@@ -1,0 +1,45 @@
+"""avenir-tpu: a TPU-native predictive-analytics framework.
+
+A ground-up JAX/XLA rebuild of the capabilities of avenir (Hadoop MapReduce /
+Storm classical data mining): Naive Bayes, Markov-chain and HMM sequence
+classification, decision trees / random forests, kNN, Apriori association
+mining, mutual-information feature selection, correlation measures, logistic
+regression, clustering, and multi-armed-bandit / reinforcement learning.
+
+Architecture (nothing here is a port; the reference's substrate was the Hadoop
+shuffle + HDFS, ours is XLA):
+
+- ``core``      -- the chombo-equivalent substrate: JSON feature schemas,
+                   properties-file config, CSV ingest to device-resident binned
+                   int32 matrices, metrics (the Hadoop-counters replacement).
+- ``ops``       -- the compute engine: a sharded group-by-composite-key
+                   counting engine (one-hot / segment-sum + psum over ICI)
+                   that replaces mapper-emit + shuffle + reducer-sum for every
+                   batch trainer, plus entropy/gini stats, sharded distance
+                   matmuls, and lax.scan sequence kernels (Viterbi).
+- ``parallel``  -- mesh construction and shard_map/pjit helpers (the
+                   "distributed communication backend": ICI collectives
+                   replace the Hadoop shuffle, replicated arrays replace HDFS
+                   side-file broadcast).
+- ``models``    -- the algorithms, each a thin parameterization of ``ops``
+                   plus host post-processing and reference-format text I/O.
+- ``datagen``   -- seeded synthetic-data generators mirroring the reference's
+                   resource/*.py|rb tutorial generators (test fixtures).
+- ``cli``       -- job registry preserving the reference's user surface:
+                   ``python -m avenir_tpu <JobName> -Dconf.path=x.properties in out``.
+"""
+
+__version__ = "0.1.0"
+
+
+def enable_x64() -> None:
+    """Opt into 64-bit JAX types for exact-parity arithmetic.
+
+    The reference does long arithmetic on count sums (e.g.
+    bayesian/BayesianDistribution.java:249-251); x64 keeps moment sums exact
+    while count tables stay int32 on the fast path.  Called by the CLI
+    drivers, bench, and tests — NOT at import, so embedding this library never
+    silently changes dtype semantics of the host program.
+    """
+    import jax
+    jax.config.update("jax_enable_x64", True)

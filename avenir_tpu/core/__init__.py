@@ -1,0 +1,12 @@
+"""Core substrate: schema binding, config, text I/O, ingest, metrics.
+
+The chombo-equivalent layer (SURVEY §2.0): the reference leans on the sister
+library chombo for config loading, schema binding, tuple/text formats and
+stats helpers; this package owns those capabilities natively.
+"""
+
+from .schema import FeatureSchema, FeatureField, CostSchema  # noqa: F401
+from .config import JobConfig, parse_properties, parse_cli_args, load_job_config  # noqa: F401
+from .io import read_lines, read_records, split_line, write_output, OutputWriter  # noqa: F401
+from .binning import DatasetEncoder, EncodedDataset, Vocab  # noqa: F401
+from .metrics import Counters, ConfusionMatrix, CostBasedArbitrator  # noqa: F401

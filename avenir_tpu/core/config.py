@@ -1,0 +1,165 @@
+"""Job configuration: Java-properties files with prefixed-key fallback.
+
+The reference passes ``-Dconf.path=<file>.properties`` to every job and loads
+it into the Hadoop Configuration (chombo ``Utility.setConfiguration``, invoked
+from every driver ``run()``, e.g. bayesian/BayesianDistribution.java:68).
+Keys are flat lower-dot-case, optionally namespaced by a job prefix with
+un-prefixed fallback (markov/MarkovStateTransitionModel.java:73-75 pattern),
+and required keys fail fast (``Utility.assertStringConfigParam``,
+association/FrequentItemsApriori.java:116-117).
+
+This module reproduces that exact user surface so existing .properties files
+drive the TPU jobs unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+class JobConfig:
+    """Flat key/value config with job-prefix fallback lookup."""
+
+    _MISSING = object()
+
+    def __init__(self, props: Optional[Dict[str, str]] = None, prefix: str = ""):
+        self.props: Dict[str, str] = dict(props or {})
+        self.prefix = prefix
+
+    # -- loading ---------------------------------------------------------
+    @classmethod
+    def from_file(cls, path: str, prefix: str = "") -> "JobConfig":
+        with open(path, "r") as fh:
+            return cls(parse_properties(fh.read()), prefix)
+
+    def with_prefix(self, prefix: str) -> "JobConfig":
+        return JobConfig(self.props, prefix)
+
+    def set(self, key: str, value) -> None:
+        self.props[key] = str(value)
+
+    # -- lookup with prefixed-key fallback -------------------------------
+    def _raw(self, key: str):
+        if self.prefix:
+            v = self.props.get(f"{self.prefix}.{key}", self._MISSING)
+            if v is not self._MISSING:
+                return v
+        v = self.props.get(key, self._MISSING)
+        return v
+
+    def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        v = self._raw(key)
+        return default if v is self._MISSING else v
+
+    def get_int(self, key: str, default: Optional[int] = None) -> Optional[int]:
+        v = self._raw(key)
+        return default if v is self._MISSING else int(v)
+
+    def get_float(self, key: str, default: Optional[float] = None) -> Optional[float]:
+        v = self._raw(key)
+        return default if v is self._MISSING else float(v)
+
+    def get_boolean(self, key: str, default: bool = False) -> bool:
+        v = self._raw(key)
+        if v is self._MISSING:
+            return default
+        return str(v).strip().lower() == "true"
+
+    def get_list(self, key: str, delim: str = ",", default=None) -> Optional[List[str]]:
+        v = self._raw(key)
+        if v is self._MISSING:
+            return default
+        return [s for s in str(v).split(delim)]
+
+    # -- fail-fast required params (Utility.assert*ConfigParam) ----------
+    def must(self, key: str, msg: Optional[str] = None) -> str:
+        v = self._raw(key)
+        if v is self._MISSING:
+            raise KeyError(msg or f"missing required configuration parameter: {key}")
+        return v
+
+    def must_int(self, key: str, msg: Optional[str] = None) -> int:
+        return int(self.must(key, msg))
+
+    def must_float(self, key: str, msg: Optional[str] = None) -> float:
+        return float(self.must(key, msg))
+
+    def must_list(self, key: str, delim: str = ",", msg: Optional[str] = None) -> List[str]:
+        return self.must(key, msg).split(delim)
+
+    # -- common conventions ----------------------------------------------
+    def field_delim_regex(self) -> str:
+        return self.get("field.delim.regex", ",")
+
+    def field_delim_out(self) -> str:
+        return self.get("field.delim.out", self.get("field.delim", ","))
+
+
+def parse_properties(text: str) -> Dict[str, str]:
+    """Parse Java .properties: ``k=v`` / ``k: v`` lines, #/! comments,
+    trailing-backslash line continuation, latin escape subset."""
+    props: Dict[str, str] = {}
+    logical: List[str] = []
+    pending = ""
+    for raw in text.splitlines():
+        # java.util.Properties strips leading whitespace of continuation lines
+        line = pending + (raw.lstrip() if pending else raw)
+        if line.rstrip().endswith("\\") and not line.rstrip().endswith("\\\\"):
+            pending = line.rstrip()[:-1]
+            continue
+        pending = ""
+        logical.append(line)
+    if pending:
+        logical.append(pending)
+
+    for line in logical:
+        s = line.strip()
+        if not s or s[0] in "#!":
+            continue
+        # find first unescaped = or :
+        sep_idx = -1
+        for i, ch in enumerate(s):
+            if ch in "=:" and (i == 0 or s[i - 1] != "\\"):
+                sep_idx = i
+                break
+            if ch.isspace():
+                # java allows whitespace separator; treat next = / : as part of value
+                sep_idx = i
+                break
+        if sep_idx <= 0:
+            continue
+        key = s[:sep_idx].strip().replace("\\=", "=").replace("\\:", ":")
+        val = s[sep_idx + 1:].lstrip() if s[sep_idx] in "=:" else s[sep_idx:].lstrip()
+        if val[:1] in "=:":
+            val = val[1:].lstrip()
+        props[key] = val
+    return props
+
+
+def parse_cli_args(argv: List[str]):
+    """Split a reference-style arg vector: ``-Dkey=value`` definitions plus
+    positional in/out paths (the hadoop GenericOptionsParser surface used by
+    every resource/*.sh driver, e.g. resource/knn.sh:70-80)."""
+    defines: Dict[str, str] = {}
+    positional: List[str] = []
+    for a in argv:
+        if a.startswith("-D") and "=" in a:
+            k, v = a[2:].split("=", 1)
+            defines[k] = v
+        else:
+            positional.append(a)
+    return defines, positional
+
+
+def load_job_config(defines: Dict[str, str], prefix: str = "") -> JobConfig:
+    """Build a JobConfig the way the reference drivers do: load the
+    ``conf.path`` properties file, then overlay any other -D defines."""
+    props: Dict[str, str] = {}
+    conf_path = defines.get("conf.path")
+    if conf_path:
+        with open(conf_path, "r") as fh:
+            props.update(parse_properties(fh.read()))
+    for k, v in defines.items():
+        if k != "conf.path":
+            props[k] = v
+    return JobConfig(props, prefix)

@@ -1,0 +1,108 @@
+"""Text I/O: delimited records in, ``part-r-*`` job outputs out.
+
+The reference's jobs consume newline-delimited text split on
+``field.delim.regex`` and write delimited text to ``part-r-NNNNN`` files in an
+output directory (every driver; conventions visible in e.g.
+resource/knn.properties ``bayesian.model.file.path=.../part-r-00000``).
+We keep both conventions so the file surface is interchangeable: a model file
+written here can be read by reference tooling and vice versa.
+
+Input paths may be a single file or a directory (all non-hidden files inside,
+sorted — mirroring how MR consumes every part file of a previous job's output
+directory).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Iterable, Iterator, List, Optional
+
+
+def _input_files(path: str) -> List[str]:
+    if os.path.isdir(path):
+        return sorted(
+            os.path.join(path, f)
+            for f in os.listdir(path)
+            if not f.startswith(("_", ".")) and os.path.isfile(os.path.join(path, f))
+        )
+    return [path]
+
+
+def read_lines(path: str) -> Iterator[str]:
+    """Yield every record line from a file or job-output directory."""
+    for fp in _input_files(path):
+        with open(fp, "r") as fh:
+            for line in fh:
+                line = line.rstrip("\n")
+                if line:
+                    yield line
+
+
+def split_line(line: str, delim_regex: str = ",") -> List[str]:
+    """Split one record on the configured delimiter regex.
+
+    Fast path for plain single-character delimiters (the overwhelmingly common
+    ``field.delim.regex=,`` case); regex split otherwise.
+    """
+    if len(delim_regex) == 1 and delim_regex not in r".^$*+?{}[]\|()":
+        return line.split(delim_regex)
+    return re.split(delim_regex, line)
+
+
+def read_records(path: str, delim_regex: str = ",") -> Iterator[List[str]]:
+    for line in read_lines(path):
+        yield split_line(line, delim_regex)
+
+
+class OutputWriter:
+    """Writes job output in the reference's directory layout.
+
+    ``OutputWriter(dir)`` produces ``dir/part-r-00000`` (plus ``_SUCCESS`` on
+    close). ``shard`` selects the part number so callers can emulate
+    partitioned reducer output (tree/DataPartitioner.java writes one part file
+    per segment); with ``as_dir=False`` the path is written as a bare file
+    (truncating any existing content) and ``shard`` is rejected.
+    """
+
+    def __init__(self, out_path: str, shard: Optional[int] = None, as_dir: bool = True):
+        self.out_path = out_path
+        self.as_dir = as_dir
+        if as_dir:
+            os.makedirs(out_path, exist_ok=True)
+            self.file_path = os.path.join(out_path, f"part-r-{(shard or 0):05d}")
+        else:
+            if shard is not None:
+                raise ValueError("shard is only meaningful with as_dir=True")
+            parent = os.path.dirname(out_path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            self.file_path = out_path
+        self._fh = open(self.file_path, "w")
+
+    def write(self, line: str) -> None:
+        self._fh.write(line)
+        self._fh.write("\n")
+
+    def write_all(self, lines: Iterable[str]) -> None:
+        for line in lines:
+            self.write(line)
+
+    def close(self, success_marker: bool = True) -> None:
+        self._fh.close()
+        if self.as_dir and success_marker:
+            open(os.path.join(self.out_path, "_SUCCESS"), "w").close()
+
+    def __enter__(self) -> "OutputWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close(success_marker=exc[0] is None)
+
+
+def write_output(out_path: str, lines: Iterable[str], shard: Optional[int] = None,
+                 as_dir: bool = True) -> str:
+    """One-shot job-output write; returns the part file path."""
+    with OutputWriter(out_path, shard=shard, as_dir=as_dir) as w:
+        w.write_all(lines)
+    return w.file_path

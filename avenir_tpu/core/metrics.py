@@ -1,0 +1,107 @@
+"""Metrics: the Hadoop-counters replacement, plus validation helpers.
+
+The reference's only driver-visible metric channel is Hadoop counters
+(groups "Validation", "Stats", "Distribution Data", ...; e.g.
+bayesian/BayesianPredictor.java:170-180).  Here every job returns/fills a
+:class:`Counters` dict; CLI drivers print it, library callers inspect it.
+
+Also the validation arithmetic the reference keeps in util/:
+- :class:`ConfusionMatrix` (util/ConfusionMatrix.java:21-78): binary
+  confusion counts with integer percent accuracy/recall/precision.
+- :class:`CostBasedArbitrator` (util/CostBasedArbitrator.java:21-46):
+  misclassification-cost argmin between two classes.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, Iterator, Tuple
+
+
+class Counters:
+    """Grouped named counters; the metrics dict every job returns."""
+
+    def __init__(self):
+        self._groups: Dict[str, Dict[str, int]] = defaultdict(lambda: defaultdict(int))
+
+    def incr(self, group: str, name: str, amount: int = 1) -> None:
+        self._groups[group][name] += int(amount)
+
+    def set(self, group: str, name: str, value: int) -> None:
+        self._groups[group][name] = int(value)
+
+    def get(self, group: str, name: str) -> int:
+        return self._groups[group].get(name, 0)
+
+    def items(self) -> Iterator[Tuple[str, str, int]]:
+        for g in sorted(self._groups):
+            for n in sorted(self._groups[g]):
+                yield g, n, self._groups[g][n]
+
+    def as_dict(self) -> Dict[str, Dict[str, int]]:
+        return {g: dict(names) for g, names in self._groups.items()}
+
+    def format(self) -> str:
+        return "\n".join(f"{g}\t{n}\t{v}" for g, n, v in self.items())
+
+
+class ConfusionMatrix:
+    """Binary confusion counts; constructor order (negClass, posClass) as in
+    util/ConfusionMatrix.java:29-32; percentages are floor-divided ints."""
+
+    def __init__(self, neg_class: str, pos_class: str):
+        self.neg_class = neg_class
+        self.pos_class = pos_class
+        self.true_pos = self.false_pos = self.true_neg = self.false_neg = 0
+
+    def report(self, pred_class: str, actual_class: str) -> None:
+        if pred_class == self.pos_class:
+            if actual_class == self.pos_class:
+                self.true_pos += 1
+            else:
+                self.false_pos += 1
+        else:
+            if actual_class == self.neg_class:
+                self.true_neg += 1
+            else:
+                self.false_neg += 1
+
+    def recall(self) -> int:
+        return (100 * self.true_pos) // (self.true_pos + self.false_neg)
+
+    def precision(self) -> int:
+        return (100 * self.true_pos) // (self.true_pos + self.false_pos)
+
+    def accuracy(self) -> int:
+        total = self.true_pos + self.true_neg + self.false_pos + self.false_neg
+        return (100 * (self.true_pos + self.true_neg)) // total
+
+    def to_counters(self, counters: Counters, group: str = "Validation") -> None:
+        counters.incr(group, "TruePositive", self.true_pos)
+        counters.incr(group, "FalseNegative", self.false_neg)
+        counters.incr(group, "TrueNagative", self.true_neg)  # sic, reference spelling
+        counters.incr(group, "FalsePositive", self.false_pos)
+        counters.incr(group, "Accuracy", self.accuracy())
+        counters.incr(group, "Recall", self.recall())
+        counters.incr(group, "Precision", self.precision())
+
+
+class CostBasedArbitrator:
+    """Pick the class minimizing expected misclassification cost
+    (util/CostBasedArbitrator.java:35-45 semantics, integer probs 0..100)."""
+
+    def __init__(self, neg_class: str, pos_class: str,
+                 false_neg_cost: int, false_pos_cost: int):
+        self.neg_class = neg_class
+        self.pos_class = pos_class
+        self.false_neg_cost = false_neg_cost
+        self.false_pos_cost = false_pos_cost
+
+    def arbitrate(self, pos_prob: int, neg_prob: int) -> str:
+        neg_cost = self.false_neg_cost * pos_prob + neg_prob
+        pos_cost = self.false_pos_cost * neg_prob + pos_prob
+        return self.pos_class if pos_cost < neg_cost else self.neg_class
+
+    def classify(self, pos_prob: int) -> str:
+        threshold = (self.false_pos_cost * 100) // (self.false_pos_cost + self.false_neg_cost)
+        return self.pos_class if pos_prob > threshold else self.neg_class

@@ -1,0 +1,190 @@
+"""Feature schema binding (chombo ``FeatureSchema``/``FeatureField`` equivalent).
+
+The reference binds JSON metadata files with Jackson into ``FeatureSchema``
+(see reference use at bayesian/BayesianDistribution.java:118-124 and the
+exemplar resource/teleComChurn.json).  This module reads the *same* JSON files
+so existing user metadata works unchanged.
+
+Field semantics reproduced here:
+- ``feature``: participates as a predictor.
+- ``id``: record identifier, passed through.
+- class attribute: a field that is neither feature nor id (the reference's
+  ``findClassAttrField``), or explicitly ``"classAttr": true``.
+- categorical fields carry optional ``cardinality`` (list of values);
+- numeric fields may carry ``bucketWidth`` (bin = value // bucketWidth,
+  bayesian/BayesianDistribution.java:152-154), ``min``/``max``,
+  ``splitScanInterval`` and ``maxSplit`` (tree split enumeration).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class FeatureField:
+    name: str = ""
+    ordinal: int = -1
+    dataType: str = "string"
+    feature: bool = False
+    id: bool = False
+    classAttr: bool = False
+    cardinality: List[str] = dc_field(default_factory=list)
+    bucketWidth: Optional[int] = None
+    min: Optional[float] = None
+    max: Optional[float] = None
+    splitScanInterval: Optional[float] = None
+    maxSplit: Optional[int] = None
+    # everything else from the JSON is kept for forward compatibility
+    extra: Dict[str, Any] = dc_field(default_factory=dict)
+
+    _KNOWN = {
+        "name", "ordinal", "dataType", "feature", "id", "classAttr",
+        "cardinality", "bucketWidth", "min", "max", "splitScanInterval",
+        "maxSplit",
+    }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "FeatureField":
+        f = cls()
+        for k, v in d.items():
+            if k in cls._KNOWN:
+                setattr(f, k, v)
+            else:
+                f.extra[k] = v
+        if f.cardinality is None:
+            f.cardinality = []
+        return f
+
+    # -- predicates matching chombo FeatureField usage --
+    def is_feature(self) -> bool:
+        return bool(self.feature)
+
+    def is_id(self) -> bool:
+        return bool(self.id)
+
+    def is_categorical(self) -> bool:
+        return self.dataType == "categorical"
+
+    def is_integer(self) -> bool:
+        return self.dataType == "int"
+
+    def is_double(self) -> bool:
+        return self.dataType == "double"
+
+    def is_numeric(self) -> bool:
+        return self.dataType in ("int", "double")
+
+    def is_bucket_width_defined(self) -> bool:
+        return self.bucketWidth is not None and self.bucketWidth > 0
+
+    def is_class_attr(self) -> bool:
+        # explicit flag wins; otherwise "neither feature nor id" as in chombo
+        return bool(self.classAttr) or (not self.feature and not self.id)
+
+    def num_bins(self) -> int:
+        """Static bin count for the dense count tensors.
+
+        Categorical: vocabulary size (from cardinality, else discovered).
+        Bucketed numeric: max // bucketWidth + 1 (requires max).
+        """
+        if self.is_categorical():
+            return len(self.cardinality)
+        if self.is_bucket_width_defined():
+            if self.max is None:
+                raise ValueError(
+                    f"field {self.name}: bucketWidth without max; cannot size bins")
+            return int(self.max) // int(self.bucketWidth) + 1
+        return 0
+
+
+class FeatureSchema:
+    """Parsed feature-schema JSON; the single metadata object every job uses."""
+
+    def __init__(self, fields: List[FeatureField]):
+        self.fields = fields
+
+    @classmethod
+    def from_json(cls, text: str) -> "FeatureSchema":
+        d = json.loads(text)
+        return cls([FeatureField.from_dict(f) for f in d.get("fields", [])])
+
+    @classmethod
+    def from_file(cls, path: str) -> "FeatureSchema":
+        with open(path, "r") as fh:
+            return cls.from_json(fh.read())
+
+    def get_fields(self) -> List[FeatureField]:
+        return self.fields
+
+    def feature_fields(self) -> List[FeatureField]:
+        return [f for f in self.fields if f.is_feature()]
+
+    def id_field(self) -> Optional[FeatureField]:
+        for f in self.fields:
+            if f.is_id():
+                return f
+        return None
+
+    def class_attr_field(self) -> FeatureField:
+        explicit = [f for f in self.fields if f.classAttr]
+        if explicit:
+            return explicit[0]
+        implicit = [f for f in self.fields if not f.feature and not f.id]
+        if not implicit:
+            raise ValueError("schema has no class attribute field")
+        return implicit[-1]
+
+    def field_by_ordinal(self, ordinal: int) -> FeatureField:
+        for f in self.fields:
+            if f.ordinal == ordinal:
+                return f
+        raise KeyError(f"no field with ordinal {ordinal}")
+
+    def field_by_name(self, name: str) -> FeatureField:
+        for f in self.fields:
+            if f.name == name:
+                return f
+        raise KeyError(f"no field named {name}")
+
+    def max_ordinal(self) -> int:
+        return max(f.ordinal for f in self.fields)
+
+
+@dataclass
+class CostAttribute:
+    """One attribute's change cost (util/CostSchema.java:43-77 equivalent)."""
+    name: str = ""
+    ordinal: int = -1
+    cost: float = 0.0
+    extra: Dict[str, Any] = dc_field(default_factory=dict)
+
+
+class CostSchema:
+    """Attribute-change cost metadata (util/CostSchema.java equivalent)."""
+
+    def __init__(self, attributes: List[CostAttribute]):
+        self.attributes = attributes
+
+    @classmethod
+    def from_file(cls, path: str) -> "CostSchema":
+        with open(path, "r") as fh:
+            d = json.load(fh)
+        attrs = []
+        for a in d.get("attributes", d.get("costAttributes", [])):
+            ca = CostAttribute()
+            for k, v in a.items():
+                if hasattr(ca, k) and k != "extra":
+                    setattr(ca, k, v)
+                else:
+                    ca.extra[k] = v
+            attrs.append(ca)
+        return cls(attrs)
+
+    def cost_by_ordinal(self, ordinal: int) -> float:
+        for a in self.attributes:
+            if a.ordinal == ordinal:
+                return a.cost
+        raise KeyError(f"no cost attribute with ordinal {ordinal}")

@@ -1,0 +1,17 @@
+"""Seeded synthetic-data generators (test fixtures).
+
+The reference ships per-tutorial generator scripts with planted signals
+(resource/telecom_churn.py, freq_items.py, price_opt.py, xaction_seq.rb, ...)
+that double as its only test strategy (SURVEY §4).  These NumPy rebuilds are
+seeded and deterministic so unit/integration tests can assert planted-signal
+recovery.
+"""
+
+from .generators import (  # noqa: F401
+    gen_telecom_churn,
+    gen_transactions,
+    gen_state_sequences,
+    gen_hmm_sequences,
+    gen_price_rounds,
+    gen_numeric_classed,
+)

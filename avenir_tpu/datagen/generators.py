@@ -1,0 +1,183 @@
+"""Deterministic synthetic datasets with planted signals.
+
+Each generator mirrors a reference tutorial fixture (citations inline) but is
+rewritten on seeded ``numpy.random.Generator`` so tests are reproducible; the
+reference used unseeded ``random``/``Math.random`` everywhere (SURVEY §7.3.5),
+so only statistical — not bitwise — equivalence is meaningful.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+def _clip_int(rng, mean, sd, lo, hi, size=None):
+    v = np.rint(rng.normal(mean, sd, size)).astype(int)
+    return np.clip(v, lo, hi)
+
+
+def gen_telecom_churn(n: int, seed: int = 42) -> List[List[str]]:
+    """Telecom-churn rows per resource/telecom_churn.py:13-76 /
+    resource/teleComChurn.json: id,plan,minUsed,dataUsed,csCall,csEmail,
+    network,churned.  ~20% churners via three planted causes: bad plan +
+    heavy usage; excess customer-service contact; small network.
+    """
+    rng = np.random.default_rng(seed)
+    rows = []
+    min_usage = [(600, 50), (1200, 300)]
+    data_usage = [(200, 50), (500, 150)]
+    cs_call = [(4, 1), (8, 2)]
+    cs_email = [(6, 2), (10, 3)]
+    network = [(3, 1), (6, 2)]
+
+    def draw(dist, i, lo, hi):
+        m, s = dist[i]
+        return int(_clip_int(rng, m, s, lo, hi))
+
+    for i in range(n):
+        cust_id = f"C{seed:02d}{i:07d}"
+        churn = rng.integers(1, 100) > 80
+        if churn:
+            case = rng.integers(1, 4)
+            churned = "Y"
+            if case == 1:        # bad plan, heavy usage
+                plan = "planA"
+                mu = draw(min_usage, 1, 0, 2200)
+                du = draw(data_usage, 1, 0, 1000)
+                cc = draw(cs_call, 0, 0, 14)
+                ce = draw(cs_email, 0, 0, 22)
+                nw = draw(network, 0, 0, 12)
+            elif case == 2:      # too many CS contacts
+                plan = "planB"
+                mu = draw(min_usage, 1, 0, 2200)
+                du = draw(data_usage, 1, 0, 1000)
+                cc = max(draw(cs_call, 1, 0, 14), 6)
+                ce = max(draw(cs_email, 1, 0, 22), 8)
+                nw = draw(network, 0, 0, 12)
+            else:                # small network
+                plan = "planB"
+                mu = min(draw(min_usage, 1, 0, 2200) + 200, 2200)
+                du = min(draw(data_usage, 1, 0, 1000) + 100, 1000)
+                cc = draw(cs_call, 0, 0, 14)
+                ce = draw(cs_email, 0, 0, 22)
+                nw = draw(network, 0, 0, 12)
+        else:
+            churned = "N"
+            plan = "planA" if rng.random() < 0.5 else "planB"
+            p = 0 if plan == "planA" else 1
+            mu = draw(min_usage, p, 0, 2200)
+            du = draw(data_usage, p, 0, 1000)
+            cc = min(draw(cs_call, 0, 0, 14), 2)
+            ce = min(draw(cs_email, 0, 0, 22), 3)
+            nw = draw(network, 1, 0, 12)
+        rows.append([cust_id, plan, str(mu), str(du), str(cc), str(ce),
+                     str(nw), churned])
+    return rows
+
+
+def gen_transactions(n_trans: int, n_items: int,
+                     planted: Sequence[Sequence[int]] = ((3, 7, 11),),
+                     planted_support: float = 0.2,
+                     items_per_trans: Tuple[int, int] = (4, 10),
+                     seed: int = 42) -> List[List[str]]:
+    """Market-basket transactions with planted frequent itemsets per
+    resource/freq_items.py / freq_items_apriori_tutorial.txt:19-24.
+    Row = transId, itemId, itemId, ...  (items as string ids)."""
+    rng = np.random.default_rng(seed)
+    rows = []
+    for t in range(n_trans):
+        k = int(rng.integers(items_per_trans[0], items_per_trans[1] + 1))
+        items = set(rng.integers(0, n_items, k).tolist())
+        for pset in planted:
+            if rng.random() < planted_support:
+                items.update(pset)
+        rows.append([f"T{t:06d}"] + [f"I{i:05d}" for i in sorted(items)])
+    return rows
+
+
+def gen_state_sequences(n_seqs: int, states: Sequence[str],
+                        trans_by_class: dict,
+                        seq_len: Tuple[int, int] = (10, 30),
+                        class_probs: Sequence[float] = None,
+                        seed: int = 42) -> List[List[str]]:
+    """Per-entity state sequences from class-conditional Markov chains
+    (the xaction_state.rb / cust_churn_markov_chain pipeline shape:
+    entityId, classLabel, s1, s2, ...).  ``trans_by_class`` maps class label
+    -> row-stochastic matrix [S, S]."""
+    rng = np.random.default_rng(seed)
+    classes = list(trans_by_class.keys())
+    if class_probs is None:
+        class_probs = [1.0 / len(classes)] * len(classes)
+    S = len(states)
+    rows = []
+    for i in range(n_seqs):
+        c = classes[rng.choice(len(classes), p=np.asarray(class_probs))]
+        T = np.asarray(trans_by_class[c], dtype=float)
+        L = int(rng.integers(seq_len[0], seq_len[1] + 1))
+        s = int(rng.integers(0, S))
+        seq = [states[s]]
+        for _ in range(L - 1):
+            s = int(rng.choice(S, p=T[s]))
+            seq.append(states[s])
+        rows.append([f"E{i:06d}", c] + seq)
+    return rows
+
+
+def gen_hmm_sequences(n_seqs: int, states: Sequence[str], obs: Sequence[str],
+                      A: np.ndarray, B: np.ndarray, pi: np.ndarray,
+                      seq_len: Tuple[int, int] = (8, 20),
+                      seed: int = 42) -> List[List[str]]:
+    """Fully-tagged HMM training rows: entityId, obs1:state1, obs2:state2 ...
+    (the HiddenMarkovModelBuilder fully-tagged input form,
+    markov/HiddenMarkovModelBuilder.java:136-166)."""
+    rng = np.random.default_rng(seed)
+    A = np.asarray(A, float); B = np.asarray(B, float); pi = np.asarray(pi, float)
+    rows = []
+    for i in range(n_seqs):
+        L = int(rng.integers(seq_len[0], seq_len[1] + 1))
+        s = int(rng.choice(len(states), p=pi))
+        pairs = []
+        for t in range(L):
+            o = int(rng.choice(len(obs), p=B[s]))
+            pairs.append(f"{obs[o]}:{states[s]}")
+            s = int(rng.choice(len(states), p=A[s]))
+        rows.append([f"E{i:06d}"] + pairs)
+    return rows
+
+
+def gen_price_rounds(n_products: int, n_prices: int = 5, seed: int = 42):
+    """Bandit price-optimization fixture per resource/price_opt.py /
+    price_optimize_tutorial.txt:8-13: each product has candidate prices with
+    hidden mean profits; returns (price labels per product, hidden mean
+    reward matrix [product, price], reward-sampler fn)."""
+    rng = np.random.default_rng(seed)
+    base = rng.uniform(20, 100, n_products)
+    prices = np.stack([base * (0.8 + 0.1 * k) for k in range(n_prices)], axis=1)
+    # hidden best price index differs per product
+    best = rng.integers(0, n_prices, n_products)
+    mean_profit = np.empty((n_products, n_prices))
+    for p in range(n_products):
+        for k in range(n_prices):
+            mean_profit[p, k] = 10.0 - 2.0 * abs(k - best[p]) + rng.uniform(-0.5, 0.5)
+
+    def sample_reward(product: int, price_idx: int, rng2=None) -> float:
+        r = (rng2 or rng)
+        return float(mean_profit[product, price_idx] + r.normal(0, 1.0))
+
+    return prices, mean_profit, sample_reward
+
+
+def gen_numeric_classed(n: int, n_features: int = 4, n_classes: int = 2,
+                        sep: float = 2.0, seed: int = 42) -> List[List[str]]:
+    """Generic numeric classification rows (id, f1..fk, class) with
+    class-separated Gaussian features — fixture for logistic regression,
+    Fisher discriminant, and kNN."""
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        c = int(rng.integers(0, n_classes))
+        feats = rng.normal(c * sep, 1.0, n_features)
+        rows.append([f"R{i:06d}"] + [f"{v:.3f}" for v in feats] + [f"C{c}"])
+    return rows

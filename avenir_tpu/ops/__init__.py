@@ -1,0 +1,13 @@
+"""Compute kernels: the counting engine, stats, distances, sequence scans.
+
+Nearly every avenir trainer is group-by-composite-key integer counting over a
+binned feature matrix (SURVEY §7.1); ``ops.counting`` is the single engine
+that replaces all of those mapper-emit / shuffle / reducer-sum pipelines.
+"""
+
+from .counting import (  # noqa: F401
+    count_table,
+    moment_table,
+    feature_class_counts,
+    sharded_reduce,
+)

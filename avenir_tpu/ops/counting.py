@@ -1,0 +1,176 @@
+"""The counting engine: dense group-by-composite-key reductions on TPU.
+
+The reference's universal computational shape is: per-record map emits
+``(composite key, small count/value tuple)``, hash shuffle, reducer sums
+(SURVEY §1; canonical instance bayesian/BayesianDistribution.java:144-175 map
++ :264-328 reduce).  On TPU that whole pipeline is ONE dense scatter-add:
+
+    C[k1, k2, ...] += w        for every record
+
+with the composite key raveled to a flat index and XLA lowering the
+scatter-add onto the VPU; across the ``data`` mesh axis the per-shard partial
+tables (the "combiner" outputs) are summed with ``lax.psum`` over ICI (the
+"shuffle + reducer").  Keys are integers by construction because ingest
+(core.binning) already vocab-encoded every categorical.
+
+Design notes for the MXU/VPU:
+- count tensors are small and dense (classes x fields x bins); the scatter is
+  over ``n`` records and vectorizes.  No dynamic shapes: invalid/padded rows
+  are masked to weight 0 and scattered to index 0 rather than branched on.
+- everything here is jit-friendly and shape-polymorphic only in the static
+  Python sense (sizes are compile-time constants).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import get_mesh, pad_rows
+
+
+def _ravel(sizes: Sequence[int], indices: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """Row-major ravel of a composite integer key."""
+    flat = jnp.zeros_like(jnp.asarray(indices[0]))
+    for size, idx in zip(sizes, indices):
+        flat = flat * size + idx
+    return flat
+
+
+def count_table(sizes: Sequence[int],
+                indices: Sequence[jnp.ndarray],
+                weights: Optional[jnp.ndarray] = None,
+                mask: Optional[jnp.ndarray] = None,
+                dtype=jnp.int32) -> jnp.ndarray:
+    """Dense count tensor ``C[sizes]`` with ``C[idx...] += w`` per element.
+
+    ``indices`` are broadcast against each other; out-of-range or masked
+    elements contribute nothing (scattered to slot 0 with weight 0, keeping
+    shapes static).
+    """
+    sizes = tuple(int(s) for s in sizes)
+    idx = jnp.broadcast_arrays(*[jnp.asarray(i) for i in indices])
+    valid = jnp.ones(idx[0].shape, dtype=bool)
+    for size, i in zip(sizes, idx):
+        valid &= (i >= 0) & (i < size)
+    if mask is not None:
+        valid &= jnp.broadcast_to(jnp.asarray(mask), idx[0].shape)
+    if weights is None:
+        w = valid.astype(dtype)
+    else:
+        w = jnp.where(valid, jnp.broadcast_to(jnp.asarray(weights, dtype), idx[0].shape),
+                      jnp.zeros((), dtype))
+    flat = jnp.where(valid, _ravel(sizes, idx), 0)
+    total = int(np.prod(sizes)) if sizes else 1
+    out = jnp.zeros((total,), dtype=dtype).at[flat.ravel()].add(w.ravel())
+    return out.reshape(sizes)
+
+
+def moment_table(sizes: Sequence[int],
+                 indices: Sequence[jnp.ndarray],
+                 values: jnp.ndarray,
+                 mask: Optional[jnp.ndarray] = None,
+                 dtype=None) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(count, sum, sum-of-squares) tables for Gaussian parameter estimation
+    (the reference's (1, v, v^2) tuple emission,
+    bayesian/BayesianDistribution.java:156-171).
+
+    One validity pass and one scatter: the three channels ride a trailing
+    axis of a single scatter-add.  Sums are exact when the caller has opted
+    into x64 (``avenir_tpu.enable_x64``); otherwise float32.
+    """
+    if dtype is None:
+        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    sizes = tuple(int(s) for s in sizes)
+    idx = jnp.broadcast_arrays(*[jnp.asarray(i) for i in indices])
+    values = jnp.broadcast_to(jnp.asarray(values, dtype), idx[0].shape)
+    valid = jnp.ones(idx[0].shape, dtype=bool)
+    for size, i in zip(sizes, idx):
+        valid &= (i >= 0) & (i < size)
+    if mask is not None:
+        valid &= jnp.broadcast_to(jnp.asarray(mask), idx[0].shape)
+    flat = jnp.where(valid, _ravel(sizes, idx), 0)
+    w = jnp.stack([valid.astype(dtype),
+                   jnp.where(valid, values, 0),
+                   jnp.where(valid, values * values, 0)], axis=-1)
+    total = int(np.prod(sizes)) if sizes else 1
+    out = jnp.zeros((total, 3), dtype=dtype).at[flat.ravel()].add(
+        w.reshape(-1, 3))
+    out = out.reshape(sizes + (3,))
+    return out[..., 0].astype(jnp.int64 if jax.config.jax_enable_x64 else jnp.int32), \
+        out[..., 1], out[..., 2]
+
+
+def feature_class_counts(x: jnp.ndarray, y: jnp.ndarray,
+                         n_class: int, max_bins: int,
+                         mask: Optional[jnp.ndarray] = None,
+                         dtype=jnp.int32) -> jnp.ndarray:
+    """``C[class, feature, bin] += 1`` for every (record, feature column) --
+    the Naive Bayes / split-gain / MI base table, one scatter for all columns.
+
+    ``x`` is the int32 [n, F] binned matrix; unbinned columns hold -1 and
+    self-mask.  The feature extent comes from ``x.shape[1]`` so a mismatch
+    cannot silently drop columns.
+    """
+    n, F = x.shape
+    col = jnp.broadcast_to(jnp.arange(F, dtype=x.dtype)[None, :], (n, F))
+    ycol = jnp.broadcast_to(jnp.asarray(y)[:, None], (n, F))
+    m = None if mask is None else jnp.broadcast_to(jnp.asarray(mask)[:, None], (n, F))
+    return count_table((n_class, F, max_bins), (ycol, col, x),
+                       mask=m, dtype=dtype)
+
+
+# Compiled-function cache so iterative callers (tree levels, Apriori passes,
+# bandit rounds) hit XLA's jit cache instead of retracing every call: jit keys
+# on the function object, and a fresh closure per call would defeat it.
+_sharded_reduce_cache: dict = {}
+
+
+def sharded_reduce(local_fn: Callable, *row_arrays,
+                   mesh=None,
+                   static_args: tuple = ()):
+    """Run ``local_fn(shard..., mask_shard, *static_args)`` over row-sharded
+    inputs and psum the resulting pytree over the ``data`` axis.
+
+    This is the whole MapReduce skeleton: ``local_fn`` plays
+    mapper+combiner on its shard; the ``psum`` is shuffle+reducer.  Inputs are
+    host numpy arrays with a common leading row count; they are padded to the
+    mesh's data-axis size with a validity mask appended as the last array
+    argument.  The result is fully replicated (every chip holds the totals,
+    exactly like every reducer's output concatenated).
+
+    ``static_args`` must be hashable; they are baked into the compiled
+    function (compile-time constants), and the compiled function is cached on
+    (local_fn, mesh, static_args, shapes/dtypes).
+    """
+    mesh = mesh or get_mesh()
+    d = mesh.shape["data"]
+    padded = []
+    mask = None
+    for a in row_arrays:
+        pa, mask = pad_rows(np.asarray(a), d)
+        padded.append(pa)
+
+    key = (local_fn, mesh, static_args,
+           tuple((a.shape, a.dtype.str) for a in padded))
+    fn = _sharded_reduce_cache.get(key)
+    if fn is None:
+        in_specs = tuple(P("data", *([None] * (a.ndim - 1))) for a in padded)
+        in_specs = in_specs + (P("data"),)
+
+        def wrapped(*args):
+            *shards, m = args
+            out = local_fn(*shards, m, *static_args)
+            return jax.tree_util.tree_map(lambda t: jax.lax.psum(t, "data"), out)
+
+        # out_specs P(): psum makes every shard's output identical (replicated)
+        fn = jax.jit(shard_map(wrapped, mesh=mesh, in_specs=in_specs,
+                               out_specs=P()))
+        _sharded_reduce_cache[key] = fn
+    return fn(*padded, mask)

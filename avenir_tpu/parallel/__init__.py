@@ -1,0 +1,18 @@
+"""Distributed substrate: mesh construction + collective helpers.
+
+This package is the rebuild's "communication backend".  The reference's
+backend is the Hadoop shuffle (sort-merge over HTTP), HDFS side files, and
+Hadoop counters (SURVEY §2.3); here every one of those becomes an XLA
+construct: hash-shuffle + reducer-sum -> ``lax.psum`` over ICI inside
+``shard_map``; HDFS side-file broadcast -> replicated device arrays; the
+reducer count is the mesh size.
+"""
+
+from .mesh import (  # noqa: F401
+    get_mesh,
+    make_mesh,
+    data_axis_size,
+    pad_rows,
+    shard_rows,
+    replicate,
+)

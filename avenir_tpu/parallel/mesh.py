@@ -1,0 +1,81 @@
+"""Device-mesh construction and row-sharding helpers.
+
+Every batch job in the framework runs the same SPMD shape: the record matrix
+is sharded over the ``data`` mesh axis (the analogue of Hadoop input splits),
+small model/count tensors are replicated (the analogue of HDFS side-file
+broadcast, e.g. bayesian/BayesianPredictor.java:186-224 loading the model in
+every mapper), and reductions ride ICI via ``psum`` inside ``shard_map``.
+
+A second ``model`` axis is available for the O(n^2) kernels (kNN / clustering
+distance matmuls shard both operand row-spaces — 2-D sharding, the TP
+analogue for this workload family).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
+              data: Optional[int] = None,
+              model: int = 1) -> Mesh:
+    """Build a (data, model) mesh over the given (default: all) devices."""
+    devs = list(devices) if devices is not None else jax.devices()
+    n = len(devs)
+    if data is None:
+        data = n // model
+    if data * model != n:
+        raise ValueError(f"mesh {data}x{model} != {n} devices")
+    arr = np.asarray(devs).reshape(data, model)
+    return Mesh(arr, axis_names=("data", "model"))
+
+
+_default_mesh: Optional[Mesh] = None
+
+
+def get_mesh() -> Mesh:
+    """Process-wide default mesh over all visible devices (data axis only)."""
+    global _default_mesh
+    if _default_mesh is None or _default_mesh.devices.size != len(jax.devices()):
+        _default_mesh = make_mesh()
+    return _default_mesh
+
+
+def data_axis_size(mesh: Optional[Mesh] = None) -> int:
+    mesh = mesh or get_mesh()
+    return mesh.shape["data"]
+
+
+def pad_rows(arr: np.ndarray, multiple: int,
+             fill=0) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad axis 0 to a multiple of the data-axis size so rows shard evenly.
+
+    Returns (padded array, bool validity mask) — padding rows carry
+    ``mask=False`` so counting kernels weight them zero instead of branching
+    on a dynamic shape (static shapes keep XLA on the fast path).
+    """
+    n = arr.shape[0]
+    target = ((n + multiple - 1) // multiple) * multiple
+    mask = np.zeros(target, dtype=bool)
+    mask[:n] = True
+    if target == n:
+        return arr, mask
+    pad_width = [(0, target - n)] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, pad_width, constant_values=fill), mask
+
+
+def shard_rows(arr, mesh: Optional[Mesh] = None, axis: str = "data"):
+    """Place an array with axis 0 sharded over the given mesh axis."""
+    mesh = mesh or get_mesh()
+    spec = P(axis, *([None] * (arr.ndim - 1)))
+    return jax.device_put(arr, NamedSharding(mesh, spec))
+
+
+def replicate(arr, mesh: Optional[Mesh] = None):
+    """Place an array replicated on every device of the mesh (broadcast)."""
+    mesh = mesh or get_mesh()
+    return jax.device_put(arr, NamedSharding(mesh, P()))

@@ -1,0 +1,34 @@
+"""Test harness: 8 virtual CPU devices so multi-chip sharding paths run
+everywhere (SURVEY §4: shard_map-on-8-devices results must match the
+single-device path bit-for-bit — counts are integers)."""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# The env-var route (JAX_PLATFORMS=cpu) is overridden by site TPU plugins,
+# so pin the platform through the config API before any backend initializes.
+jax.config.update("jax_platforms", "cpu")
+
+import avenir_tpu  # noqa: E402
+
+avenir_tpu.enable_x64()
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from avenir_tpu.parallel import make_mesh
+    assert len(jax.devices()) == 8, "expected 8 virtual devices"
+    return make_mesh()
+
+
+@pytest.fixture(scope="session")
+def mesh1():
+    from avenir_tpu.parallel import make_mesh
+    return make_mesh(devices=jax.devices()[:1])

@@ -1,0 +1,138 @@
+"""Stage-0 substrate tests: schema, config, io, binning, metrics."""
+
+import os
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core import (
+    ConfusionMatrix, CostBasedArbitrator, DatasetEncoder, FeatureSchema,
+    JobConfig, parse_cli_args, parse_properties, read_records, split_line,
+    write_output,
+)
+from avenir_tpu.datagen import gen_telecom_churn
+
+CHURN_SCHEMA = """
+{
+  "fields": [
+    {"name": "id", "ordinal": 0, "id": true, "dataType": "string"},
+    {"name": "plan", "ordinal": 1, "dataType": "categorical", "feature": true},
+    {"name": "minUsed", "ordinal": 2, "dataType": "int", "feature": true,
+     "min": 0, "max": 2200, "bucketWidth": 200},
+    {"name": "csCall", "ordinal": 3, "dataType": "int", "feature": true,
+     "min": 0, "max": 14},
+    {"name": "churned", "ordinal": 4, "dataType": "categorical"}
+  ]
+}
+"""
+
+
+def test_schema_binding():
+    s = FeatureSchema.from_json(CHURN_SCHEMA)
+    assert [f.name for f in s.feature_fields()] == ["plan", "minUsed", "csCall"]
+    assert s.class_attr_field().name == "churned"
+    assert s.id_field().name == "id"
+    f = s.field_by_ordinal(2)
+    assert f.is_bucket_width_defined() and f.num_bins() == 12
+    assert not s.field_by_ordinal(3).is_bucket_width_defined()
+
+
+def test_properties_parsing_and_prefix_fallback():
+    props = parse_properties(
+        "# comment\n"
+        "field.delim.regex=,\n"
+        "mst.trans.prob.scale=1000\n"
+        "trans.prob.scale=100\n"
+        "debug.on=true\n"
+        "names=a,b,c\n")
+    cfg = JobConfig(props, prefix="mst")
+    assert cfg.get_int("trans.prob.scale") == 1000      # prefixed wins
+    assert cfg.with_prefix("xyz").get_int("trans.prob.scale") == 100
+    assert cfg.get_boolean("debug.on") is True
+    assert cfg.get_list("names") == ["a", "b", "c"]
+    with pytest.raises(KeyError):
+        cfg.must("nope")
+
+
+def test_cli_arg_surface():
+    defines, pos = parse_cli_args(
+        ["-Dconf.path=/tmp/x.properties", "-Dnum.reducer=3", "in_dir", "out_dir"])
+    assert defines["num.reducer"] == "3" and pos == ["in_dir", "out_dir"]
+
+
+def test_io_roundtrip(tmp_path):
+    out = str(tmp_path / "job_out")
+    write_output(out, ["a,1", "b,2"])
+    assert os.path.exists(os.path.join(out, "part-r-00000"))
+    assert os.path.exists(os.path.join(out, "_SUCCESS"))
+    recs = list(read_records(out))
+    assert recs == [["a", "1"], ["b", "2"]]
+    assert split_line("a|b", r"\|") == ["a", "b"]
+
+
+def test_encoder_binning_semantics():
+    s = FeatureSchema.from_json(CHURN_SCHEMA)
+    enc = DatasetEncoder(s)
+    rows = [
+        ["id1", "planA", "399", "3", "N"],
+        ["id2", "planB", "400", "7", "Y"],
+        ["id3", "planA", "2200", "0", "N"],
+    ]
+    ds = enc.encode(rows)
+    assert ds.x.shape == (3, 3)
+    # categorical vocab order = first seen
+    assert ds.x[:, 0].tolist() == [0, 1, 0]
+    # bucketWidth binning: value // 200
+    assert ds.x[:, 1].tolist() == [1, 2, 11]
+    # unbinned numeric: -1 bins, raw values kept
+    assert ds.x[:, 2].tolist() == [-1, -1, -1]
+    assert ds.values[:, 2].tolist() == [3.0, 7.0, 0.0]
+    assert ds.y.tolist() == [0, 1, 0]
+    assert ds.num_bins == [2, 12, 0]
+    assert ds.ids == ["id1", "id2", "id3"]
+
+
+def test_negative_value_binning_java_semantics():
+    # Java integer division truncates toward zero: -5/2 == -2; negative bins
+    # shift via bin_offset so the dense tensors stay zero-based.
+    s = FeatureSchema.from_json("""
+    {"fields": [
+      {"name": "temp", "ordinal": 0, "dataType": "int", "feature": true,
+       "bucketWidth": 2, "max": 10},
+      {"name": "cls", "ordinal": 1, "dataType": "categorical"}]}
+    """)
+    ds = DatasetEncoder(s).encode([["-5", "a"], ["5", "a"], ["-1", "b"]])
+    assert int(ds.bin_offset[0]) == -2
+    # raw bins: -2, 2, 0 -> shifted: 0, 4, 2
+    assert ds.x[:, 0].tolist() == [0, 4, 2]
+    assert [ds.bin_label(0, b) for b in ds.x[:, 0]] == ["-2", "2", "0"]
+
+
+def test_confusion_matrix_and_arbitrator():
+    cm = ConfusionMatrix("N", "Y")
+    for pred, act in [("Y", "Y"), ("Y", "N"), ("N", "N"), ("N", "Y"), ("Y", "Y")]:
+        cm.report(pred, act)
+    assert (cm.true_pos, cm.false_pos, cm.true_neg, cm.false_neg) == (2, 1, 1, 1)
+    assert cm.accuracy() == 60 and cm.recall() == 66 and cm.precision() == 66
+
+    arb = CostBasedArbitrator("N", "Y", false_neg_cost=4, false_pos_cost=1)
+    # costly false negatives bias toward the positive class
+    assert arb.arbitrate(40, 60) == "Y"
+    assert arb.classify(25) == "Y" and arb.classify(15) == "N"
+    arb2 = CostBasedArbitrator("N", "Y", false_neg_cost=1, false_pos_cost=4)
+    # costly false positives bias toward the negative class
+    assert arb2.arbitrate(40, 60) == "N"
+
+
+def test_datagen_planted_signal():
+    rows = gen_telecom_churn(2000, seed=7)
+    assert len(rows) == 2000
+    churn = [r for r in rows if r[7] == "Y"]
+    keep = [r for r in rows if r[7] == "N"]
+    assert 0.12 < len(churn) / 2000 < 0.30
+    # planted signal: churners use far more minutes on average
+    mu_churn = np.mean([int(r[2]) for r in churn])
+    mu_keep = np.mean([int(r[2]) for r in keep])
+    assert mu_churn > mu_keep + 200
+    # determinism
+    assert gen_telecom_churn(50, seed=3) == gen_telecom_churn(50, seed=3)
