@@ -1,0 +1,86 @@
+"""CLI job driver: the reference's user surface, JVM-free.
+
+The reference runs every job as
+``hadoop jar avenir-1.0.jar org.avenir.<pkg>.<Class> -Dconf.path=<props> <in> <out>``
+(resource/knn.sh:70-80 and every other runbook).  Here the same invocation is
+``python -m avenir_tpu <Class|FQCN> -Dconf.path=<props> <in> <out>`` — same
+properties files, same schema JSONs, same in/out directory conventions, with
+job counters printed to stderr the way the MR framework printed counter
+groups.
+
+The registry maps reference driver class names (short or fully-qualified) to
+job factories; jobs expose ``run(in_path, out_path) -> Counters``.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Callable, Dict
+
+from .core.config import JobConfig, load_job_config, parse_cli_args
+from .core.metrics import Counters
+
+
+def _lazy(modname: str, clsname: str) -> Callable[[JobConfig], object]:
+    def factory(config: JobConfig):
+        import importlib
+        mod = importlib.import_module(f"avenir_tpu.models.{modname}")
+        return getattr(mod, clsname)(config)
+    return factory
+
+
+# reference driver class -> (module, job class, config key prefix)
+# Prefixes follow the reference's per-job property namespaces (SURVEY §5:
+# dtb.*, fia.*, arm.*, mst.* ... with un-prefixed fallback).
+JOBS: Dict[str, tuple] = {
+    "org.avenir.bayesian.BayesianDistribution": ("bayesian", "BayesianDistribution", ""),
+    "org.avenir.bayesian.BayesianPredictor": ("bayesian", "BayesianPredictor", "bp"),
+}
+
+
+def resolve(name: str):
+    if name in JOBS:
+        return JOBS[name]
+    # short-name lookup
+    for fq, spec in JOBS.items():
+        if fq.rsplit(".", 1)[1] == name:
+            return spec
+    raise SystemExit(
+        f"unknown job: {name}\nknown jobs:\n  " +
+        "\n  ".join(sorted(JOBS)))
+
+
+def register(fqcn: str, module: str, cls: str, prefix: str = "") -> None:
+    JOBS[fqcn] = (module, cls, prefix)
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv:
+        print("usage: python -m avenir_tpu <JobClass> -Dconf.path=<props> <in> <out>",
+              file=sys.stderr)
+        print("known jobs:\n  " + "\n  ".join(sorted(JOBS)), file=sys.stderr)
+        return 2
+
+    job_name, rest = argv[0], argv[1:]
+    modname, clsname, prefix = resolve(job_name)
+    defines, positional = parse_cli_args(rest)
+    if len(positional) < 2:
+        print("expected <input path> <output path>", file=sys.stderr)
+        return 2
+
+    import avenir_tpu
+    avenir_tpu.enable_x64()
+
+    config = load_job_config(defines, prefix)
+    job = _lazy(modname, clsname)(config)
+    result = job.run(positional[0], positional[1])
+
+    if isinstance(result, Counters):
+        print(result.format(), file=sys.stderr)
+        return 0
+    return int(result or 0)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

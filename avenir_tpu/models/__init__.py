@@ -1,0 +1,7 @@
+"""Algorithm layer: each model family is a thin parameterization of the
+``ops`` counting/distance/scan engines plus reference-format text I/O.
+
+Job classes expose ``run(config, in_path, out_path) -> Counters`` and are
+registered in ``avenir_tpu.cli`` under the reference's driver class names so
+existing pipeline scripts translate 1:1.
+"""

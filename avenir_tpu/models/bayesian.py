@@ -1,0 +1,414 @@
+"""Naive Bayes: distribution trainer + predictor (TPU-native).
+
+Reference surface being re-expressed (citations into /root/reference):
+- trainer ``org.avenir.bayesian.BayesianDistribution`` — mapper bins features
+  and emits (class, ord, bin)->1 or (class, ord)->(1, v, v^2)
+  (BayesianDistribution.java:137-179); reducer sums and writes the model as
+  delimited text with empty-column type tags (:264-328) plus Gaussian feature
+  priors in cleanup (:241-259).
+- predictor ``org.avenir.bayesian.BayesianPredictor`` — map-only; loads the
+  model text (BayesianPredictor.java:186-224), computes per-class
+  ``P(C|x) ∝ P(x|C)P(C)/P(x)`` scaled to int percent (:396-421), arbitrates
+  max-prob / cost-based (:342-391), emits prediction + confusion counters.
+
+TPU re-design: binning happens once in ingest (core.binning); the whole
+mapper+shuffle+reducer collapses into one ``feature_class_counts`` /
+``moment_table`` scatter under ``shard_map`` with ``psum`` over the data axis
+(ops.counting); prediction is a vectorized gather + log-free product over
+per-class probability tables, jitted over the row-sharded batch.  The model
+TEXT FORMAT is preserved verbatim so reference model files and consumers
+(e.g. the kNN pipeline's FeatureCondProbJoiner) interoperate.
+
+Normalization parity note: the reference emits one class-prior line per
+reduce key and the loader SUMS them (BayesianModel.addClassPrior), making the
+stored class count = N_c x F (records of class c times feature fields); every
+per-feature normalizer carries the same F factor, which cancels in the final
+posterior/prior ratio.  We reproduce that accumulation exactly so the
+"output.feature.prob.only" numbers match the reference's, not just the final
+predictions.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.binning import DatasetEncoder, EncodedDataset
+from ..core.config import JobConfig
+from ..core.io import read_lines, split_line, write_output
+from ..core.metrics import ConfusionMatrix, CostBasedArbitrator, Counters
+from ..core.schema import FeatureSchema
+from ..ops.counting import feature_class_counts, moment_table, sharded_reduce
+
+
+def _jdiv(a: int, b: int) -> int:
+    """Java long division: truncates toward zero (floor division does not,
+    for negative operands — BayesianDistribution.java:249 does ``valSum / count``
+    on longs)."""
+    q = abs(a) // abs(b)
+    return -q if (a < 0) != (b < 0) else q
+
+
+def _jstd(vsq: int, cnt: int, mean: int) -> int:
+    """Reference stddev: ``(long)Math.sqrt((valSqSum - count*mean*mean)/(count-1))``
+    (BayesianDistribution.java:250-251); Java's sqrt(negative) is NaN and
+    ``(long)NaN == 0``."""
+    if cnt <= 1:
+        return 0
+    t = (vsq - cnt * mean * mean) / (cnt - 1)
+    return int(math.sqrt(t)) if t > 0 else 0
+
+
+# Module-level local_fn so sharded_reduce's compiled-function cache hits on
+# repeated training runs (a per-call closure would key a fresh cache entry
+# every time).  Static shape params ride static_args.
+def _nb_local(x, y, values, mask, n_class, max_bins, cont_cols):
+    out = {"counts": feature_class_counts(x, y, n_class, max_bins, mask=mask)}
+    if cont_cols:
+        n_r = x.shape[0]
+        k = len(cont_cols)
+        col_ids = jnp.asarray(cont_cols, dtype=jnp.int32)
+        ycol = jnp.broadcast_to(y[:, None], (n_r, k))
+        ccol = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32)[None, :], (n_r, k))
+        m2 = jnp.broadcast_to(mask[:, None], (n_r, k))
+        out["mom"] = moment_table((n_class, k), (ycol, ccol),
+                                  values[:, col_ids], mask=m2)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# trainer
+# ---------------------------------------------------------------------------
+
+class BayesianDistribution:
+    """The Naive Bayes distribution trainer job."""
+
+    def __init__(self, config: JobConfig, schema: Optional[FeatureSchema] = None):
+        self.config = config
+        self.schema = schema or FeatureSchema.from_file(
+            config.must("feature.schema.file.path"))
+
+    def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
+        counters = Counters()
+        delim_in = self.config.field_delim_regex()
+        delim = self.config.field_delim_out()
+
+        enc = DatasetEncoder(self.schema)
+        ds = enc.encode_path(in_path, delim_in)
+        lines = self.train_lines(ds, delim, counters, mesh=mesh)
+        write_output(out_path, lines)
+        return counters
+
+    def train_lines(self, ds: EncodedDataset, delim: str,
+                    counters: Counters, mesh=None) -> List[str]:
+        """Compute all distributions on device; emit reference-format lines."""
+        n_class = len(ds.class_vocab)
+        F = ds.n_features
+        max_bins = max([b for b in ds.num_bins] + [1])
+        cont_cols = [j for j in range(F) if not ds.binned_mask[j]]
+
+        res = sharded_reduce(_nb_local, ds.x, ds.y, ds.values, mesh=mesh,
+                             static_args=(n_class, max_bins, tuple(cont_cols)))
+        counts = np.asarray(res["counts"])          # [n_class, F, max_bins]
+
+        lines: List[str] = []
+        # feature-prior continuous accumulators: ord -> [count, sum, sumsq]
+        prior_mom: Dict[int, List[float]] = defaultdict(lambda: [0, 0.0, 0.0])
+
+        # reducer key order: Tuple sorts by its string form; we emit grouped
+        # by (class, ordinal, bin) in encoding order, which downstream
+        # loaders are insensitive to (they dispatch on the empty-column tags)
+        for c in range(n_class):
+            class_val = ds.class_vocab.values[c]
+            for j in range(F):
+                f = ds.feature_fields[j]
+                ordinal = f.ordinal
+                if ds.binned_mask[j]:
+                    for b in range(ds.num_bins[j]):
+                        cnt = int(counts[c, j, b])
+                        if cnt == 0:
+                            continue  # reference only ever sees observed keys
+                        bin_label = ds.bin_label(j, b)
+                        counters.incr("Distribution Data", "Feature posterior binned ")
+                        lines.append(f"{class_val}{delim}{ordinal}{delim}{bin_label}{delim}{cnt}")
+                        counters.incr("Distribution Data", "Class prior")
+                        lines.append(f"{class_val}{delim}{delim}{delim}{cnt}")
+                        counters.incr("Distribution Data", "Feature prior binned ")
+                        lines.append(f"{delim}{ordinal}{delim}{bin_label}{delim}{cnt}")
+                else:
+                    k = cont_cols.index(j)
+                    cnt = int(np.asarray(res["mom"][0])[c, k])
+                    if cnt == 0:
+                        continue
+                    vsum = int(np.asarray(res["mom"][1])[c, k])
+                    vsq = int(np.asarray(res["mom"][2])[c, k])
+                    mean = _jdiv(vsum, cnt)
+                    std = _jstd(vsq, cnt, mean)
+                    counters.incr("Distribution Data", "Feature posterior cont ")
+                    lines.append(f"{class_val}{delim}{ordinal}{delim}{delim}{mean}{delim}{std}")
+                    counters.incr("Distribution Data", "Class prior")
+                    lines.append(f"{class_val}{delim}{delim}{delim}{cnt}")
+                    pm = prior_mom[ordinal]
+                    pm[0] += cnt
+                    pm[1] += vsum
+                    pm[2] += vsq
+
+        # reducer cleanup: Gaussian feature priors across classes
+        for ordinal, (cnt, vsum, vsq) in sorted(prior_mom.items()):
+            counters.incr("Distribution Data", "Feature prior cont ")
+            mean = _jdiv(int(vsum), int(cnt))
+            std = _jstd(int(vsq), int(cnt), mean)
+            lines.append(f"{delim}{ordinal}{delim}{delim}{mean}{delim}{std}")
+        return lines
+
+
+# ---------------------------------------------------------------------------
+# model (text-format compatible with the reference loader)
+# ---------------------------------------------------------------------------
+
+class _FeatureDistr:
+    """Per-(scope, ordinal) distribution: bin counts or Gaussian params —
+    the chombo FeatureCount equivalent."""
+
+    __slots__ = ("bins", "mean", "std", "total")
+
+    def __init__(self):
+        self.bins: Dict[str, int] = defaultdict(int)
+        self.mean: Optional[int] = None
+        self.std: Optional[int] = None
+        self.total = 0
+
+    def prob(self, bin_or_val) -> float:
+        if self.mean is not None:
+            x = float(bin_or_val)
+            sd = max(float(self.std), 1e-9)
+            z = (x - self.mean) / sd
+            return math.exp(-0.5 * z * z) / (sd * math.sqrt(2.0 * math.pi))
+        if self.total <= 0:
+            return 0.0
+        return self.bins.get(str(bin_or_val), 0) / self.total
+
+
+class NaiveBayesModel:
+    """In-memory model; parses/serializes the reference text format
+    (dispatch on empty-column tags per BayesianPredictor.java:193-218)."""
+
+    def __init__(self):
+        self.post: Dict[Tuple[str, int], _FeatureDistr] = defaultdict(_FeatureDistr)
+        self.prior: Dict[int, _FeatureDistr] = defaultdict(_FeatureDistr)
+        self.class_count: Dict[str, int] = defaultdict(int)
+        self.class_prob: Dict[str, float] = {}
+        self.total = 0
+
+    @classmethod
+    def load(cls, path: str, delim_regex: str = ",") -> "NaiveBayesModel":
+        m = cls()
+        for line in read_lines(path):
+            items = split_line(line, delim_regex)
+            ordinal = int(items[1]) if items[1] != "" else -1
+            if items[0] == "":
+                if items[2] != "":
+                    m.prior[ordinal].bins[items[2]] += int(items[3])
+                else:
+                    m.prior[ordinal].mean = int(items[3])
+                    m.prior[ordinal].std = int(items[4])
+            elif items[1] == "" and items[2] == "":
+                m.class_count[items[0]] += int(items[3])
+            else:
+                if items[2] != "":
+                    m.post[(items[0], ordinal)].bins[items[2]] += int(items[3])
+                else:
+                    m.post[(items[0], ordinal)].mean = int(items[3])
+                    m.post[(items[0], ordinal)].std = int(items[4])
+        m.finish_up()
+        return m
+
+    def finish_up(self) -> None:
+        """Reference BayesianModel.finishUp: class probs normalized by the
+        summed class counts; per-feature tables by their scope's count."""
+        self.total = sum(self.class_count.values())
+        for cv, cnt in self.class_count.items():
+            self.class_prob[cv] = cnt / self.total if self.total else 0.0
+        for (cv, _), d in self.post.items():
+            d.total = self.class_count[cv]
+        for d in self.prior.values():
+            d.total = self.total
+
+    # -- scalar reference semantics (oracle + small-batch path) ----------
+    def class_prior_prob(self, class_val: str) -> float:
+        return self.class_prob.get(class_val, 0.0)
+
+    def feature_prior_prob(self, feature_values) -> float:
+        p = 1.0
+        for ordinal, v in feature_values:
+            p *= self.prior[ordinal].prob(v)
+        return p
+
+    def feature_post_prob(self, class_val: str, feature_values) -> float:
+        p = 1.0
+        for ordinal, v in feature_values:
+            p *= self.post[(class_val, ordinal)].prob(v)
+        return p
+
+
+# ---------------------------------------------------------------------------
+# predictor
+# ---------------------------------------------------------------------------
+
+class BayesianPredictor:
+    """Map-only scoring job; vectorized over the row batch on device."""
+
+    def __init__(self, config: JobConfig, schema: Optional[FeatureSchema] = None,
+                 model: Optional[NaiveBayesModel] = None):
+        self.config = config
+        self.schema = schema or FeatureSchema.from_file(
+            config.must("feature.schema.file.path"))
+        self.model = model or NaiveBayesModel.load(
+            config.must("bayesian.model.file.path"),
+            config.field_delim_regex())
+
+        delim = self.config.field_delim_out()
+        cls_field = self.schema.class_attr_field()
+        pc = self.config.get("bp.predict.class")
+        if pc is not None:
+            self.predicting_classes = pc.split(delim)
+        else:
+            card = cls_field.cardinality
+            self.predicting_classes = [card[0], card[1]]
+
+        costs = self.config.get("bp.predict.class.cost")
+        self.arbitrator = None
+        if costs is not None:
+            c = costs.split(delim)
+            self.arbitrator = CostBasedArbitrator(
+                self.predicting_classes[0], self.predicting_classes[1],
+                int(c[0]), int(c[1]))
+        self.class_prob_diff_threshold = self.config.get_int(
+            "class.prob.diff.threshold", -1)
+        self.output_feature_prob_only = self.config.get_boolean(
+            "output.feature.prob.only", False)
+
+    # -- vectorized scoring ------------------------------------------------
+    def _build_tables(self, ds: EncodedDataset):
+        """Per-class probability lookup tables aligned to the predict-time
+        encoding (host-built gather tables; the device replaces the
+        reference's per-record hash lookups)."""
+        F = ds.n_features
+        max_bins = max([b for b in ds.num_bins] + [1])
+        C = len(self.predicting_classes)
+        post = np.zeros((C, F, max_bins))
+        prior = np.zeros((F, max_bins))
+        gauss_post = np.zeros((C, F, 2))   # mean, std
+        gauss_prior = np.zeros((F, 2))
+        is_cont = ~ds.binned_mask
+        for j, f in enumerate(ds.feature_fields):
+            if ds.binned_mask[j]:
+                for b in range(ds.num_bins[j]):
+                    label = ds.bin_label(j, b)
+                    prior[j, b] = self.model.prior[f.ordinal].prob(label)
+                    for ci, cv in enumerate(self.predicting_classes):
+                        post[ci, j, b] = self.model.post[(cv, f.ordinal)].prob(label)
+            else:
+                d = self.model.prior[f.ordinal]
+                gauss_prior[j] = (d.mean or 0, d.std or 0)
+                for ci, cv in enumerate(self.predicting_classes):
+                    dp = self.model.post[(cv, f.ordinal)]
+                    gauss_post[ci, j] = (dp.mean or 0, dp.std or 0)
+        class_prior = np.asarray(
+            [self.model.class_prior_prob(cv) for cv in self.predicting_classes])
+        return post, prior, gauss_post, gauss_prior, class_prior, is_cont
+
+    @staticmethod
+    def _score_batch(x, values, post, prior, gauss_post, gauss_prior,
+                     class_prior, is_cont):
+        """classPostProb[n, C] = int(featPost*classPrior/featPrior * 100)
+        (BayesianPredictor.java:416), fully vectorized."""
+        n, F = x.shape
+        cols = jnp.arange(F)
+        xc = jnp.clip(x, 0, post.shape[2] - 1)
+
+        def gauss(v, params):
+            mean = params[..., 0]
+            std = jnp.maximum(params[..., 1], 1e-9)
+            z = (v - mean) / std
+            return jnp.exp(-0.5 * z * z) / (std * jnp.sqrt(2.0 * jnp.pi))
+
+        # binned factors (cont columns contribute 1.0)
+        prior_f = jnp.where(is_cont[None, :], gauss(values, gauss_prior[None, :, :]),
+                            prior[cols[None, :], xc])
+        feat_prior = jnp.prod(prior_f, axis=1)                       # [n]
+
+        post_f = jnp.where(
+            is_cont[None, None, :],
+            gauss(values[:, None, :], gauss_post[None, :, :, :]),
+            jnp.take_along_axis(
+                jnp.broadcast_to(post[None], (n,) + post.shape),
+                xc[:, None, :, None], axis=3)[..., 0])
+        feat_post = jnp.prod(post_f, axis=2)                          # [n, C]
+
+        ratio = feat_post * class_prior[None, :] / jnp.maximum(feat_prior[:, None], 1e-300)
+        return (ratio * 100).astype(jnp.int32), feat_prior, feat_post
+
+    def run(self, in_path: str, out_path: str) -> Counters:
+        counters = Counters()
+        delim_regex = self.config.field_delim_regex()
+        delim = self.config.field_delim_out()
+        schema = self.schema
+
+        enc = DatasetEncoder(schema)
+        raw_lines = list(read_lines(in_path))
+        records = [split_line(l, delim_regex) for l in raw_lines]
+        ds = enc.encode(records)
+
+        tables = self._build_tables(ds)
+        probs, feat_prior, feat_post = jax.jit(self._score_batch)(
+            jnp.asarray(ds.x), jnp.asarray(ds.values),
+            *[jnp.asarray(t) for t in tables])
+        probs = np.asarray(probs)
+        feat_prior = np.asarray(feat_prior)
+        feat_post = np.asarray(feat_post)
+
+        cls_field = schema.class_attr_field()
+        conf = ConfusionMatrix(self.predicting_classes[0], self.predicting_classes[1])
+        out: List[str] = []
+        for i, line in enumerate(raw_lines):
+            actual = records[i][cls_field.ordinal]
+            if self.output_feature_prob_only:
+                parts = [records[i][0], str(feat_prior[i])]
+                for ci, cv in enumerate(self.predicting_classes):
+                    parts += [cv, str(feat_post[i, ci])]
+                parts.append(actual)
+                out.append(delim.join(parts))
+                continue
+
+            row = probs[i]
+            if self.arbitrator is not None:
+                pos = int(row[1]); neg = int(row[0])
+                pred = self.arbitrator.arbitrate(pos, neg)
+                prob = 100
+                suffix = ""
+            else:
+                order = np.argsort(-row, kind="stable")
+                pred = self.predicting_classes[int(order[0])]
+                prob = int(row[order[0]])
+                suffix = ""
+                if self.class_prob_diff_threshold > 0:
+                    diff = int(row[order[0]] - row[order[1]]) if len(row) > 1 else 100
+                    suffix = delim + ("classified" if diff > self.class_prob_diff_threshold
+                                      else "ambiguous")
+            conf.report(pred, actual)
+            if pred == actual:
+                counters.incr("Validation", "Correct")
+            else:
+                counters.incr("Validation", "Incorrect")
+            out.append(f"{line}{delim}{pred}{delim}{prob}{suffix}")
+
+        if not self.output_feature_prob_only:
+            conf.to_counters(counters)
+        write_output(out_path, out)
+        return counters

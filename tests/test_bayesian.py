@@ -1,0 +1,167 @@
+"""Naive Bayes end-to-end: trainer text format, model load, prediction
+accuracy on the planted-signal churn fixture, 1-dev == 8-dev parity."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core import DatasetEncoder, FeatureSchema, JobConfig, write_output
+from avenir_tpu.datagen import gen_telecom_churn
+from avenir_tpu.models.bayesian import (BayesianDistribution, BayesianPredictor,
+                                        NaiveBayesModel)
+
+SCHEMA = {
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "plan", "ordinal": 1, "dataType": "categorical", "feature": True},
+        {"name": "minUsed", "ordinal": 2, "dataType": "int", "feature": True,
+         "min": 0, "max": 2200, "bucketWidth": 200},
+        {"name": "dataUsed", "ordinal": 3, "dataType": "int", "feature": True,
+         "min": 0, "max": 1000, "bucketWidth": 100},
+        {"name": "csCall", "ordinal": 4, "dataType": "int", "feature": True,
+         "min": 0, "max": 14, "bucketWidth": 2},
+        {"name": "csEmail", "ordinal": 5, "dataType": "int", "feature": True,
+         "min": 0, "max": 22, "bucketWidth": 4},
+        {"name": "network", "ordinal": 6, "dataType": "int", "feature": True},
+        {"name": "churned", "ordinal": 7, "dataType": "categorical",
+         "cardinality": ["N", "Y"]},
+    ]
+}
+
+
+@pytest.fixture(scope="module")
+def churn_setup(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("nb")
+    schema_path = str(tmp / "schema.json")
+    with open(schema_path, "w") as f:
+        json.dump(SCHEMA, f)
+    rows = gen_telecom_churn(4000, seed=13)
+    train, test = rows[:3000], rows[3000:]
+    train_path = str(tmp / "train")
+    test_path = str(tmp / "test")
+    write_output(train_path, [",".join(r) for r in train])
+    write_output(test_path, [",".join(r) for r in test])
+    cfg = JobConfig({"feature.schema.file.path": schema_path})
+    return tmp, cfg, train_path, test_path, train
+
+
+def test_train_model_format(churn_setup, mesh8):
+    tmp, cfg, train_path, _, train_rows = churn_setup
+    model_out = str(tmp / "model")
+    job = BayesianDistribution(cfg)
+    job.run(train_path, model_out, mesh=mesh8)
+
+    lines = open(os.path.join(model_out, "part-r-00000")).read().splitlines()
+    # line-type census by empty-column tags (the reference's dispatch)
+    post_binned = [l for l in lines if l.split(",")[0] and l.split(",")[1] and l.split(",")[2]]
+    class_prior = [l for l in lines if l.split(",")[0] and not l.split(",")[1] and not l.split(",")[2]]
+    feat_prior_binned = [l for l in lines if not l.split(",")[0] and l.split(",")[2]]
+    cont_post = [l for l in lines
+                 if l.split(",")[0] and l.split(",")[1] and not l.split(",")[2]]
+    cont_prior = [l for l in lines if not l.split(",")[0] and not l.split(",")[2]]
+    assert post_binned and class_prior and feat_prior_binned
+    assert cont_post and cont_prior          # 'network' has no bucketWidth
+
+    # class-prior lines sum to N_c * F per class (reference accumulation)
+    model = NaiveBayesModel.load(model_out)
+    n_y = sum(1 for r in train_rows if r[7] == "Y")
+    n_n = len(train_rows) - n_y
+    F = 6
+    assert model.class_count["Y"] == n_y * F
+    assert model.class_count["N"] == n_n * F
+    # class priors normalize correctly despite the F factor
+    assert abs(model.class_prior_prob("Y") - n_y / len(train_rows)) < 1e-12
+
+    # binned posterior counts equal a direct python count
+    s = FeatureSchema.from_json(json.dumps(SCHEMA))
+    want = sum(1 for r in train_rows if r[7] == "Y" and r[1] == "planA")
+    assert model.post[("Y", 1)].bins.get("planA", 0) == want
+
+
+def test_predictor_accuracy_and_output(churn_setup, mesh8):
+    tmp, cfg, train_path, test_path, _ = churn_setup
+    model_out = str(tmp / "model2")
+    BayesianDistribution(cfg).run(train_path, model_out, mesh=mesh8)
+
+    cfg2 = JobConfig(dict(cfg.props))
+    cfg2.set("bayesian.model.file.path", model_out)
+    pred_out = str(tmp / "pred")
+    counters = BayesianPredictor(cfg2).run(test_path, pred_out)
+
+    v = counters.as_dict()["Validation"]
+    total = v["Correct"] + v["Incorrect"]
+    assert total == 1000
+    # planted signal is strong; NB should be well above the 80% base rate
+    assert v["Correct"] / total > 0.85
+    assert v["Accuracy"] == (100 * (v["TruePositive"] + v["TrueNagative"])) // total
+
+    # output format: input line + pred class + int prob
+    line0 = open(os.path.join(pred_out, "part-r-00000")).readline().strip()
+    parts = line0.split(",")
+    assert parts[-2] in ("Y", "N") and parts[-1].lstrip("-").isdigit()
+
+
+def test_predictor_matches_scalar_oracle(churn_setup, mesh8):
+    """Vectorized device scoring == reference scalar math on every record."""
+    tmp, cfg, train_path, test_path, _ = churn_setup
+    model_out = str(tmp / "model3")
+    BayesianDistribution(cfg).run(train_path, model_out, mesh=mesh8)
+    model = NaiveBayesModel.load(model_out)
+
+    schema = FeatureSchema.from_json(json.dumps(SCHEMA))
+    enc = DatasetEncoder(schema)
+    from avenir_tpu.core.io import read_records
+    records = list(read_records(test_path))
+    ds = enc.encode(records)
+
+    pred = BayesianPredictor(JobConfig({
+        "feature.schema.file.path": str(tmp / "schema.json"),
+        "bayesian.model.file.path": model_out}))
+    tables = pred._build_tables(ds)
+    import jax.numpy as jnp
+    probs, _, _ = pred._score_batch(jnp.asarray(ds.x), jnp.asarray(ds.values),
+                                    *[jnp.asarray(t) for t in tables])
+    probs = np.asarray(probs)
+
+    for i in np.random.default_rng(0).choice(len(records), 50, replace=False):
+        fvals = []
+        for j, f in enumerate(ds.feature_fields):
+            if ds.binned_mask[j]:
+                fvals.append((f.ordinal, ds.bin_label(j, int(ds.x[i, j]))))
+            else:
+                fvals.append((f.ordinal, ds.values[i, j]))
+        prior = model.feature_prior_prob(fvals)
+        for ci, cv in enumerate(["N", "Y"]):
+            want = int((model.feature_post_prob(cv, fvals)
+                        * model.class_prior_prob(cv) / prior) * 100)
+            assert abs(int(probs[i, ci]) - want) <= 1, (i, cv)
+
+
+def test_negative_continuous_values_java_division(tmp_path, mesh8):
+    """Java long division truncates toward zero: mean([-1,-2]) == -1, and the
+    variance stays non-negative (no sqrt domain error)."""
+    import json as _json
+    from avenir_tpu.core import write_output as _wo
+    sp = str(tmp_path / "s.json")
+    with open(sp, "w") as f:
+        _json.dump({"fields": [
+            {"name": "v", "ordinal": 0, "dataType": "int", "feature": True},
+            {"name": "c", "ordinal": 1, "dataType": "categorical"}]}, f)
+    _wo(str(tmp_path / "in"), ["-1,a", "-2,a", "-3,b", "5,b"])
+    BayesianDistribution(JobConfig({"feature.schema.file.path": sp})).run(
+        str(tmp_path / "in"), str(tmp_path / "out"), mesh=mesh8)
+    lines = open(str(tmp_path / "out" / "part-r-00000")).read().splitlines()
+    assert "a,0,,-1,1" in lines     # floor division would give mean -2
+    assert "b,0,,1,5" in lines
+
+
+def test_train_1dev_equals_8dev(churn_setup, mesh8, mesh1):
+    tmp, cfg, train_path, _, _ = churn_setup
+    out1, out8 = str(tmp / "m1"), str(tmp / "m8")
+    BayesianDistribution(cfg).run(train_path, out1, mesh=mesh1)
+    BayesianDistribution(cfg).run(train_path, out8, mesh=mesh8)
+    l1 = open(os.path.join(out1, "part-r-00000")).read()
+    l8 = open(os.path.join(out8, "part-r-00000")).read()
+    assert l1 == l8
