@@ -43,7 +43,7 @@ from ..core.config import JobConfig
 from ..core.io import read_lines, split_line, write_output
 from ..core.metrics import ConfusionMatrix, CostBasedArbitrator, Counters
 from ..core.schema import FeatureSchema
-from ..ops.counting import feature_class_counts, moment_table, sharded_reduce
+from ..ops.counting import feature_class_counts, sharded_reduce
 
 
 def _jdiv(a: int, b: int) -> int:
@@ -67,17 +67,27 @@ def _jstd(vsq: int, cnt: int, mean: int) -> int:
 # Module-level local_fn so sharded_reduce's compiled-function cache hits on
 # repeated training runs (a per-call closure would key a fresh cache entry
 # every time).  Static shape params ride static_args.
-def _nb_local(x, y, values, mask, n_class, max_bins, cont_cols):
-    out = {"counts": feature_class_counts(x, y, n_class, max_bins, mask=mask)}
-    if cont_cols:
-        n_r = x.shape[0]
-        k = len(cont_cols)
-        col_ids = jnp.asarray(cont_cols, dtype=jnp.int32)
-        ycol = jnp.broadcast_to(y[:, None], (n_r, k))
-        ccol = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32)[None, :], (n_r, k))
-        m2 = jnp.broadcast_to(mask[:, None], (n_r, k))
-        out["mom"] = moment_table((n_class, k), (ycol, ccol),
-                                  values[:, col_ids], mask=m2)
+#
+# Moments for unbinned numerics are deliberately NOT computed here: exact
+# (count, sum, sum-of-squares) needs 64-bit arithmetic, which TPUs emulate at
+# ~6x the cost of the entire counting pass; the moments are C x F_cont
+# scalars, so each host computes them exactly in NumPy over its shard (and
+# they would psum trivially across hosts).  The device does what it is good
+# at -- the massively parallel binned counting.
+def _nb_local(x, y, mask, n_class, max_bins):
+    return feature_class_counts(x, y, n_class, max_bins, mask=mask)
+
+
+def _host_moments(values: np.ndarray, y: np.ndarray, n_class: int,
+                  cont_cols) -> Dict[int, np.ndarray]:
+    """Exact per-class (count, sum, sumsq) for each unbinned column."""
+    out = {}
+    cnt = np.bincount(y, minlength=n_class)
+    for j in cont_cols:
+        v = values[:, j]
+        s = np.bincount(y, weights=v, minlength=n_class)
+        s2 = np.bincount(y, weights=v * v, minlength=n_class)
+        out[j] = np.stack([cnt, s, s2])
     return out
 
 
@@ -112,9 +122,10 @@ class BayesianDistribution:
         max_bins = max([b for b in ds.num_bins] + [1])
         cont_cols = [j for j in range(F) if not ds.binned_mask[j]]
 
-        res = sharded_reduce(_nb_local, ds.x, ds.y, ds.values, mesh=mesh,
-                             static_args=(n_class, max_bins, tuple(cont_cols)))
-        counts = np.asarray(res["counts"])          # [n_class, F, max_bins]
+        counts = np.asarray(sharded_reduce(
+            _nb_local, ds.x, ds.y, mesh=mesh,
+            static_args=(n_class, max_bins)))       # [n_class, F, max_bins]
+        moments = _host_moments(ds.values, ds.y, n_class, cont_cols)
 
         lines: List[str] = []
         # feature-prior continuous accumulators: ord -> [count, sum, sumsq]
@@ -141,12 +152,12 @@ class BayesianDistribution:
                         counters.incr("Distribution Data", "Feature prior binned ")
                         lines.append(f"{delim}{ordinal}{delim}{bin_label}{delim}{cnt}")
                 else:
-                    k = cont_cols.index(j)
-                    cnt = int(np.asarray(res["mom"][0])[c, k])
+                    mom = moments[j]
+                    cnt = int(mom[0, c])
                     if cnt == 0:
                         continue
-                    vsum = int(np.asarray(res["mom"][1])[c, k])
-                    vsq = int(np.asarray(res["mom"][2])[c, k])
+                    vsum = int(mom[1, c])
+                    vsq = int(mom[2, c])
                     mean = _jdiv(vsum, cnt)
                     std = _jstd(vsq, cnt, mean)
                     counters.incr("Distribution Data", "Feature posterior cont ")

@@ -1,0 +1,117 @@
+"""Benchmark: telecom-churn Naive Bayes training throughput (rows/sec/chip).
+
+The north-star workload from BASELINE.json: the reference's
+BayesianDistribution on the telecom-churn schema.  The reference publishes no
+numbers (BASELINE.md), so the recorded baseline is a measured single-core
+NumPy implementation of the identical count/moment computation — a generous
+stand-in for Hadoop-local wall-clock (the JVM stack adds orders of magnitude
+of job/shuffle overhead on top of the raw counting).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def numpy_baseline(x, y, values, n_class, max_bins, cont_cols, reps=3):
+    """Single-core NumPy stand-in for the NB counting step (combiner+reducer);
+    moments use the same _host_moments the measured path uses."""
+    from avenir_tpu.models.bayesian import _host_moments
+    n, F = x.shape
+
+    def run():
+        C = np.zeros((n_class, F, max_bins), dtype=np.int32)
+        valid = x >= 0
+        flat = (y[:, None] * F + np.arange(F)[None, :]) * max_bins + np.where(valid, x, 0)
+        np.add.at(C.reshape(-1), flat[valid], 1)
+        return C, _host_moments(values, y, n_class, cont_cols)
+
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        run()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    import avenir_tpu
+    avenir_tpu.enable_x64()
+    import jax
+
+    from avenir_tpu.datagen import gen_telecom_churn
+    from avenir_tpu.core import DatasetEncoder, FeatureSchema
+    from avenir_tpu.models.bayesian import _host_moments, _nb_local
+    from avenir_tpu.ops.counting import sharded_reduce_resident
+    from avenir_tpu.parallel.mesh import make_mesh, shard_rows
+
+    n_rows = 2_000_000
+    # scaled-up tutorial workload: replicate generated churn rows to 2M
+    base = gen_telecom_churn(50_000, seed=1)
+    schema = FeatureSchema.from_json(json.dumps({"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "plan", "ordinal": 1, "dataType": "categorical", "feature": True},
+        {"name": "minUsed", "ordinal": 2, "dataType": "int", "feature": True,
+         "min": 0, "max": 2200, "bucketWidth": 200},
+        {"name": "dataUsed", "ordinal": 3, "dataType": "int", "feature": True,
+         "min": 0, "max": 1000, "bucketWidth": 100},
+        {"name": "csCall", "ordinal": 4, "dataType": "int", "feature": True,
+         "min": 0, "max": 14, "bucketWidth": 2},
+        {"name": "csEmail", "ordinal": 5, "dataType": "int", "feature": True,
+         "min": 0, "max": 22, "bucketWidth": 4},
+        {"name": "network", "ordinal": 6, "dataType": "int", "feature": True},
+        {"name": "churned", "ordinal": 7, "dataType": "categorical",
+         "cardinality": ["N", "Y"]}]}))
+    ds = DatasetEncoder(schema).encode(base)
+    reps_factor = n_rows // ds.n_rows
+    x = np.tile(ds.x, (reps_factor, 1))
+    y = np.tile(ds.y, reps_factor)
+    values = np.tile(ds.values, (reps_factor, 1))
+    n = x.shape[0]
+
+    n_class = len(ds.class_vocab)
+    max_bins = max(ds.num_bins)
+    cont_cols = tuple(j for j in range(ds.n_features) if not ds.binned_mask[j])
+    mesh = make_mesh()
+    n_chips = mesh.devices.size
+
+    static = (n_class, max_bins)
+    # steady-state residency: the binned matrix lives in HBM sharded over
+    # rows (SURVEY §7.1); ingest/transfer is a one-time cost, counted apart
+    xd = shard_rows(x, mesh)
+    yd = shard_rows(y, mesh)
+    md = shard_rows(np.ones(n, dtype=bool), mesh)
+
+    # warmup/compile
+    res = sharded_reduce_resident(_nb_local, xd, yd, mask=md, mesh=mesh,
+                                  static_args=static)
+    np.asarray(res)
+
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        res = sharded_reduce_resident(_nb_local, xd, yd, mask=md,
+                                      mesh=mesh, static_args=static)
+        moms = _host_moments(values, y, n_class, cont_cols)
+        # host materialization: block_until_ready does not reliably block on
+        # tunneled backends, so pull the (tiny) count table back to host
+        np.asarray(res)
+        best = min(best, time.perf_counter() - t0)
+
+    rows_per_sec_chip = n / best / n_chips
+    base_t = numpy_baseline(x, y, values, n_class, max_bins, cont_cols)
+    base_rows_per_sec = n / base_t
+
+    print(json.dumps({
+        "metric": "telecom_churn_nb_train_rows_per_sec_per_chip",
+        "value": round(rows_per_sec_chip),
+        "unit": "rows/sec/chip",
+        "vs_baseline": round(rows_per_sec_chip / base_rows_per_sec, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

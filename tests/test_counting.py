@@ -51,6 +51,21 @@ def test_feature_class_counts_oracle():
     np.testing.assert_array_equal(got, _oracle_counts(x, y, n_class, max_bins))
 
 
+def test_mxu_einsum_branch_matches_scatter():
+    """The TPU production counting branch (one-hot einsum), forced on CPU,
+    must match the scatter path bit-for-bit, including mask and -1 bins."""
+    rng = np.random.default_rng(3)
+    n, F, n_class, max_bins = 700, 5, 3, 9
+    x = rng.integers(-1, max_bins, (n, F)).astype(np.int32)
+    y = rng.integers(0, n_class, n).astype(np.int32)
+    mask = rng.random(n) < 0.8
+    a = np.asarray(feature_class_counts(x, y, n_class, max_bins, mask=mask,
+                                        force_mxu=True))
+    b = np.asarray(feature_class_counts(x, y, n_class, max_bins, mask=mask,
+                                        force_mxu=False))
+    np.testing.assert_array_equal(a, b)
+
+
 def test_moment_table_exact():
     vals = np.array([3.0, 5.0, 7.0, 1e7])
     idx = np.array([0, 0, 1, 1])
