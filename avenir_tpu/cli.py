@@ -35,6 +35,19 @@ def _lazy(modname: str, clsname: str) -> Callable[[JobConfig], object]:
 JOBS: Dict[str, tuple] = {
     "org.avenir.bayesian.BayesianDistribution": ("bayesian", "BayesianDistribution", ""),
     "org.avenir.bayesian.BayesianPredictor": ("bayesian", "BayesianPredictor", "bp"),
+    "org.avenir.markov.MarkovStateTransitionModel": ("markov", "MarkovStateTransitionModel", "mst"),
+    "org.avenir.markov.MarkovModelClassifier": ("markov", "MarkovModelClassifier", ""),
+    "org.avenir.markov.HiddenMarkovModelBuilder": ("markov", "HiddenMarkovModelBuilder", ""),
+    "org.avenir.markov.ViterbiStatePredictor": ("markov", "ViterbiStatePredictor", ""),
+    "org.avenir.markov.ProbabilisticSuffixTreeGenerator": ("pst", "ProbabilisticSuffixTreeGenerator", ""),
+    "org.avenir.explore.MutualInformation": ("mutual_info", "MutualInformation", ""),
+    "org.avenir.explore.CramerCorrelation": ("correlation", "CramerCorrelation", ""),
+    "org.avenir.explore.HeterogeneityReductionCorrelation": ("correlation", "HeterogeneityReductionCorrelation", ""),
+    "org.avenir.explore.NumericalCorrelation": ("correlation", "NumericalCorrelation", "nco"),
+    "org.avenir.explore.BaggingSampler": ("sampler", "BaggingSampler", ""),
+    "org.avenir.explore.UnderSamplingBalancer": ("sampler", "UnderSamplingBalancer", ""),
+    "org.avenir.discriminant.FisherDiscriminant": ("discriminant", "FisherDiscriminant", ""),
+    "org.chombo.mr.NumericalAttrStats": ("discriminant", "NumericalAttrStats", ""),
 }
 
 

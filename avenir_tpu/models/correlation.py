@@ -1,0 +1,227 @@
+"""Correlation jobs: Cramer index, heterogeneity reduction, numerical Pearson.
+
+Reference surface:
+- ``explore.CramerCorrelation`` / ``explore.CategoricalCorrelation`` —
+  contingency matrices per (source, dest) categorical attribute pair
+  (CramerCorrelation.java:105-182), reduced to the Cramer index
+  (util/ContingencyMatrix.java:86-123: pearson = sum t^2/(rowSum*colSum) - 1,
+  cramer = pearson/(minDim-1)); output ``srcName,dstName,value``.
+- ``explore.HeterogeneityReductionCorrelation`` — same matrices, reduced to
+  the concentration (gini) or uncertainty coefficient
+  (ContingencyMatrix.java:141-185), selected by ``heterogeneity.algorithm``.
+- ``explore.NumericalCorrelation`` — Pearson over configured ``attr.pairs``
+  using external mean/stddev (NumericalCorrelation.java:115-218); output
+  ``ord1,ord2,corr``.
+
+TPU re-design: all contingency matrices for all pairs come from one
+``count_table`` scatter over (pair, srcIdx, dstIdx); the coefficient math is
+tiny host NumPy mirroring the reference formulas (including its
+guard of clamping zero row/col sums to 1).  Numerical cross-moments are one
+masked einsum over the centered value matrix.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.config import JobConfig
+from ..core.io import read_lines, split_line, write_output
+from ..core.metrics import Counters
+from ..core.schema import FeatureSchema
+from ..ops.counting import count_table, sharded_reduce
+
+
+# ---------------------------------------------------------------------------
+# ContingencyMatrix math (util/ContingencyMatrix.java)
+# ---------------------------------------------------------------------------
+
+def cramer_index(table: np.ndarray) -> float:
+    t = np.asarray(table, dtype=np.float64)
+    row = t.sum(axis=1)
+    col = t.sum(axis=0)
+    row[row == 0] = 1
+    col[col == 0] = 1
+    pearson = float((t * t / (row[:, None] * col[None, :])).sum()) - 1.0
+    return pearson / (min(t.shape) - 1)
+
+
+def concentration_coeff(table: np.ndarray) -> float:
+    t = np.asarray(table, dtype=np.float64)
+    total = t.sum()
+    row = t.sum(axis=1); col = t.sum(axis=0)
+    row[row == 0] = 1; col[col == 0] = 1
+    rown = row / total; coln = col / total
+    e = t / total
+    sum_one = float(((e * e).sum(axis=1) / rown).sum())
+    sum_two = float((coln * coln).sum())
+    return (sum_one - sum_two) / (1.0 - sum_two)
+
+
+def uncertainty_coeff(table: np.ndarray) -> float:
+    t = np.asarray(table, dtype=np.float64)
+    total = t.sum()
+    row = t.sum(axis=1); col = t.sum(axis=0)
+    row[row == 0] = 1; col[col == 0] = 1
+    rown = row / total; coln = col / total
+    e = t / total
+    with np.errstate(divide="ignore", invalid="ignore"):
+        terms = e * np.log10(e * coln[None, :] / rown[:, None])
+    # reference computes log10(0) -> -Inf * 0 -> NaN propagates; zero cells
+    # simply never occur there because HashMap entries exist only when
+    # counted -- skip them here for the same effective sum
+    sum_one = float(np.nansum(np.where(e > 0, terms, 0.0)))
+    sum_two = float((coln * np.log10(coln)).sum())
+    return sum_one / sum_two
+
+
+def _cat_corr_local(src, dst, mask, sizes):
+    n, P = src.shape
+    p_idx = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32)[None, :], (n, P))
+    m = mask[:, None]
+    return count_table(sizes, (p_idx, src, dst), mask=m)
+
+
+class CategoricalCorrelation:
+    """Shared contingency-matrix job; subclasses choose the statistic."""
+
+    stat_name = "cramer"
+
+    def __init__(self, config: JobConfig, schema: Optional[FeatureSchema] = None):
+        self.config = config
+        self.schema = schema or FeatureSchema.from_file(
+            config.must("feature.schema.file.path"))
+
+    def statistic(self, table: np.ndarray) -> float:
+        return cramer_index(table)
+
+    def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
+        counters = Counters()
+        cfg = self.config
+        delim = cfg.field_delim_out()
+        src_attrs = [int(v) for v in cfg.must_list("source.attributes")]
+        dst_attrs = [int(v) for v in cfg.must_list("dest.attributes")]
+
+        pairs: List[Tuple[int, int]] = [
+            (s, d) for s in src_attrs for d in dst_attrs if s != d]
+        fields = {o: self.schema.field_by_ordinal(o)
+                  for o in set(src_attrs) | set(dst_attrs)}
+        card = {o: {v: i for i, v in enumerate(fields[o].cardinality)}
+                for o in fields}
+        max_card = max(len(c) for c in card.values())
+
+        records = [split_line(l, cfg.field_delim_regex())
+                   for l in read_lines(in_path)]
+        n = len(records)
+        src_idx = np.zeros((n, len(pairs)), dtype=np.int32)
+        dst_idx = np.zeros((n, len(pairs)), dtype=np.int32)
+        for i, r in enumerate(records):
+            for p, (s, d) in enumerate(pairs):
+                src_idx[i, p] = card[s][r[s]]
+                dst_idx[i, p] = card[d][r[d]]
+
+        sizes = (len(pairs), max_card, max_card)
+        counts = np.asarray(sharded_reduce(
+            _cat_corr_local, src_idx, dst_idx, mesh=mesh,
+            static_args=(sizes,)))
+
+        out = []
+        for p, (s, d) in enumerate(pairs):
+            tbl = counts[p, :len(card[s]), :len(card[d])]
+            out.append(f"{fields[s].name}{delim}{fields[d].name}{delim}"
+                       f"{self.statistic(tbl)}")
+        write_output(out_path, out)
+        counters.set("Correlation", "Pairs", len(pairs))
+        return counters
+
+
+class CramerCorrelation(CategoricalCorrelation):
+    pass
+
+
+class HeterogeneityReductionCorrelation(CategoricalCorrelation):
+    """gini -> concentration coefficient, else uncertainty coefficient
+    (HeterogeneityReductionCorrelation.java:71-90)."""
+
+    def statistic(self, table: np.ndarray) -> float:
+        alg = self.config.get("heterogeneity.algorithm", "gini")
+        if alg == "gini":
+            return concentration_coeff(table)
+        return uncertainty_coeff(table)
+
+
+class NumericalCorrelation:
+    """Pearson over configured ordinal pairs; config prefix ``nco``.
+
+    The reference pulls means/stddevs from a chombo stats file
+    (``stats.file.path``); when absent we compute them from the data in the
+    same pass (exact host moments, as in models.bayesian).
+    """
+
+    def __init__(self, config: JobConfig):
+        self.config = config.with_prefix("nco") if not config.prefix else config
+
+    def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
+        counters = Counters()
+        cfg = self.config
+        delim = cfg.field_delim_out()
+        # "0:1,2:3" style pair list
+        pair_spec = cfg.must("attr.pairs")
+        pairs = []
+        for item in pair_spec.replace(";", ",").split(","):
+            a, b = item.split(":")
+            pairs.append((int(a), int(b)))
+
+        records = [split_line(l, cfg.field_delim_regex())
+                   for l in read_lines(in_path)]
+        ords = sorted({o for p in pairs for o in p})
+        vals = np.asarray([[float(r[o]) for o in ords] for r in records])
+        col = {o: i for i, o in enumerate(ords)}
+
+        stats_path = cfg.get("stats.file.path")
+        if stats_path:
+            mgr = NumericalAttrStatsManager(stats_path, delim)
+            mean = {o: mgr.mean(o) for o in ords}
+            std = {o: mgr.std_dev(o) for o in ords}
+        else:
+            mean = {o: float(vals[:, col[o]].mean()) for o in ords}
+            std = {o: float(vals[:, col[o]].std()) for o in ords}
+
+        out = []
+        for a, b in pairs:
+            ca = vals[:, col[a]] - mean[a]
+            cb = vals[:, col[b]] - mean[b]
+            corr = float((ca * cb).mean()) / (std[a] * std[b])
+            out.append(f"{a}{delim}{b}{delim}{corr}")
+        write_output(out_path, out)
+        counters.set("Correlation", "Pairs", len(pairs))
+        return counters
+
+
+class NumericalAttrStatsManager:
+    """Reader for the stats file written by models.discriminant.
+    NumericalAttrStats (chombo ``NumericalAttrStatsManager`` equivalent)."""
+
+    def __init__(self, path: str, delim: str = ","):
+        self.stats = {}
+        for line in read_lines(path):
+            items = line.split(delim)
+            # attr, condVal, sum, sumSq, count, mean, variance, stdDev
+            self.stats[(int(items[0]), items[1])] = [float(v) for v in items[2:]]
+
+    def _row(self, attr: int, cond: str = "0"):
+        return self.stats[(attr, cond)]
+
+    def mean(self, attr: int, cond: str = "0") -> float:
+        return self._row(attr, cond)[3]
+
+    def variance(self, attr: int, cond: str = "0") -> float:
+        return self._row(attr, cond)[4]
+
+    def std_dev(self, attr: int, cond: str = "0") -> float:
+        return self._row(attr, cond)[5]
+
+    def count(self, attr: int, cond: str = "0") -> int:
+        return int(self._row(attr, cond)[2])

@@ -1,0 +1,127 @@
+"""Numerical attribute stats + univariate Fisher linear discriminant.
+
+Reference surface:
+- chombo's ``NumericalAttrStats`` MR (not vendored in the reference repo but
+  load-bearing: FisherDiscriminant reuses its mapper/combiner/reducer —
+  discriminant/FisherDiscriminant.java:57-60).  It computes per (attribute,
+  condition-value) moments; condition value "0" is the unconditioned row.
+  Our stats line format: ``attr,condVal,sum,sumSq,count,mean,variance,stdDev``
+  (consumed by correlation.NumericalAttrStatsManager).
+- ``discriminant.FisherDiscriminant`` — reducer computes, per attribute with
+  two class-conditional stats: pooled variance (count-weighted), log-odds
+  prior ``log(c0/c1)``, and the decision boundary
+  ``(m0+m1)/2 - logOddsPrior*pooledVar/(m0-m1)``
+  (FisherDiscriminant.java:84-97); output
+  ``attr,logOddsPrior,pooledVariance,discrimValue``.
+
+TPU re-design: moments are exact host NumPy per (attr, class) — see the
+models.bayesian moments note (64-bit emulation on TPU costs more than the
+whole pass for a handful of scalars); the record scan is one vectorized
+pass.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.config import JobConfig
+from ..core.io import read_lines, split_line, write_output
+from ..core.metrics import Counters
+
+
+def _moment_rows(vals: np.ndarray, conds: List[str],
+                 attr: int) -> List[Tuple[str, np.ndarray]]:
+    """(condVal, [sum, sumSq, count, mean, variance, stdDev]) rows, with the
+    unconditioned "0" row first."""
+    out = []
+
+    def stats(v):
+        cnt = len(v)
+        s = float(v.sum()); s2 = float((v * v).sum())
+        mean = s / cnt
+        var = s2 / cnt - mean * mean
+        return np.asarray([s, s2, cnt, mean, var, math.sqrt(max(var, 0.0))])
+
+    out.append(("0", stats(vals)))
+    for cond in sorted(set(conds)):
+        sel = np.asarray([c == cond for c in conds])
+        out.append((cond, stats(vals[sel])))
+    return out
+
+
+class NumericalAttrStats:
+    """Per-attribute (optionally class-conditioned) moment stats job."""
+
+    def __init__(self, config: JobConfig):
+        self.config = config
+
+    def run(self, in_path: str, out_path: str) -> Counters:
+        counters = Counters()
+        cfg = self.config
+        delim = cfg.field_delim_out()
+        attrs = [int(v) for v in cfg.must_list("attr.list")]
+        cond_ord = cfg.get_int("cond.attr.ord", -1)
+
+        records = [split_line(l, cfg.field_delim_regex())
+                   for l in read_lines(in_path)]
+        out = []
+        for a in attrs:
+            vals = np.asarray([float(r[a]) for r in records])
+            conds = ([r[cond_ord] for r in records] if cond_ord >= 0
+                     else ["0"] * len(records))
+            for cond, row in _moment_rows(vals, conds, a):
+                body = delim.join(str(v) for v in row)
+                out.append(f"{a}{delim}{cond}{delim}{body}")
+        write_output(out_path, out)
+        counters.set("Stats", "Attributes", len(attrs))
+        return counters
+
+
+class FisherDiscriminant:
+    """Univariate Fisher discriminant job (reuses the stats computation the
+    way the reference reuses chombo's NumericalAttrStats)."""
+
+    def __init__(self, config: JobConfig):
+        self.config = config
+
+    def run(self, in_path: str, out_path: str) -> Counters:
+        counters = Counters()
+        cfg = self.config
+        delim = cfg.field_delim_out()
+        attrs = [int(v) for v in cfg.must_list("attr.list")]
+        cond_ord = cfg.must_int("cond.attr.ord")
+
+        records = [split_line(l, cfg.field_delim_regex())
+                   for l in read_lines(in_path)]
+        conds = [r[cond_ord] for r in records]
+
+        out = []
+        for a in attrs:
+            vals = np.asarray([float(r[a]) for r in records])
+            rows = _moment_rows(vals, conds, a)
+            # stats lines (NumericalAttrStats output, emitted by the shared
+            # reducer path in the reference)
+            for cond, row in rows:
+                body = delim.join(str(v) for v in row)
+                out.append(f"{a}{delim}{cond}{delim}{body}")
+            # the two class-conditional rows in first-seen order
+            cls = [(cond, row) for cond, row in rows if cond != "0"]
+            if len(cls) != 2:
+                raise ValueError(
+                    f"FisherDiscriminant needs exactly 2 class values, "
+                    f"got {[c for c, _ in cls]}")
+            (c0, r0), (c1, r1) = cls
+            cnt0, m0, v0 = r0[2], r0[3], r0[4]
+            cnt1, m1, v1 = r1[2], r1[3], r1[4]
+            pooled_var = (v0 * cnt0 + v1 * cnt1) / (cnt0 + cnt1)
+            log_odds_prior = math.log(cnt0 / cnt1)
+            mean_diff = m0 - m1
+            discrim = (m0 + m1) / 2 - log_odds_prior * pooled_var / mean_diff
+            out.append(f"{a}{delim}{log_odds_prior}{delim}{pooled_var}"
+                       f"{delim}{discrim}")
+            counters.incr("Fisher", "Attributes")
+        write_output(out_path, out)
+        return counters

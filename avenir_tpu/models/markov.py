@@ -1,0 +1,473 @@
+"""Markov-chain models: transition trainer, classifier, HMM builder, Viterbi.
+
+Reference surface (citations into /root/reference/src/main/java/org/avenir/):
+- ``markov.MarkovStateTransitionModel`` — counts (class?, from, to) state
+  transitions over each row's trailing state sequence
+  (MarkovStateTransitionModel.java:116-133), row-normalizes to scaled ints
+  with whole-row Laplace correction and writes one row per line with an
+  optional state-list header (:202-242).
+- ``markov.MarkovModelClassifier`` — map-only: per sequence accumulates
+  ``log(P_c0[from,to] / P_c1[from,to])`` and thresholds
+  (MarkovModelClassifier.java:127-150).
+- ``markov.HiddenMarkovModelBuilder`` — counts STATE_TRANS / STATE_OBS /
+  INITIAL_STATE families from fully-tagged ``obs:state`` items
+  (HiddenMarkovModelBuilder.java:136-166) or partially-tagged rows with a
+  distance-decay window function (:174-260); serialized model = states line,
+  observations line, A rows, B rows, pi row (:309-343).  NOTE: the initial
+  state vector keeps the default scale 100 (the reference never calls
+  setScale on it — :304-306) while A and B use ``trans.prob.scale``.
+- ``markov.ViterbiStatePredictor`` + ``ViterbiDecoder`` — map-only Viterbi
+  max-product forward pass + backtrack per record (ViterbiDecoder.java:66-143).
+
+TPU re-design: sequences are vocab-encoded and padded into an int32
+``[n, Lmax]`` matrix; transition counting is one ``count_table`` scatter over
+all adjacent pairs under the sharded-reduce skeleton; Viterbi runs as a
+``lax.scan`` over time on the whole row batch at once (the reference's
+O(T·S^2) per-record loop becomes a batched [n, S] dynamic program).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.config import JobConfig
+from ..core.io import read_lines, split_line, write_output
+from ..core.metrics import Counters
+from ..core.tabular import deserialize_matrix, normalize_rows, serialize_matrix
+from ..ops.counting import count_table, sharded_reduce
+
+
+# ---------------------------------------------------------------------------
+# sequence ingest
+# ---------------------------------------------------------------------------
+
+def encode_sequences(records: Sequence[Sequence[str]], skip: int,
+                     vocab: Dict[str, int],
+                     strict: bool = True) -> Tuple[np.ndarray, np.ndarray]:
+    """Encode each record's trailing items as vocab ids, padded with -1.
+
+    Returns (seq int32 [n, Lmax], lengths int32 [n]).  Unknown symbols raise
+    (strict) or map to -1.
+    """
+    n = len(records)
+    lengths = np.asarray([max(0, len(r) - skip) for r in records], dtype=np.int32)
+    lmax = int(lengths.max()) if n else 0
+    seq = np.full((n, lmax), -1, dtype=np.int32)
+    for i, r in enumerate(records):
+        for t, sym in enumerate(r[skip:]):
+            if strict and sym not in vocab:
+                raise KeyError(f"unknown state/observation symbol: {sym!r}")
+            seq[i, t] = vocab.get(sym, -1)
+    return seq, lengths
+
+
+def _transition_pairs(seq: np.ndarray):
+    """(from, to) index arrays for every adjacent pair; -1-padded cells
+    self-mask in count_table."""
+    return seq[:, :-1], seq[:, 1:]
+
+
+# Module-level local_fns (cache-friendly; see ops.counting._compiled_reduce).
+def _markov_local(frm, to, cls, mask, n_class, n_states):
+    m = mask[:, None]
+    if n_class > 0:
+        c = jnp.broadcast_to(cls[:, None], frm.shape)
+        return count_table((n_class, n_states, n_states), (c, frm, to), mask=m)
+    return count_table((n_states, n_states), (frm, to), mask=m)
+
+
+def _hmm_local(frm, to, obs_s, obs_o, init_s, mask, S, O):
+    m = mask[:, None]
+    return {
+        "trans": count_table((S, S), (frm, to), mask=m),
+        "obs": count_table((S, O), (obs_s, obs_o), mask=m),
+        "init": count_table((S,), (init_s,), mask=mask),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Markov transition model trainer
+# ---------------------------------------------------------------------------
+
+class MarkovStateTransitionModel:
+    """Trainer job; config prefix ``mst`` with un-prefixed fallback
+    (MarkovStateTransitionModel.java:73-75)."""
+
+    def __init__(self, config: JobConfig):
+        self.config = config.with_prefix("mst") if not config.prefix else config
+
+    def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
+        counters = Counters()
+        cfg = self.config
+        delim_regex = cfg.field_delim_regex()
+        states = cfg.must("model.states").split(",")
+        vocab = {s: i for i, s in enumerate(states)}
+        S = len(states)
+        skip = cfg.get_int("skip.field.count", 0)
+        class_ord = cfg.get_int("class.label.field.ord", -1)
+        scale = cfg.get_int("trans.prob.scale", 1000)
+        output_states = cfg.get_boolean("output.states", True)
+
+        records = [split_line(l, delim_regex) for l in read_lines(in_path)]
+        # class label occupies one leading field when present (:107-109)
+        eff_skip = skip + (1 if class_ord >= 0 else 0)
+        class_labels: List[str] = []
+        cls_idx = np.zeros(len(records), dtype=np.int32)
+        if class_ord >= 0:
+            seen: Dict[str, int] = {}
+            for i, r in enumerate(records):
+                lbl = r[class_ord]
+                if lbl not in seen:
+                    seen[lbl] = len(seen)
+                    class_labels.append(lbl)
+                cls_idx[i] = seen[lbl]
+        seq, _ = encode_sequences(records, eff_skip, vocab)
+        if seq.shape[1] < 2:
+            counts = (np.zeros((len(class_labels), S, S), dtype=np.int64)
+                      if class_ord >= 0 else np.zeros((S, S), dtype=np.int64))
+        else:
+            frm, to = _transition_pairs(seq)
+            counts = np.asarray(sharded_reduce(
+                _markov_local, frm, to, cls_idx, mesh=mesh,
+                static_args=(len(class_labels) if class_ord >= 0 else 0, S)))
+
+        lines: List[str] = []
+        if output_states:
+            lines.append(",".join(states))
+        if class_ord >= 0:
+            for ci, lbl in enumerate(class_labels):
+                lines.append(f"classLabel:{lbl}")
+                lines.extend(serialize_matrix(normalize_rows(counts[ci], scale)))
+        else:
+            lines.extend(serialize_matrix(normalize_rows(counts, scale)))
+        write_output(out_path, lines)
+        counters.set("Markov", "Transitions", int(counts.sum()))
+        return counters
+
+
+# ---------------------------------------------------------------------------
+# model + classifier
+# ---------------------------------------------------------------------------
+
+class MarkovModel:
+    """Text-format model loader (markov/MarkovModel.java:38-65)."""
+
+    def __init__(self, lines: List[str], class_label_based: bool):
+        self.states = lines[0].split(",")
+        S = len(self.states)
+        self.index = {s: i for i, s in enumerate(self.states)}
+        self.class_trans: Dict[str, np.ndarray] = {}
+        self.trans: Optional[np.ndarray] = None
+        i = 1
+        if class_label_based:
+            while i < len(lines):
+                if lines[i].startswith("classLabel"):
+                    label = lines[i].split(":")[1]
+                    i += 1
+                    self.class_trans[label] = deserialize_matrix(lines[i:i + S], S)
+                    i += S
+                else:  # pragma: no cover - malformed files mirror Java behavior
+                    raise ValueError(f"unexpected model line: {lines[i]}")
+        else:
+            self.trans = deserialize_matrix(lines[1:1 + S], S)
+
+    @classmethod
+    def load(cls, path: str, class_label_based: bool) -> "MarkovModel":
+        return cls(list(read_lines(path)), class_label_based)
+
+
+class MarkovModelClassifier:
+    """Map-only log-odds classifier, vectorized over the sequence batch."""
+
+    def __init__(self, config: JobConfig):
+        self.config = config
+
+    def run(self, in_path: str, out_path: str) -> Counters:
+        counters = Counters()
+        cfg = self.config
+        delim_regex = cfg.field_delim_regex()
+        delim = cfg.field_delim_out()
+        skip = cfg.get_int("skip.field.count", 1)
+        id_ord = cfg.get_int("id.field.ord", 0)
+        class_based = cfg.get_boolean("class.label.based.model", False)
+        validation = cfg.get_boolean("validation.mode", False)
+        class_ord = -1
+        if validation:
+            skip += 1
+            class_ord = cfg.get_int("class.label.field.ord", -1)
+            if class_ord < 0:
+                raise ValueError(
+                    "In validation mode actual class labels must be provided")
+        model = MarkovModel.load(cfg.must("mm.model.path"), class_based)
+        class_labels = cfg.must("class.labels").split(",")
+        threshold = cfg.get_float("log.odds.threshold", 0.0)
+
+        records = [split_line(l, delim_regex) for l in read_lines(in_path)]
+        usable = [r for r in records if len(r) >= skip + 2]
+        seq, _ = encode_sequences(usable, skip, model.index)
+        frm, to = seq[:, :-1], seq[:, 1:]
+        valid = (frm >= 0) & (to >= 0)
+
+        t0 = jnp.asarray(model.class_trans[class_labels[0]])
+        t1 = jnp.asarray(model.class_trans[class_labels[1]])
+
+        def score(frm, to, valid):
+            f = jnp.where(valid, frm, 0)
+            t = jnp.where(valid, to, 0)
+            lo = jnp.log(t0[f, t] / t1[f, t])
+            return jnp.sum(jnp.where(valid, lo, 0.0), axis=1)
+
+        log_odds = np.asarray(jax.jit(score)(frm, to, valid))
+
+        out: List[str] = []
+        for i, r in enumerate(usable):
+            pred = class_labels[0] if log_odds[i] > threshold else class_labels[1]
+            parts = [r[id_ord]]
+            if validation:
+                parts.append(r[class_ord])
+                if r[class_ord] == pred:
+                    counters.incr("Validation", "Correct")
+                else:
+                    counters.incr("Validation", "Incorrect")
+            parts += [pred, repr(float(log_odds[i]))]
+            out.append(delim.join(parts))
+        write_output(out_path, out)
+        return counters
+
+
+# ---------------------------------------------------------------------------
+# HMM builder
+# ---------------------------------------------------------------------------
+
+class HiddenMarkovModelBuilder:
+    """Builds A/B/pi from tagged sequences; model text format per
+    HiddenMarkovModelBuilder.java:309-343."""
+
+    def __init__(self, config: JobConfig):
+        self.config = config
+
+    def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
+        counters = Counters()
+        cfg = self.config
+        delim_regex = cfg.field_delim_regex()
+        sub_delim = cfg.get("sub.field.delim", ":")
+        skip = cfg.get_int("skip.field.count", 0)
+        states = cfg.must("model.states").split(",")
+        observations = cfg.must("model.observations").split(",")
+        scale = cfg.get_int("trans.prob.scale", 1000)
+        partially = cfg.get_boolean("partially.tagged", False)
+        s_vocab = {s: i for i, s in enumerate(states)}
+        o_vocab = {o: i for i, o in enumerate(observations)}
+        S, O = len(states), len(observations)
+
+        records = [split_line(l, delim_regex) for l in read_lines(in_path)]
+        if partially:
+            trans_c, obs_c, init_c = self._count_partially_tagged(
+                records, states, s_vocab, o_vocab, cfg)
+        else:
+            trans_c, obs_c, init_c = self._count_fully_tagged(
+                records, skip, sub_delim, s_vocab, o_vocab, S, O, mesh)
+
+        lines: List[str] = [",".join(states), ",".join(observations)]
+        lines.extend(serialize_matrix(normalize_rows(trans_c, scale)))
+        lines.extend(serialize_matrix(normalize_rows(obs_c, scale)))
+        # initial vector keeps the reference's default scale of 100
+        lines.extend(serialize_matrix(normalize_rows(init_c[None, :], 100)))
+        write_output(out_path, lines)
+        counters.set("HMM", "Transitions", int(trans_c.sum()))
+        counters.set("HMM", "Emissions", int(obs_c.sum()))
+        return counters
+
+    def _count_fully_tagged(self, records, skip, sub_delim, s_vocab, o_vocab,
+                            S, O, mesh):
+        """Device path: encode (state, obs) streams, count three families."""
+        st_rows, ob_rows = [], []
+        for r in records:
+            if len(r) < skip + 2:
+                st_rows.append([]); ob_rows.append([])
+                continue
+            st, ob = [], []
+            for item in r[skip:]:
+                o, s = item.split(sub_delim)
+                st.append(s); ob.append(o)
+            st_rows.append(st); ob_rows.append(ob)
+        st_seq, _ = encode_sequences(st_rows, 0, s_vocab)
+        ob_seq, _ = encode_sequences(ob_rows, 0, o_vocab)
+        frm, to = st_seq[:, :-1], st_seq[:, 1:]
+        init = st_seq[:, 0] if st_seq.shape[1] else np.zeros(0, np.int32)
+        res = sharded_reduce(_hmm_local, frm, to, st_seq, ob_seq, init,
+                             mesh=mesh, static_args=(S, O))
+        return (np.asarray(res["trans"], dtype=np.int64),
+                np.asarray(res["obs"], dtype=np.int64),
+                np.asarray(res["init"], dtype=np.int64))
+
+    def _count_partially_tagged(self, records, states, s_vocab, o_vocab, cfg):
+        """Host path: the distance-decay window logic of
+        HiddenMarkovModelBuilder.java:174-260 (including its asymmetric
+        window arithmetic) is inherently per-row sequential; rows are few in
+        this mode and counting stays exact on host."""
+        window = [int(v) for v in cfg.must("window.function").split(",")]
+        S, O = len(s_vocab), len(o_vocab)
+        trans_c = np.zeros((S, S), dtype=np.int64)
+        obs_c = np.zeros((S, O), dtype=np.int64)
+        init_c = np.zeros(S, dtype=np.int64)
+        state_set = set(states)
+        for items in records:
+            sidx = [i for i, it in enumerate(items) if it in state_set]
+            if not sidx:
+                continue
+            init_c[s_vocab[items[sidx[0]]]] += 1
+            for i, si in enumerate(sidx):
+                # reference operator-precedence quirks preserved:
+                # left = s[i] - s[i-1]/2 ; right = s[i+1] - s[i]/2
+                if i > 0:
+                    lw = sidx[i] - sidx[i - 1] // 2
+                    lb = sidx[i] - lw
+                else:
+                    lb = -1
+                if i < len(sidx) - 1:
+                    rw = sidx[i + 1] - sidx[i] // 2
+                    rb = sidx[i] + rw
+                else:
+                    rb = -1
+                if lb == -1 and rb != -1:
+                    lb = max(sidx[i] - rw, 0)
+                elif rb == -1 and lb != -1:
+                    rb = min(sidx[i] + lw, len(items) - 1)
+                elif lb == -1 and rb == -1:
+                    lb = sidx[i] // 2
+                    rb = sidx[i] + (len(items) - 1 - sidx[i]) // 2
+                s = s_vocab[items[si]]
+                for j, k in zip(range(si - 1, lb - 1, -1), range(10 ** 9)):
+                    if items[j] in o_vocab:
+                        w = window[k] if k < len(window) else window[-1]
+                        obs_c[s, o_vocab[items[j]]] += w
+                for j, k in zip(range(si + 1, rb + 1), range(10 ** 9)):
+                    if items[j] in o_vocab:
+                        w = window[k] if k < len(window) else window[-1]
+                        obs_c[s, o_vocab[items[j]]] += w
+            for a, b in zip(sidx[:-1], sidx[1:]):
+                trans_c[s_vocab[items[a]], s_vocab[items[b]]] += 1
+        return trans_c, obs_c, init_c
+
+
+# ---------------------------------------------------------------------------
+# HMM model + Viterbi
+# ---------------------------------------------------------------------------
+
+class HiddenMarkovModel:
+    """Text-format HMM loader (markov/HiddenMarkovModel.java:46-70)."""
+
+    def __init__(self, lines: List[str]):
+        self.states = lines[0].split(",")
+        self.observations = lines[1].split(",")
+        S, O = len(self.states), len(self.observations)
+        self.trans = deserialize_matrix(lines[2:2 + S], S)
+        self.obs = deserialize_matrix(lines[2 + S:2 + 2 * S], S)
+        self.initial = np.asarray([float(v) for v in lines[2 + 2 * S].split(",")])
+        self.obs_index = {o: i for i, o in enumerate(self.observations)}
+
+    @classmethod
+    def load(cls, path: str) -> "HiddenMarkovModel":
+        return cls(list(read_lines(path)))
+
+
+def viterbi_batch(obs_idx: jnp.ndarray, lengths: jnp.ndarray,
+                  trans: jnp.ndarray, emit: jnp.ndarray,
+                  initial: jnp.ndarray) -> jnp.ndarray:
+    """Batched max-product Viterbi: ``lax.scan`` over time on [n, S] path
+    scores (the reference's per-record O(T*S^2) loop,
+    ViterbiDecoder.java:66-105, over the whole row batch at once).
+
+    Padded steps (obs == -1 at t >= length) freeze the path scores and write
+    backpointers that keep the argmax stable.  Returns decoded state ids
+    [n, T] (forward order), -1 on padding.
+
+    Scores accumulate in LOG space: the reference multiplies raw scaled-int
+    probabilities (ViterbiDecoder.java:91 — a product that overflows even
+    double for the tutorial's 210-day sequences); log-sum decoding picks the
+    identical argmax path at any length.
+    """
+    n, T = obs_idx.shape
+    S = trans.shape[0]
+    obs_safe = jnp.where(obs_idx >= 0, obs_idx, 0)
+    ltrans = jnp.log(trans)
+    lemit = jnp.log(emit)
+    linit = jnp.log(initial)
+
+    def step(carry, t):
+        path = carry                                  # [n, S] log scores
+        o = obs_safe[:, t]
+        active = (t < lengths) & (t > 0)
+        # candidate[n, s] = max_p path[n, p] + ltrans[p, s]
+        cand = path[:, :, None] + ltrans[None, :, :]  # [n, S, S]
+        best_p = jnp.argmax(cand, axis=1)             # first max, as in Java
+        best = jnp.max(cand, axis=1)
+        new_path = best + lemit[:, o].T               # [n, S]
+        path = jnp.where(active[:, None], new_path, path)
+        ptr = jnp.where(active[:, None], best_p, -1)
+        return path, ptr
+
+    init_path = linit[None, :] + lemit[:, obs_safe[:, 0]].T
+    path, ptrs = jax.lax.scan(step, init_path, jnp.arange(T))
+    ptrs = jnp.moveaxis(ptrs, 0, 1)                   # [n, T, S]
+
+    last = jnp.argmax(path, axis=1)                   # [n]
+
+    def back(carry, t):
+        nxt = carry                                   # [n]
+        tt = T - 1 - t
+        use = tt < lengths
+        prev = jnp.take_along_axis(ptrs[:, tt, :], nxt[:, None], axis=1)[:, 0]
+        state_here = jnp.where(use, nxt, -1)
+        nxt = jnp.where(use & (tt > 0), prev, nxt)
+        return nxt, state_here
+
+    # rev column t holds the state at position T-1-t (padding already -1),
+    # so a flip yields forward order with -1 exactly at t >= length
+    _, rev = jax.lax.scan(back, last, jnp.arange(T))
+    return jnp.flip(jnp.moveaxis(rev, 0, 1), axis=1)
+
+
+class ViterbiStatePredictor:
+    """Map-only decoding job (ViterbiStatePredictor.java:77-152)."""
+
+    def __init__(self, config: JobConfig):
+        self.config = config
+
+    def run(self, in_path: str, out_path: str) -> Counters:
+        counters = Counters()
+        cfg = self.config
+        delim_regex = cfg.field_delim_regex()
+        delim = cfg.field_delim_out()
+        skip = cfg.get_int("skip.field.count", 1)
+        id_ord = cfg.get_int("id.field.ordinal", 0)
+        state_only = cfg.get_boolean("output.state.only", True)
+        sub_delim = cfg.get("sub.field.delim", ":")
+        model = HiddenMarkovModel.load(cfg.must("hmm.model.path"))
+
+        records = [split_line(l, delim_regex) for l in read_lines(in_path)]
+        obs_idx, lengths = encode_sequences(records, skip, model.obs_index)
+        decoded = np.asarray(jax.jit(viterbi_batch)(
+            jnp.asarray(obs_idx), jnp.asarray(lengths),
+            jnp.asarray(model.trans), jnp.asarray(model.obs),
+            jnp.asarray(model.initial)))
+
+        out: List[str] = []
+        for i, r in enumerate(records):
+            L = int(lengths[i])
+            parts = [r[id_ord]]
+            for t in range(L):
+                s = model.states[int(decoded[i, t])]
+                if state_only:
+                    parts.append(s)
+                else:
+                    parts.append(f"{r[skip + t]}{sub_delim}{s}")
+            out.append(delim.join(parts))
+            counters.incr("Viterbi", "Decoded")
+        write_output(out_path, out)
+        return counters

@@ -1,0 +1,361 @@
+"""Mutual information distributions + feature-selection scores.
+
+Reference surface:
+- ``explore.MutualInformation`` — one pass emits 7 distribution families
+  (class; feature; feature-pair; feature-class; feature-pair-class;
+  feature-class-conditional; feature-pair-class-conditional — constants at
+  MutualInformation.java:61-67, map at :136-214); the reducer materializes
+  them, prints each section under a ``distribution:<name>`` header, computes
+  feature/pair/pair-class/pair-class-conditional MI under
+  ``mutualInformation:<name>`` headers (:479-784), then ranked feature
+  scores per configured algorithm (:792-840).
+- ``explore.MutualInformationScore`` — MIM (sort by MI desc), MIFS
+  (redundancy-penalized greedy, MutualInformationScore.java:116-153), JMI
+  (:177-241), DISR (pair MI / pair entropy), mRMR (:265-300).
+
+TPU re-design: the 7 families all project from two dense device tables —
+``FC[class, feature, bin]`` (one ``feature_class_counts`` einsum/scatter) and
+``PC[pair, b1, b2, class]`` (one ``count_table`` over all i<j column pairs).
+The mapper's quadratic per-record pair loop disappears into indexing; the MI
+arithmetic runs on the host over the tiny tables, preserving the reference's
+"only observed cells" summation (dense zero cells are skipped, which is the
+same set).  Binning requires every numeric feature to declare bucketWidth
+(MutualInformation.java:220-227 has no unbinned path).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.binning import DatasetEncoder, EncodedDataset
+from ..core.config import JobConfig
+from ..core.io import write_output
+from ..core.metrics import Counters
+from ..core.schema import FeatureSchema
+from ..ops.counting import count_table, feature_class_counts, sharded_reduce
+
+
+def _mi_local(x, y, mask, n_class, max_bins, pair_i, pair_j):
+    fc = feature_class_counts(x, y, n_class, max_bins, mask=mask)
+    n_pairs = len(pair_i)
+    pi = np.asarray(pair_i, dtype=np.int32)
+    pj = np.asarray(pair_j, dtype=np.int32)
+    import jax.numpy as jnp
+    xi = x[:, pi]                                  # [n, n_pairs]
+    xj = x[:, pj]
+    p_idx = jnp.broadcast_to(jnp.arange(n_pairs, dtype=jnp.int32)[None, :],
+                             xi.shape)
+    yb = jnp.broadcast_to(y[:, None], xi.shape)
+    m = mask[:, None]
+    pc = count_table((n_pairs, max_bins, max_bins, n_class),
+                     (p_idx, xi, xj, yb), mask=m)
+    return {"fc": fc, "pc": pc}
+
+
+class MutualInformationScore:
+    """Feature-ranking algorithms (MutualInformationScore.java)."""
+
+    def __init__(self):
+        self.feature_mi: List[Tuple[int, float]] = []
+        self.pair_mi: List[Tuple[int, int, float]] = []
+        self.pair_class_mi: List[Tuple[int, int, float]] = []
+        self.pair_class_entropy: List[Tuple[int, int, float]] = []
+
+    # -- MIM ----------------------------------------------------------------
+    def mim(self) -> List[Tuple[int, float]]:
+        return sorted(self.feature_mi, key=lambda t: -t[1])
+
+    # -- MIFS ---------------------------------------------------------------
+    def mifs(self, redundancy_factor: float) -> List[Tuple[int, float]]:
+        out, selected = [], set()
+        while len(selected) < len(self.feature_mi):
+            best, best_f = -math.inf, 0
+            for f, mi in self.feature_mi:
+                if f in selected:
+                    continue
+                red = sum(v for a, b, v in self.pair_mi
+                          if (a == f and b in selected)
+                          or (b == f and a in selected))
+                score = mi - redundancy_factor * red
+                if score > best:
+                    best, best_f = score, f
+            out.append((best_f, best))
+            selected.add(best_f)
+        return out
+
+    # -- JMI / DISR ---------------------------------------------------------
+    def _jmi_helper(self, joint: bool) -> List[Tuple[int, float]]:
+        out, selected = [], set()
+        first = self.mim()[0]
+        out.append(first)
+        selected.add(first[0])
+        while len(selected) < len(self.feature_mi):
+            best, best_f = -math.inf, 0
+            for f, _ in self.feature_mi:
+                if f in selected:
+                    continue
+                s = 0.0
+                for a, b, v in self.pair_class_mi:
+                    if (a == f and b in selected) or (b == f and a in selected):
+                        if joint:
+                            s += v
+                        else:
+                            ent = self._pair_entropy(a, b)
+                            s += v / ent
+                if s > best:
+                    best, best_f = s, f
+            out.append((best_f, best))
+            selected.add(best_f)
+        return out
+
+    def jmi(self) -> List[Tuple[int, float]]:
+        return self._jmi_helper(True)
+
+    def disr(self) -> List[Tuple[int, float]]:
+        return self._jmi_helper(False)
+
+    def _pair_entropy(self, a: int, b: int) -> float:
+        for x, y, v in self.pair_class_entropy:
+            if (x == a and y == b) or (x == b and y == a):
+                return v
+        raise KeyError((a, b))
+
+    # -- mRMR ---------------------------------------------------------------
+    def mrmr(self) -> List[Tuple[int, float]]:
+        out, selected = [], set()
+        while len(selected) < len(self.feature_mi):
+            best, best_f = -math.inf, 0
+            for f, mi in self.feature_mi:
+                if f in selected:
+                    continue
+                red = sum(v for a, b, v in self.pair_mi
+                          if (a == f and b in selected)
+                          or (b == f and a in selected))
+                score = (mi - red / len(selected)) if selected else mi
+                if score > best:
+                    best, best_f = score, f
+            out.append((best_f, best))
+            selected.add(best_f)
+        return out
+
+
+_ALGOS = {
+    "mutual.info.maximization": lambda s, rf: s.mim(),
+    "mutual.info.selection": lambda s, rf: s.mifs(rf),
+    "joint.mutual.info": lambda s, rf: s.jmi(),
+    "double.input.symmetric.relevance": lambda s, rf: s.disr(),
+    "min.redundancy.max.relevance": lambda s, rf: s.mrmr(),
+}
+
+
+class MutualInformation:
+    """The MI job."""
+
+    def __init__(self, config: JobConfig, schema: Optional[FeatureSchema] = None):
+        self.config = config
+        self.schema = schema or FeatureSchema.from_file(
+            config.must("feature.schema.file.path"))
+        for f in self.schema.feature_fields():
+            if not f.is_categorical() and not f.is_bucket_width_defined():
+                raise ValueError(
+                    f"MutualInformation requires bucketWidth on numeric "
+                    f"feature {f.name!r} (reference has no unbinned path)")
+
+    def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
+        counters = Counters()
+        cfg = self.config
+        delim = cfg.field_delim_out()
+        enc = DatasetEncoder(self.schema)
+        ds = enc.encode_path(in_path, cfg.field_delim_regex())
+        counters.set("Basic", "Records", ds.n_rows)
+
+        F = ds.n_features
+        C = len(ds.class_vocab)
+        B = max(ds.num_bins)
+        pair_i, pair_j = map(tuple, np.triu_indices(F, k=1))
+        res = sharded_reduce(_mi_local, ds.x, ds.y, mesh=mesh,
+                             static_args=(C, B, pair_i, pair_j))
+        fc = np.asarray(res["fc"], dtype=np.int64)       # [C, F, B]
+        pc = np.asarray(res["pc"], dtype=np.int64)       # [P, B, B, C]
+
+        lines = self._emit(ds, fc, pc, pair_i, pair_j, delim, cfg)
+        write_output(out_path, lines)
+        return counters
+
+    # -- host post-processing ----------------------------------------------
+    def _emit(self, ds: EncodedDataset, fc, pc, pair_i, pair_j, delim,
+              cfg) -> List[str]:
+        out: List[str] = []
+        F = ds.n_features
+        C, B = fc.shape[0], fc.shape[2]
+        ords = [f.ordinal for f in ds.feature_fields]
+        class_vals = ds.class_vocab.values
+        class_counts = fc[:, 0, :].sum(axis=1)           # every row binned
+        total = int(class_counts.sum())
+        feat = fc.sum(axis=0)                            # [F, B]
+        pair = pc.sum(axis=3)                            # [P, B, B]
+
+        def bl(j, b):
+            return ds.bin_label(j, b)
+
+        # ---- distributions ----
+        out.append("distribution:class")
+        for c in range(C):
+            out.append(f"{class_vals[c]}{delim}{class_counts[c] / total}")
+
+        out.append("distribution:feature")
+        for j in range(F):
+            for b in range(B):
+                if feat[j, b]:
+                    out.append(f"{ords[j]}{delim}{bl(j, b)}{delim}"
+                               f"{feat[j, b] / total}")
+
+        out.append("distribution:featurePair")
+        for p, (i, j) in enumerate(zip(pair_i, pair_j)):
+            for b1 in range(B):
+                for b2 in range(B):
+                    v = pair[p, b1, b2]
+                    if v:
+                        out.append(
+                            f"{ords[i]}{delim}{ords[j]}{delim}{bl(i, b1)}"
+                            f"{delim}{bl(j, b2)}{delim}{v / total}")
+
+        out.append("distribution:featureClass")
+        for j in range(F):
+            for b in range(B):
+                for c in range(C):
+                    v = fc[c, j, b]
+                    if v:
+                        out.append(f"{ords[j]}{delim}{bl(j, b)}{delim}"
+                                   f"{class_vals[c]}{delim}{v / total}")
+
+        out.append("distribution:featurePairClass")
+        for p, (i, j) in enumerate(zip(pair_i, pair_j)):
+            for b1 in range(B):
+                for b2 in range(B):
+                    for c in range(C):
+                        v = pc[p, b1, b2, c]
+                        if v:
+                            out.append(
+                                f"{ords[i]}{delim}{ords[j]}{delim}{bl(i, b1)}"
+                                f"{delim}{bl(j, b2)}{delim}{class_vals[c]}"
+                                f"{delim}{v / total}")
+
+        out.append("distribution:featureClassConditional")
+        for j in range(F):
+            for c in range(C):
+                for b in range(B):
+                    v = fc[c, j, b]
+                    if v:
+                        out.append(f"{ords[j]}{delim}{class_vals[c]}{delim}"
+                                   f"{bl(j, b)}{delim}{v / class_counts[c]}")
+
+        out.append("distribution:featurePairClassConditional")
+        for p, (i, j) in enumerate(zip(pair_i, pair_j)):
+            for c in range(C):
+                for b1 in range(B):
+                    for b2 in range(B):
+                        v = pc[p, b1, b2, c]
+                        if v:
+                            out.append(
+                                f"{ords[i]}{delim}{ords[j]}{delim}"
+                                f"{class_vals[c]}{delim}{bl(i, b1)}{delim}"
+                                f"{bl(j, b2)}{delim}{v / class_counts[c]}")
+
+        # ---- mutual information ----
+        score = MutualInformationScore()
+
+        out.append("mutualInformation:feature")
+        for j in range(F):
+            s = 0.0
+            for b in range(B):
+                if not feat[j, b]:
+                    continue
+                fp = feat[j, b] / total
+                for c in range(C):
+                    v = fc[c, j, b]
+                    if v:
+                        jp = v / total
+                        s += jp * math.log(jp / (fp * class_counts[c] / total))
+            out.append(f"{ords[j]}{delim}{s}")
+            score.feature_mi.append((ords[j], s))
+
+        out.append("mutualInformation:featurePair")
+        for p, (i, j) in enumerate(zip(pair_i, pair_j)):
+            s = 0.0
+            for b1 in range(B):
+                if not feat[i, b1]:
+                    continue
+                p1 = feat[i, b1] / total
+                for b2 in range(B):
+                    if not feat[j, b2]:
+                        continue
+                    p2 = feat[j, b2] / total
+                    v = pair[p, b1, b2]
+                    if v:
+                        jp = v / total
+                        s += jp * math.log(jp / (p1 * p2))
+            out.append(f"{ords[i]}{delim}{ords[j]}{delim}{s}")
+            score.pair_mi.append((ords[i], ords[j], s))
+
+        out.append("mutualInformation:featurePairClass")
+        for p, (i, j) in enumerate(zip(pair_i, pair_j)):
+            s = 0.0
+            ent = 0.0
+            for b1 in range(B):
+                for b2 in range(B):
+                    jf = pair[p, b1, b2]
+                    if not jf:
+                        continue
+                    jfp = jf / total
+                    for c in range(C):
+                        v = pc[p, b1, b2, c]
+                        if v:
+                            jp = v / total
+                            s += jp * math.log(
+                                jp / (jfp * class_counts[c] / total))
+                            ent -= jp * math.log(jp)
+            out.append(f"{ords[i]}{delim}{ords[j]}{delim}{s}")
+            score.pair_class_mi.append((ords[i], ords[j], s))
+            score.pair_class_entropy.append((ords[i], ords[j], ent))
+
+        out.append("mutualInformation:featurePairClassConditional")
+        for p, (i, j) in enumerate(zip(pair_i, pair_j)):
+            total_s = 0.0
+            for c in range(C):
+                cp = class_counts[c] / total
+                s = 0.0
+                for b1 in range(B):
+                    v1 = fc[c, i, b1]
+                    if not v1:
+                        continue
+                    # reference normalizes class-conditional marginals by
+                    # TOTAL count here (MutualInformation.java:759-762)
+                    p1 = v1 / total
+                    for b2 in range(B):
+                        v2 = fc[c, j, b2]
+                        if not v2:
+                            continue
+                        p2 = v2 / total
+                        v = pc[p, b1, b2, c]
+                        if v:
+                            jp = v / total
+                            s += cp * (jp * math.log(jp / (p1 * p2)))
+                total_s += s
+            out.append(f"{ords[i]}{delim}{ords[j]}{delim}{total_s}")
+
+        # ---- scores ----
+        algos = cfg.get("mutual.info.score.algorithms",
+                        "mutual.info.maximization").split(",")
+        rf = cfg.get_float("mutual.info.redundancy.factor", 1.0)
+        for alg in algos:
+            out.append(f"mutualInformationScoreAlgorithm: {alg}")
+            fn = _ALGOS.get(alg)
+            if fn is None:
+                continue
+            for f, v in fn(score, rf):
+                out.append(f"{f}{delim}{v}")
+        return out

@@ -1,0 +1,277 @@
+"""Stage-2 explore jobs: PST, mutual information, correlations, Fisher,
+samplers — oracle checks + planted-signal recovery."""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core import JobConfig, write_output
+from avenir_tpu.datagen import gen_state_sequences, gen_telecom_churn
+from avenir_tpu.models.correlation import (CramerCorrelation,
+                                           HeterogeneityReductionCorrelation,
+                                           NumericalCorrelation, cramer_index,
+                                           concentration_coeff)
+from avenir_tpu.models.discriminant import FisherDiscriminant, NumericalAttrStats
+from avenir_tpu.models.mutual_info import MutualInformation
+from avenir_tpu.models.pst import (ProbabilisticSuffixTreeGenerator,
+                                   SuffixTreeBuilder)
+from avenir_tpu.models.sampler import BaggingSampler, UnderSamplingBalancer
+
+MI_SCHEMA = {
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "plan", "ordinal": 1, "dataType": "categorical", "feature": True},
+        {"name": "minUsed", "ordinal": 2, "dataType": "int", "feature": True,
+         "min": 0, "max": 2200, "bucketWidth": 200},
+        {"name": "dataUsed", "ordinal": 3, "dataType": "int", "feature": True,
+         "min": 0, "max": 1000, "bucketWidth": 100},
+        {"name": "csCall", "ordinal": 4, "dataType": "int", "feature": True,
+         "min": 0, "max": 14, "bucketWidth": 2},
+        {"name": "csEmail", "ordinal": 5, "dataType": "int", "feature": True,
+         "min": 0, "max": 22, "bucketWidth": 4},
+        {"name": "network", "ordinal": 6, "dataType": "int", "feature": True,
+         "min": 0, "max": 12, "bucketWidth": 2},
+        {"name": "churned", "ordinal": 7, "dataType": "categorical",
+         "cardinality": ["N", "Y"]},
+    ]
+}
+
+
+def test_pst_ngram_counts(tmp_path, mesh8):
+    rows = [
+        ["E1", "a", "b", "a", "b"],
+        ["E2", "a", "b", "b", "a"],
+    ]
+    write_output(str(tmp_path / "in"), [",".join(r) for r in rows])
+    cfg = JobConfig({"skip.field.count": "1", "max.seq.length": "3"})
+    ProbabilisticSuffixTreeGenerator(cfg).run(
+        str(tmp_path / "in"), str(tmp_path / "out"), mesh=mesh8)
+    lines = open(str(tmp_path / "out" / "part-r-00000")).read().splitlines()
+    counts = {tuple(l.split(",")[:-1]): int(l.split(",")[-1]) for l in lines}
+    # bigram a,b appears 2x in row1, 1x in row2
+    assert counts[("a", "b")] == 3
+    assert counts[("b", "a")] == 2
+    assert counts[("b", "b")] == 1
+    # trigrams: aba, bab / abb, bba
+    assert counts[("a", "b", "a")] == 1
+    assert counts[("b", "a", "b")] == 1
+    # root count = windows per record summed: row has 3 bigram + 2 trigram = 5
+    assert counts[("$",)] == 10
+
+    tree = SuffixTreeBuilder(str(tmp_path / "out"))
+    assert tree.get_tree().find(["a", "b"]).count == 3
+    assert tree.get_tree().find(["a", "b", "a"]).count == 1
+
+
+def test_pst_class_based_and_partitioned(tmp_path, mesh8):
+    rows = [["P1", "c0", "x", "y", "x"], ["P2", "c1", "y", "y", "x"]]
+    write_output(str(tmp_path / "in"), [",".join(r) for r in rows])
+    cfg = JobConfig({
+        "skip.field.count": "1",
+        "class.label.field.ord": "1",
+        "id.field.ordinals": "0",
+        "max.seq.length": "2",
+    })
+    ProbabilisticSuffixTreeGenerator(cfg).run(
+        str(tmp_path / "in"), str(tmp_path / "out"), mesh=mesh8)
+    lines = open(str(tmp_path / "out" / "part-r-00000")).read().splitlines()
+    counts = {tuple(l.split(",")[:-1]): int(l.split(",")[-1]) for l in lines}
+    assert counts[("P1", "c0", "x", "y")] == 1
+    assert counts[("P2", "c1", "y", "y")] == 1
+    assert counts[("P1", "c0", "$")] == 2
+
+
+def test_pst_nonsequential_prefix_semantics(tmp_path):
+    """One-event-per-row mode emits only the length-w PREFIXES of each full
+    rolling window (ProbabilisticSuffixTreeGenerator.java:225-241) — no
+    sliding inside overlapping windows."""
+    rows = [["e1"], ["e2"], ["e3"], ["e4"], ["e5"]]
+    write_output(str(tmp_path / "in"), [",".join(r) for r in rows])
+    cfg = JobConfig({
+        "input.format.sequential": "false",
+        "data.field.ordinal": "0",
+        "max.seq.length": "3",
+    })
+    ProbabilisticSuffixTreeGenerator(cfg).run(
+        str(tmp_path / "in"), str(tmp_path / "out"))
+    lines = open(str(tmp_path / "out" / "part-r-00000")).read().splitlines()
+    counts = {tuple(l.split(",")[:-1]): int(l.split(",")[-1]) for l in lines}
+    # windows fill at e3: [e1,e2,e3], e4: [e2,e3,e4], e5: [e3,e4,e5];
+    # per window only prefixes of length 2 and 3 are emitted once
+    assert counts[("e1", "e2")] == 1
+    assert counts[("e2", "e3")] == 1       # NOT 2 (interior of first window)
+    assert counts[("e1", "e2", "e3")] == 1
+    assert counts[("$",)] == 6             # 3 windows x 2 prefixes
+
+
+def _mi_oracle_feature(records, ord_, class_ord, bucket):
+    """Plain-dict MI oracle for one feature."""
+    from collections import Counter
+    n = len(records)
+    fcnt, ccnt, jcnt = Counter(), Counter(), Counter()
+    for r in records:
+        b = r[ord_] if bucket is None else str(int(r[ord_]) // bucket)
+        fcnt[b] += 1
+        ccnt[r[class_ord]] += 1
+        jcnt[(b, r[class_ord])] += 1
+    s = 0.0
+    for (b, c), v in jcnt.items():
+        jp = v / n
+        s += jp * math.log(jp / ((fcnt[b] / n) * (ccnt[c] / n)))
+    return s
+
+
+def test_mutual_information(tmp_path, mesh8):
+    schema_path = str(tmp_path / "schema.json")
+    with open(schema_path, "w") as f:
+        json.dump(MI_SCHEMA, f)
+    rows = gen_telecom_churn(3000, seed=21)
+    write_output(str(tmp_path / "in"), [",".join(r) for r in rows])
+    cfg = JobConfig({
+        "feature.schema.file.path": schema_path,
+        "mutual.info.score.algorithms":
+            "mutual.info.maximization,mutual.info.selection,joint.mutual.info,"
+            "double.input.symmetric.relevance,min.redundancy.max.relevance",
+    })
+    MutualInformation(cfg).run(str(tmp_path / "in"), str(tmp_path / "out"),
+                               mesh=mesh8)
+    lines = open(str(tmp_path / "out" / "part-r-00000")).read().splitlines()
+
+    # all sections present in reference order
+    headers = [l for l in lines if l.startswith(("distribution:",
+                                                 "mutualInformation",
+                                                 "mutualInformationScore"))]
+    assert headers[:7] == [
+        "distribution:class", "distribution:feature",
+        "distribution:featurePair", "distribution:featureClass",
+        "distribution:featurePairClass", "distribution:featureClassConditional",
+        "distribution:featurePairClassConditional"]
+    assert "mutualInformationScoreAlgorithm: mutual.info.maximization" in headers
+
+    # per-feature MI matches a dict oracle
+    mi_sec = lines[lines.index("mutualInformation:feature") + 1:
+                   lines.index("mutualInformation:featurePair")]
+    got = {int(l.split(",")[0]): float(l.split(",")[1]) for l in mi_sec}
+    assert abs(got[1] - _mi_oracle_feature(rows, 1, 7, None)) < 1e-9
+    assert abs(got[2] - _mi_oracle_feature(rows, 2, 7, 200)) < 1e-9
+
+    # planted signal: all real features beat the uninformative-ish network
+    mim_start = lines.index("mutualInformationScoreAlgorithm: mutual.info.maximization")
+    top_feature = int(lines[mim_start + 1].split(",")[0])
+    assert top_feature in (2, 3, 4, 5, 6)
+    # MIM is sorted descending
+    scores = [float(l.split(",")[1]) for l in lines[mim_start + 1:mim_start + 7]]
+    assert scores == sorted(scores, reverse=True)
+
+
+def test_cramer_and_heterogeneity(tmp_path, mesh8):
+    # two perfectly-correlated categoricals and one independent
+    rng = np.random.default_rng(3)
+    rows = []
+    for i in range(1000):
+        a = rng.choice(["u", "v"])
+        b = "p" if a == "u" else "q"            # perfectly dependent on a
+        c = rng.choice(["m", "n"])              # independent
+        rows.append([str(i), a, b, c])
+    write_output(str(tmp_path / "in"), [",".join(r) for r in rows])
+    schema = {"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "a", "ordinal": 1, "dataType": "categorical", "feature": True,
+         "cardinality": ["u", "v"]},
+        {"name": "b", "ordinal": 2, "dataType": "categorical", "feature": True,
+         "cardinality": ["p", "q"]},
+        {"name": "c", "ordinal": 3, "dataType": "categorical",
+         "cardinality": ["m", "n"]},
+    ]}
+    spath = str(tmp_path / "s.json")
+    with open(spath, "w") as f:
+        json.dump(schema, f)
+    cfg = JobConfig({
+        "feature.schema.file.path": spath,
+        "source.attributes": "1",
+        "dest.attributes": "2,3",
+    })
+    CramerCorrelation(cfg).run(str(tmp_path / "in"), str(tmp_path / "cram"),
+                               mesh=mesh8)
+    got = {}
+    for line in open(str(tmp_path / "cram" / "part-r-00000")):
+        s, d, v = line.strip().split(",")
+        got[(s, d)] = float(v)
+    assert got[("a", "b")] > 0.99          # perfect association
+    assert got[("a", "c")] < 0.05          # independent
+
+    HeterogeneityReductionCorrelation(cfg).run(
+        str(tmp_path / "in"), str(tmp_path / "het"), mesh=mesh8)
+    hline = open(str(tmp_path / "het" / "part-r-00000")).readline().split(",")
+    assert float(hline[2]) > 0.99
+
+    # oracle parity for the index math itself
+    tbl = np.array([[30, 0], [0, 20]])
+    assert abs(cramer_index(tbl) - 1.0) < 1e-12
+    assert abs(concentration_coeff(tbl) - 1.0) < 1e-12
+
+
+def test_numerical_correlation(tmp_path):
+    rng = np.random.default_rng(5)
+    x = rng.normal(0, 1, 2000)
+    y = 0.8 * x + rng.normal(0, 0.6, 2000)
+    z = rng.normal(0, 1, 2000)
+    rows = [[f"{a:.5f}", f"{b:.5f}", f"{c:.5f}"] for a, b, c in zip(x, y, z)]
+    write_output(str(tmp_path / "in"), [",".join(r) for r in rows])
+    cfg = JobConfig({"nco.attr.pairs": "0:1,0:2"})
+    NumericalCorrelation(cfg).run(str(tmp_path / "in"), str(tmp_path / "out"))
+    got = {}
+    for line in open(str(tmp_path / "out" / "part-r-00000")):
+        a, b, v = line.strip().split(",")
+        got[(a, b)] = float(v)
+    want = np.corrcoef(x, y)[0, 1]
+    assert abs(got[("0", "1")] - want) < 0.01
+    assert abs(got[("0", "2")]) < 0.08
+
+
+def test_fisher_discriminant(tmp_path):
+    rng = np.random.default_rng(6)
+    rows = []
+    for i in range(1000):
+        c = "A" if rng.random() < 0.6 else "B"
+        v = rng.normal(10 if c == "A" else 20, 2.0)
+        rows.append([f"{v:.4f}", c])
+    write_output(str(tmp_path / "in"), [",".join(r) for r in rows])
+    cfg = JobConfig({"attr.list": "0", "cond.attr.ord": "1"})
+    FisherDiscriminant(cfg).run(str(tmp_path / "in"), str(tmp_path / "out"))
+    lines = open(str(tmp_path / "out" / "part-r-00000")).read().splitlines()
+    fisher = [l for l in lines if len(l.split(",")) == 4][-1]
+    attr, log_odds, pooled_var, discrim = fisher.split(",")
+    assert attr == "0"
+    assert abs(float(log_odds) - math.log(0.6 / 0.4)) < 0.15
+    assert 2.5 < float(pooled_var) < 6.0
+    # boundary sits between the means, shifted toward B by the prior
+    assert 13.0 < float(discrim) < 16.0
+
+
+def test_bagging_sampler(tmp_path):
+    lines = [f"row{i}" for i in range(250)]
+    write_output(str(tmp_path / "in"), lines)
+    cfg = JobConfig({"batch.size": "100", "sampling.seed": "1"})
+    BaggingSampler(cfg).run(str(tmp_path / "in"), str(tmp_path / "out"))
+    out = open(str(tmp_path / "out" / "part-r-00000")).read().splitlines()
+    assert len(out) == 250                       # per-batch size preserved
+    assert set(out) <= set(lines)
+    assert len(set(out)) < 250                   # with replacement -> dupes
+
+
+def test_undersampling_balancer(tmp_path):
+    rows = [f"r{i},MAJ" for i in range(900)] + [f"r{i},MIN" for i in range(100)]
+    rng = np.random.default_rng(0)
+    rng.shuffle(rows)
+    write_output(str(tmp_path / "in"), rows)
+    cfg = JobConfig({"class.attr.ord": "1", "distr.batch.size": "200",
+                     "sampling.seed": "2"})
+    UnderSamplingBalancer(cfg).run(str(tmp_path / "in"), str(tmp_path / "out"))
+    out = open(str(tmp_path / "out" / "part-r-00000")).read().splitlines()
+    maj = sum(1 for l in out if l.endswith("MAJ"))
+    mn = sum(1 for l in out if l.endswith("MIN"))
+    assert mn == 100                              # minority kept whole
+    assert maj < 350                              # majority cut toward min

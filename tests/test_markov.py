@@ -1,0 +1,190 @@
+"""Markov family: transition model format/normalization, classifier recovery,
+HMM builder, batched Viterbi vs a scalar oracle."""
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core import JobConfig, write_output
+from avenir_tpu.core.tabular import normalize_rows
+from avenir_tpu.datagen import gen_hmm_sequences, gen_state_sequences
+from avenir_tpu.models.markov import (HiddenMarkovModel,
+                                      HiddenMarkovModelBuilder, MarkovModel,
+                                      MarkovModelClassifier,
+                                      MarkovStateTransitionModel,
+                                      ViterbiStatePredictor, viterbi_batch)
+
+STATES = ["LL", "LM", "LH", "ML", "MM", "MH", "HL", "HM", "HH"]
+
+
+def _chain(diag):
+    S = len(STATES)
+    T = np.full((S, S), (1 - diag) / (S - 1))
+    np.fill_diagonal(T, diag)
+    return T
+
+
+def test_transition_model_normalization_semantics():
+    # whole-row Laplace: a row with any zero gets +1 EVERYWHERE in the row
+    counts = np.array([[5, 0, 5], [2, 3, 5]])
+    norm = normalize_rows(counts, 1000)
+    # row 0: corrected to [6,1,6] sum 13 -> (6*1000)//13 = 461, (1*1000)//13 = 76
+    assert norm[0].tolist() == [461, 76, 461]
+    # row 1: untouched, sum 10
+    assert norm[1].tolist() == [200, 300, 500]
+
+
+def test_markov_train_and_classify(tmp_path, mesh8):
+    # class-conditional chains: churners hop around, loyals stay put
+    rows = gen_state_sequences(
+        600, STATES,
+        {"L": _chain(0.6), "C": _chain(0.15)},
+        seq_len=(15, 40), seed=9)
+    train, test = rows[:400], rows[400:]
+    write_output(str(tmp_path / "train"), [",".join(r) for r in train])
+    write_output(str(tmp_path / "test"), [",".join(r) for r in test])
+
+    cfg = JobConfig({
+        "model.states": ",".join(STATES),
+        "class.label.field.ord": "1",
+        "skip.field.count": "1",
+        "trans.prob.scale": "1000",
+    })
+    MarkovStateTransitionModel(cfg).run(
+        str(tmp_path / "train"), str(tmp_path / "model"), mesh=mesh8)
+
+    lines = open(str(tmp_path / "model" / "part-r-00000")).read().splitlines()
+    assert lines[0] == ",".join(STATES)
+    assert sum(1 for l in lines if l.startswith("classLabel:")) == 2
+    # each class block has 9 rows of 9 scaled ints
+    model = MarkovModel.load(str(tmp_path / "model"), class_label_based=True)
+    assert set(model.class_trans) == {"L", "C"}
+    assert model.class_trans["L"].shape == (9, 9)
+    # loyal chain is diagonal-heavy
+    tl = model.class_trans["L"]
+    assert np.mean(np.diag(tl)) > np.mean(tl) * 2
+
+    cfg2 = JobConfig({
+        "mm.model.path": str(tmp_path / "model"),
+        "class.label.based.model": "true",
+        "class.labels": "L,C",
+        "validation.mode": "true",
+        "class.label.field.ord": "1",
+        "skip.field.count": "1",
+    })
+    counters = MarkovModelClassifier(cfg2).run(
+        str(tmp_path / "test"), str(tmp_path / "pred"))
+    correct = counters.get("Validation", "Correct")
+    incorrect = counters.get("Validation", "Incorrect")
+    assert correct / (correct + incorrect) > 0.9
+    line = open(str(tmp_path / "pred" / "part-r-00000")).readline().split(",")
+    assert line[1] in ("L", "C") and line[2] in ("L", "C")
+
+
+def _viterbi_oracle(obs, trans, emit, initial):
+    """Scalar max-product Viterbi with the reference's strict-greater /
+    first-index tie semantics (ViterbiDecoder.java:66-143)."""
+    T = len(obs)
+    S = trans.shape[0]
+    path = np.zeros((T, S))
+    ptr = np.zeros((T, S), dtype=int)
+    for s in range(S):
+        path[0, s] = initial[s] * emit[s, obs[0]]
+        ptr[0, s] = -1
+    for t in range(1, T):
+        for s in range(S):
+            best, bi = 0.0, 0
+            for p in range(S):
+                v = path[t - 1, p] * trans[p, s]
+                if v > best:
+                    best, bi = v, p
+            path[t, s] = best * emit[s, obs[t]]
+            ptr[t, s] = bi
+    best, bi = 0.0, -1
+    for s in range(S):
+        if path[T - 1, s] > best:
+            best, bi = path[T - 1, s], s
+    seq = [bi]
+    for t in range(T - 1, 0, -1):
+        bi = ptr[t, bi]
+        seq.append(bi)
+    return seq[::-1]
+
+
+def test_viterbi_batch_matches_oracle():
+    rng = np.random.default_rng(4)
+    S, O = 4, 6
+    trans = rng.dirichlet(np.ones(S), S)
+    emit = rng.dirichlet(np.ones(O), S)
+    initial = rng.dirichlet(np.ones(S))
+    lengths = np.array([7, 3, 12, 1, 12], dtype=np.int32)
+    T = int(lengths.max())
+    obs = np.full((5, T), -1, dtype=np.int32)
+    for i, L in enumerate(lengths):
+        obs[i, :L] = rng.integers(0, O, L)
+
+    import jax.numpy as jnp
+    got = np.asarray(viterbi_batch(jnp.asarray(obs), jnp.asarray(lengths),
+                                   jnp.asarray(trans), jnp.asarray(emit),
+                                   jnp.asarray(initial)))
+    for i, L in enumerate(lengths):
+        want = _viterbi_oracle(obs[i, :L], trans, emit, initial)
+        assert got[i, :L].tolist() == want, i
+        assert (got[i, L:] == -1).all()
+
+
+def test_hmm_build_and_decode(tmp_path, mesh8):
+    S_NAMES = ["s0", "s1", "s2"]
+    O_NAMES = ["a", "b", "c", "d"]
+    A = np.array([[.7, .2, .1], [.1, .7, .2], [.2, .1, .7]])
+    B = np.array([[.7, .1, .1, .1], [.1, .7, .1, .1], [.1, .1, .1, .7]])
+    pi = np.array([.5, .3, .2])
+    rows = gen_hmm_sequences(400, S_NAMES, O_NAMES, A, B, pi, seed=5)
+    write_output(str(tmp_path / "train"), [",".join(r) for r in rows])
+
+    cfg = JobConfig({
+        "model.states": ",".join(S_NAMES),
+        "model.observations": ",".join(O_NAMES),
+        "skip.field.count": "1",
+        "trans.prob.scale": "1000",
+    })
+    HiddenMarkovModelBuilder(cfg).run(
+        str(tmp_path / "train"), str(tmp_path / "hmm"), mesh=mesh8)
+
+    model = HiddenMarkovModel.load(str(tmp_path / "hmm"))
+    assert model.states == S_NAMES and model.observations == O_NAMES
+    # learned A approximates the generator (scaled by 1000)
+    est = model.trans / model.trans.sum(axis=1, keepdims=True)
+    assert np.abs(est - A).max() < 0.08
+
+    # decode: feed observation rows, expect recovered states mostly right
+    test_rows = gen_hmm_sequences(50, S_NAMES, O_NAMES, A, B, pi, seed=77)
+    obs_only = [[r[0]] + [p.split(":")[0] for p in r[1:]] for r in test_rows]
+    true_states = [[p.split(":")[1] for p in r[1:]] for r in test_rows]
+    write_output(str(tmp_path / "obs"), [",".join(r) for r in obs_only])
+    cfg2 = JobConfig({"hmm.model.path": str(tmp_path / "hmm"),
+                      "skip.field.count": "1"})
+    ViterbiStatePredictor(cfg2).run(str(tmp_path / "obs"), str(tmp_path / "dec"))
+    correct = total = 0
+    for line, truth in zip(
+            open(str(tmp_path / "dec" / "part-r-00000")).read().splitlines(),
+            true_states):
+        got = line.split(",")[1:]
+        assert len(got) == len(truth)
+        correct += sum(g == t for g, t in zip(got, truth))
+        total += len(truth)
+    assert correct / total > 0.7  # strongly-peaked B makes decoding easy
+
+
+def test_hmm_partially_tagged(tmp_path):
+    cfg = JobConfig({
+        "model.states": "X,Y",
+        "model.observations": "a,b",
+        "partially.tagged": "true",
+        "window.function": "3,2,1",
+    })
+    write_output(str(tmp_path / "in"), ["a,X,b,b,Y,a"])
+    HiddenMarkovModelBuilder(cfg).run(str(tmp_path / "in"), str(tmp_path / "out"))
+    model = HiddenMarkovModel.load(str(tmp_path / "out"))
+    # one X->Y transition observed; Laplace corrects the zero cells
+    assert model.trans.shape == (2, 2)
+    assert model.initial.shape == (2,)
