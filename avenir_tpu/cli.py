@@ -48,6 +48,9 @@ JOBS: Dict[str, tuple] = {
     "org.avenir.explore.UnderSamplingBalancer": ("sampler", "UnderSamplingBalancer", ""),
     "org.avenir.discriminant.FisherDiscriminant": ("discriminant", "FisherDiscriminant", ""),
     "org.chombo.mr.NumericalAttrStats": ("discriminant", "NumericalAttrStats", ""),
+    "org.avenir.association.FrequentItemsApriori": ("association", "FrequentItemsApriori", "fia"),
+    "org.avenir.association.AssociationRuleMiner": ("association", "AssociationRuleMiner", "arm"),
+    "org.avenir.association.InfrequentItemMarker": ("association", "InfrequentItemMarker", "iim"),
 }
 
 

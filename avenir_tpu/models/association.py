@@ -1,0 +1,319 @@
+"""Association mining: Apriori frequent itemsets, rule miner, marker.
+
+Reference surface:
+- ``association.FrequentItemsApriori`` — one MR pass per itemset length k
+  (driven per resource/freq_items_apriori_tutorial.txt:37-46).  k=1: emit
+  each token -> transId|1 (FrequentItemsApriori.java:138-150).  k>1: for each
+  frequent (k-1)-itemset the transaction supports, extend by each new
+  non-marker item, sort, emit (:151-196); combiner/reducer union trans-id
+  sets or sum counts; support threshold strictly, support printed with 3
+  decimals (:306-342).  In count mode a candidate reached from m frequent
+  (k-1)-subsets is emitted m times per supporting transaction — that
+  multiplicity is part of the reference's observable output and is
+  reproduced here.
+- ``association.ItemSetList`` — text loader: items, [transIds,] support.
+- ``association.AssociationRuleMiner`` — per frequent itemset emits
+  antecedent sublists (size <= arm.max.ante.size) and computes
+  confidence = support(whole)/support(antecedent), strict threshold,
+  output ``a1,a2 -> c1,c2`` (AssociationRuleMiner.java:111-196).
+- ``association.InfrequentItemMarker`` — rewrites transactions replacing
+  items absent from the frequent 1-itemset list with a marker
+  (InfrequentItemMarker.java:77-150).
+
+TPU re-design (SURVEY §7.2 stage 3): the transaction set becomes a boolean
+incidence matrix ``inc[t, item]`` sharded over transactions.  The support of
+every candidate s ∪ {x} for all frequent (k-1)-itemsets s and all items x is
+ONE MXU matmul: ``co = v_s^T @ inc`` where ``v_s[t] = prod_{i in s} inc[t,i]``
+is the itemset-support indicator — the mapper's triple loop and the shuffle
+vanish into a [n_s, n_t] x [n_t, V] contraction with psum over the
+transaction shards.  Distinct-transaction semantics are inherent (boolean
+algebra); count-mode multiplicities are applied host-side.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..core.config import JobConfig
+from ..core.io import read_lines, split_line, write_output
+from ..core.metrics import Counters
+from ..parallel.mesh import get_mesh, pad_rows
+
+
+def _fmt_support(v: float) -> str:
+    """Utility.formatDouble(support, 3) equivalent."""
+    return f"{v:.3f}"
+
+
+class ItemSet:
+    """(items, transactionIds) pair (association/ItemSetList.java:65-101)."""
+
+    def __init__(self, items: Sequence[str], trans_ids: Sequence[str] = ()):
+        self.items = list(items)
+        self.transaction_ids = list(trans_ids)
+
+    def contains_item(self, item: str) -> bool:
+        return item in self.items
+
+    def contains_trans(self, trans_id: str) -> bool:
+        return trans_id in self.transaction_ids
+
+
+class ItemSetList:
+    """Loader for itemset output lines: items, [transIds,] support."""
+
+    def __init__(self, path: str, item_set_length: int,
+                 contains_trans_ids: bool, delim: str = ","):
+        self.item_sets: List[ItemSet] = []
+        for line in read_lines(path):
+            tokens = line.split(delim)
+            items = tokens[:item_set_length]
+            tids = tokens[item_set_length:-1] if contains_trans_ids else ()
+            self.item_sets.append(ItemSet(items, tids))
+
+    def get_item_set_list(self) -> List[ItemSet]:
+        return self.item_sets
+
+
+def _apriori_support_local(inc, sets_idx, mask):
+    """Per-shard candidate support: v = prod of candidate-member columns,
+    co = v^T @ inc (bf16 on the MXU), psum'd over transaction shards.
+
+    inc: [nt, V] uint8 (0/1 — transferred narrow, widened on device);
+    sets_idx: [n_s, k-1] int32 column ids; mask [nt].
+    """
+    incb = inc.astype(jnp.bfloat16)
+    v = jnp.prod(incb[:, sets_idx], axis=2)          # [nt, n_s]
+    v = v * mask[:, None].astype(jnp.bfloat16)
+    co = jax.lax.dot_general(
+        v, incb, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)          # [n_s, V]
+    return jax.lax.psum(co, "data")
+
+
+class FrequentItemsApriori:
+    """One Apriori pass (one k); config prefix ``fia``."""
+
+    def __init__(self, config: JobConfig):
+        self.config = config.with_prefix("fia") if not config.prefix else config
+
+    def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
+        counters = Counters()
+        cfg = self.config
+        delim_regex = cfg.field_delim_regex()
+        delim = cfg.field_delim_out()
+        skip = cfg.get_int("skip.field.count", 1)
+        k = cfg.must_int("item.set.length", "missing item set length")
+        trans_ord = cfg.must_int("tans.id.ord", "missing transaction id ordinal")
+        emit_trans_id = cfg.get_boolean("emit.trans.id", True)
+        threshold = cfg.must_float("support.threshold", "missing support threshold")
+        total_trans = cfg.must_int("total.tans.count", "missing total transaction count")
+        trans_id_output = cfg.get_boolean("trans.id.output", True)
+        marker = cfg.get("infreq.item.marker")
+
+        records = [split_line(l, delim_regex) for l in read_lines(in_path)]
+        trans_ids = [r[trans_ord] for r in records]
+        baskets = [[it for it in r[skip:] if it != marker] for r in records]
+
+        if k == 1:
+            lines = self._pass_one(baskets, trans_ids, emit_trans_id,
+                                   threshold, total_trans, trans_id_output,
+                                   delim)
+        else:
+            prev = ItemSetList(cfg.must("item.set.file.path"), k - 1,
+                               emit_trans_id, ",")
+            lines = self._pass_k(baskets, trans_ids, prev, k, emit_trans_id,
+                                 threshold, total_trans, trans_id_output,
+                                 delim, mesh)
+        write_output(out_path, lines)
+        counters.set("Apriori", "FrequentItemSets", len(lines))
+        return counters
+
+    # -- k == 1: token counting --------------------------------------------
+    def _pass_one(self, baskets, trans_ids, emit_trans_id, threshold,
+                  total_trans, trans_id_output, delim) -> List[str]:
+        token_counts: Dict[str, int] = {}
+        token_trans: Dict[str, Set[str]] = {}
+        for tid, basket in zip(trans_ids, baskets):
+            for it in basket:
+                if emit_trans_id:
+                    token_trans.setdefault(it, set()).add(tid)
+                else:
+                    # reference counts every token occurrence at k=1
+                    token_counts[it] = token_counts.get(it, 0) + 1
+        lines = []
+        keys = sorted(token_trans if emit_trans_id else token_counts)
+        for it in keys:
+            if emit_trans_id:
+                tids = sorted(token_trans[it])
+                cnt = len(tids)
+            else:
+                cnt = token_counts[it]
+            support = cnt / total_trans
+            if support > threshold:
+                if emit_trans_id:
+                    if trans_id_output:
+                        lines.append(delim.join([it] + tids +
+                                                [_fmt_support(support)]))
+                    else:
+                        lines.append(f"{it}{delim}{_fmt_support(support)}")
+                else:
+                    lines.append(f"{it}{delim}{cnt}{delim}{_fmt_support(support)}")
+        return lines
+
+    # -- k > 1: incidence matmul on device ---------------------------------
+    def _pass_k(self, baskets, trans_ids, prev: ItemSetList, k,
+                emit_trans_id, threshold, total_trans, trans_id_output,
+                delim, mesh) -> List[str]:
+        mesh = mesh or get_mesh()
+        # vocabulary over current items + previous itemset members
+        vocab: Dict[str, int] = {}
+        for b in baskets:
+            for it in b:
+                vocab.setdefault(it, len(vocab))
+        prev_sets = [s for s in prev.get_item_set_list()
+                     if all(it in vocab for it in s.items)]
+        if not prev_sets:
+            return []
+        V = len(vocab)
+        nt = len(baskets)
+        inc = np.zeros((nt, V), dtype=np.uint8)
+        for t, b in enumerate(baskets):
+            for it in b:
+                inc[t, vocab[it]] = 1.0
+        sets_idx = np.asarray(
+            [[vocab[it] for it in s.items] for s in prev_sets],
+            dtype=np.int32)                            # [n_s, k-1]
+
+        d = mesh.shape["data"]
+        inc_p, mask = pad_rows(inc, d)
+        fn = jax.jit(shard_map(
+            _apriori_support_local, mesh=mesh,
+            in_specs=(P("data"), P(), P("data")),
+            out_specs=P()))
+        co = np.asarray(fn(inc_p, sets_idx, mask))     # [n_s, V]
+
+        # merge duplicate candidates and compute count-mode multiplicities
+        inv = list(vocab)
+        distinct: Dict[Tuple[str, ...], int] = {}
+        multiplicity: Dict[Tuple[str, ...], int] = {}
+        prev_keys = {tuple(sorted(s.items)) for s in prev_sets}
+        for si, s in enumerate(prev_sets):
+            s_items = set(s.items)
+            for x in range(V):
+                if inv[x] in s_items:
+                    continue
+                cnt = int(round(co[si, x]))
+                if cnt <= 0:
+                    continue
+                cand = tuple(sorted(s.items + [inv[x]]))
+                distinct[cand] = cnt
+        for cand in distinct:
+            from itertools import combinations
+            m = sum(1 for sub in combinations(cand, k - 1)
+                    if tuple(sorted(sub)) in prev_keys)
+            multiplicity[cand] = m
+
+        lines = []
+        inc_bool = inc.astype(bool)
+        for cand in sorted(distinct):
+            cnt = distinct[cand]
+            if not emit_trans_id:
+                cnt = cnt * multiplicity[cand]
+            support = (distinct[cand] if emit_trans_id else cnt) / total_trans
+            if support > threshold:
+                if emit_trans_id:
+                    if trans_id_output:
+                        cols = [vocab[it] for it in cand]
+                        sel = inc_bool[:, cols].all(axis=1)
+                        tids = sorted(trans_ids[t] for t in np.nonzero(sel)[0])
+                        lines.append(delim.join(list(cand) + tids +
+                                                [_fmt_support(support)]))
+                    else:
+                        lines.append(delim.join(list(cand) +
+                                                [_fmt_support(support)]))
+                else:
+                    lines.append(delim.join(list(cand) +
+                                            [str(cnt), _fmt_support(support)]))
+        return lines
+
+
+class AssociationRuleMiner:
+    """Rules from frequent itemsets (+supports); config prefix ``arm``."""
+
+    def __init__(self, config: JobConfig):
+        self.config = config.with_prefix("arm") if not config.prefix else config
+
+    def run(self, in_path: str, out_path: str) -> Counters:
+        counters = Counters()
+        cfg = self.config
+        delim_regex = cfg.field_delim_regex()
+        max_ante = cfg.get_int("max.ante.size", 3)
+        conf_threshold = cfg.must_float("conf.threshold",
+                                        "missing confidence threshold")
+
+        supports: Dict[Tuple[str, ...], float] = {}
+        itemsets: List[Tuple[Tuple[str, ...], float]] = []
+        for line in read_lines(in_path):
+            tokens = split_line(line, delim_regex)
+            items = tuple(tokens[:-1])
+            support = float(tokens[-1])
+            supports[tuple(sorted(items))] = support
+            itemsets.append((items, support))
+
+        from itertools import combinations
+        out = []
+        for items, support in itemsets:
+            if len(items) <= 1:
+                continue
+            for size in range(1, min(max_ante, len(items) - 1) + 1):
+                for ante in combinations(items, size):
+                    ante_support = supports.get(tuple(sorted(ante)))
+                    if ante_support is None:
+                        continue  # antecedent itself not frequent
+                    confidence = support / ante_support
+                    if confidence > conf_threshold:
+                        cons = [it for it in items if it not in ante]
+                        out.append(",".join(ante) + " -> " + ",".join(cons))
+                        counters.incr("Rules", "Emitted")
+        write_output(out_path, out)
+        return counters
+
+
+class InfrequentItemMarker:
+    """Rewrite transactions, masking infrequent items; prefix ``iim``."""
+
+    def __init__(self, config: JobConfig):
+        self.config = config.with_prefix("iim") if not config.prefix else config
+
+    def run(self, in_path: str, out_path: str) -> Counters:
+        counters = Counters()
+        cfg = self.config
+        delim_regex = cfg.field_delim_regex()
+        delim_out = cfg.field_delim_out()
+        skip = cfg.get_int("skip.field.count", 1)
+        length = cfg.must_int("item.set.length", "missing item set length")
+        if length != 1:
+            raise ValueError("expecting item set of length 1")
+        contains_tid = cfg.get_boolean("contains.trans.id", True)
+        marker = cfg.get("infreq.item.marker", "*")
+        isl = ItemSetList(cfg.must("item.set.file.path"), 1, contains_tid,
+                          cfg.get("itemset.delim", ","))
+        freq = {s.items[0] for s in isl.get_item_set_list()}
+
+        out = []
+        for line in read_lines(in_path):
+            items = split_line(line, delim_regex)
+            for i in range(skip, len(items)):
+                if items[i] not in freq:
+                    items[i] = marker
+                    counters.incr("Marker", "Masked")
+            out.append(delim_out.join(items))
+        write_output(out_path, out)
+        return counters

@@ -69,9 +69,11 @@ def uncertainty_coeff(table: np.ndarray) -> float:
     e = t / total
     with np.errstate(divide="ignore", invalid="ignore"):
         terms = e * np.log10(e * coln[None, :] / rown[:, None])
-    # reference computes log10(0) -> -Inf * 0 -> NaN propagates; zero cells
-    # simply never occur there because HashMap entries exist only when
-    # counted -- skip them here for the same effective sum
+    # DELIBERATE deviation: the reference's dense int[][] table hits
+    # 0 * log10(0) = NaN on any never-co-occurring value pair and outputs
+    # NaN (ContingencyMatrix.java:165-185); we skip zero cells (the standard
+    # convention, and what its own MI job does for unobserved cells) so the
+    # coefficient stays finite
     sum_one = float(np.nansum(np.where(e > 0, terms, 0.0)))
     sum_two = float((coln * np.log10(coln)).sum())
     return sum_one / sum_two

@@ -107,7 +107,9 @@ class FisherDiscriminant:
             for cond, row in rows:
                 body = delim.join(str(v) for v in row)
                 out.append(f"{a}{delim}{cond}{delim}{body}")
-            # the two class-conditional rows in first-seen order
+            # the two class-conditional rows in sorted-value order — the MR
+            # shuffle delivers keys sorted, so c0/c1 assignment follows the
+            # sorted class values (flipping it would negate logOddsPrior)
             cls = [(cond, row) for cond, row in rows if cond != "0"]
             if len(cls) != 2:
                 raise ValueError(

@@ -115,6 +115,8 @@ class MarkovStateTransitionModel:
         records = [split_line(l, delim_regex) for l in read_lines(in_path)]
         # class label occupies one leading field when present (:107-109)
         eff_skip = skip + (1 if class_ord >= 0 else 0)
+        # reference mapper skips rows too short to hold a transition (:119)
+        records = [r for r in records if len(r) >= eff_skip + 2]
         class_labels: List[str] = []
         cls_idx = np.zeros(len(records), dtype=np.int32)
         if class_ord >= 0:
@@ -209,7 +211,7 @@ class MarkovModelClassifier:
         records = [split_line(l, delim_regex) for l in read_lines(in_path)]
         usable = [r for r in records if len(r) >= skip + 2]
         seq, _ = encode_sequences(usable, skip, model.index)
-        frm, to = seq[:, :-1], seq[:, 1:]
+        frm, to = _transition_pairs(seq)
         valid = (frm >= 0) & (to >= 0)
 
         t0 = jnp.asarray(model.class_trans[class_labels[0]])

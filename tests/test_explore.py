@@ -166,6 +166,32 @@ def test_mutual_information(tmp_path, mesh8):
     assert scores == sorted(scores, reverse=True)
 
 
+def test_mi_counts_rows_beyond_declared_max(tmp_path, mesh8):
+    """Values past the schema's declared max must still be counted: the
+    encoder sizes bins to max(declared, observed), so no record is silently
+    dropped from the distributions (the reference's string-keyed HashMaps
+    count everything)."""
+    schema = {"fields": [
+        {"name": "v", "ordinal": 0, "dataType": "int", "feature": True,
+         "min": 0, "max": 10, "bucketWidth": 5},
+        {"name": "w", "ordinal": 1, "dataType": "categorical", "feature": True},
+        {"name": "c", "ordinal": 2, "dataType": "categorical",
+         "cardinality": ["A", "B"]}]}
+    spath = str(tmp_path / "s.json")
+    with open(spath, "w") as f:
+        json.dump(schema, f)
+    # 95 is way past max=10 -> bin 19 beyond the declared 3 bins
+    write_output(str(tmp_path / "in"), ["95,p,A", "3,q,B", "7,p,A"])
+    MutualInformation(JobConfig({"feature.schema.file.path": spath})).run(
+        str(tmp_path / "in"), str(tmp_path / "out"), mesh=mesh8)
+    lines = open(str(tmp_path / "out" / "part-r-00000")).read().splitlines()
+    cls = lines[lines.index("distribution:class") + 1:
+                lines.index("distribution:feature")]
+    got = {l.split(",")[0]: float(l.split(",")[1]) for l in cls}
+    assert abs(got["A"] - 2 / 3) < 1e-12     # all 3 rows counted
+    assert any(l.startswith("0,19,") for l in lines)  # the out-of-range bin
+
+
 def test_cramer_and_heterogeneity(tmp_path, mesh8):
     # two perfectly-correlated categoricals and one independent
     rng = np.random.default_rng(3)
