@@ -48,6 +48,10 @@ JOBS: Dict[str, tuple] = {
     "org.avenir.explore.UnderSamplingBalancer": ("sampler", "UnderSamplingBalancer", ""),
     "org.avenir.discriminant.FisherDiscriminant": ("discriminant", "FisherDiscriminant", ""),
     "org.chombo.mr.NumericalAttrStats": ("discriminant", "NumericalAttrStats", ""),
+    "org.avenir.explore.ClassPartitionGenerator": ("tree", "ClassPartitionGenerator", ""),
+    "org.avenir.tree.SplitGenerator": ("tree", "SplitGenerator", ""),
+    "org.avenir.tree.DecisionTreeBuilder": ("tree", "DecisionTreeBuilder", "dtb"),
+    "org.avenir.tree.DataPartitioner": ("tree", "DataPartitioner", ""),
     "org.avenir.association.FrequentItemsApriori": ("association", "FrequentItemsApriori", "fia"),
     "org.avenir.association.AssociationRuleMiner": ("association", "AssociationRuleMiner", "arm"),
     "org.avenir.association.InfrequentItemMarker": ("association", "InfrequentItemMarker", "iim"),

@@ -1,0 +1,344 @@
+"""Stage-4 tree family: split enumeration, split stats, ClassPartitionGenerator,
+DecisionTreeBuilder, DataPartitioner — oracle checks per SURVEY §4."""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core import JobConfig, write_output
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.models.split import (AttributePredicate, Split,
+                                     categorical_partitions,
+                                     class_confidence_split_stat,
+                                     hellinger_split_stat, info_content,
+                                     point_partitions, segment_predicates,
+                                     split_info_content, weighted_split_stat)
+from avenir_tpu.models.tree import (ClassPartitionGenerator, DataPartitioner,
+                                    DecisionPathList, DecisionTreeBuilder)
+
+TREE_SCHEMA = {
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "color", "ordinal": 1, "dataType": "categorical",
+         "feature": True, "cardinality": ["red", "green", "blue"],
+         "maxSplit": 2},
+        {"name": "size", "ordinal": 2, "dataType": "int", "feature": True,
+         "min": 0, "max": 100, "bucketWidth": 25, "splitScanInterval": 25,
+         "maxSplit": 3},
+        {"name": "label", "ordinal": 3, "dataType": "categorical",
+         "cardinality": ["N", "Y"]},
+    ]
+}
+
+
+def _schema():
+    return FeatureSchema.from_json(json.dumps(TREE_SCHEMA))
+
+
+# ---------------------------------------------------------------------------
+# enumeration
+# ---------------------------------------------------------------------------
+
+def test_point_partitions_grid():
+    parts = point_partitions(0, 100, 25, 3, integer=True)
+    assert set(parts) == {(25,), (50,), (75,), (25, 50), (25, 75), (50, 75)}
+    # max_split=2 limits to single points
+    assert set(point_partitions(0, 100, 25, 2, integer=True)) == {
+        (25,), (50,), (75,)}
+    # degenerate interval adjustment: interval > range -> midpoint
+    assert point_partitions(0.0, 10.0, 20.0, 2, integer=False) == [(5.0,)]
+
+
+def test_categorical_partitions_cover():
+    # 3 values, 2 groups -> Stirling S(3,2)=3 bipartitions
+    parts = categorical_partitions(["a", "b", "c"], 2)
+    canon = {tuple(sorted(tuple(sorted(g)) for g in sp)) for sp in parts}
+    assert canon == {
+        (("a",), ("b", "c")), (("a", "b"), ("c",)), (("a", "c"), ("b",))}
+    # every enumerated split is a disjoint cover
+    for sp in parts:
+        flat = [v for g in sp for v in g]
+        assert sorted(flat) == ["a", "b", "c"]
+    # 4 values, 2 groups -> S(4,2)=7
+    assert len(categorical_partitions(list("abcd"), 2)) == 7
+    # 4 values, 3 groups -> S(4,3)=6
+    assert len(categorical_partitions(list("abcd"), 3)) == 6
+
+
+def test_segment_predicates_reference_overlap():
+    """SplitManager.createIntAttrPredicates gives the last point an
+    unbounded `le` (SplitManager.java:563-576) — parity check."""
+    sch = _schema()
+    field = sch.field_by_ordinal(2)
+    sp = Split(2, points=(30, 60))
+    preds = segment_predicates(sp, field)
+    assert [p.to_string() for p in preds] == ["2 le 30", "2 le 60", "2 gt 60"]
+    col = np.asarray([10.0, 40.0, 90.0])
+    mats = np.stack([p.evaluate(col) for p in preds])
+    # value 10 satisfies BOTH le-30 and le-60 (the documented overlap)
+    assert mats[:, 0].tolist() == [True, True, False]
+    assert mats[:, 1].tolist() == [False, True, False]
+    assert mats[:, 2].tolist() == [False, False, True]
+
+    single = segment_predicates(Split(2, points=(50,)), field)
+    assert [p.to_string() for p in single] == ["2 le 50", "2 gt 50"]
+
+    cat = segment_predicates(
+        Split(1, groups=[["red"], ["green", "blue"]]), sch.field_by_ordinal(1))
+    assert [p.to_string() for p in cat] == ["1 in red", "1 in green:blue"]
+    assert cat[1].evaluate(np.asarray(["red", "blue"], dtype=object)).tolist() \
+        == [False, True]
+
+
+def test_predicate_parse_roundtrip():
+    sch = _schema()
+    for s in ["2 le 30", "2 le 60 30", "2 gt 60"]:
+        assert AttributePredicate.parse(s, sch.field_by_ordinal(2)).to_string() == s
+    s = "1 in red:blue"
+    assert AttributePredicate.parse(s, sch.field_by_ordinal(1)).to_string() == s
+
+
+def test_split_segment_index():
+    sp = Split(2, points=(30, 60))
+    seg = sp.segment_index(np.asarray([10.0, 30.0, 31.0, 60.0, 61.0]))
+    # reference loop: first i with value <= point (strict > advances)
+    assert seg.tolist() == [0, 0, 1, 1, 2]
+    cat = Split(1, groups=[["red"], ["green", "blue"]])
+    seg = cat.segment_index(np.asarray(["green", "red", "blue"], dtype=object))
+    assert seg.tolist() == [1, 0, 1]
+    # round trip via key
+    sch = _schema()
+    assert Split.from_key(2, sp.key, sch.field_by_ordinal(2)).points == (30, 60)
+    parsed = Split.from_key(1, cat.key, sch.field_by_ordinal(1))
+    assert parsed.groups == [["red"], ["green", "blue"]]
+
+
+# ---------------------------------------------------------------------------
+# split statistics vs hand oracles
+# ---------------------------------------------------------------------------
+
+def test_info_content_oracle():
+    counts = np.asarray([8, 8])
+    assert info_content(counts, "entropy") == pytest.approx(1.0)
+    assert info_content(counts, "giniIndex") == pytest.approx(0.5)
+    assert info_content(np.asarray([4, 0]), "entropy") == pytest.approx(0.0)
+    assert info_content(np.asarray([3, 1]), "giniIndex") == pytest.approx(
+        1 - (0.75 ** 2 + 0.25 ** 2))
+
+
+def test_weighted_split_stat_oracle():
+    seg = np.asarray([[4, 0], [2, 2]])
+    # weighted: (0*4 + 1*4)/8
+    assert weighted_split_stat(seg, "entropy") == pytest.approx(0.5)
+    assert split_info_content(seg) == pytest.approx(1.0)  # 4/4 segment split
+
+
+def test_hellinger_oracle():
+    seg = np.asarray([[9, 1], [1, 9]])
+    v0 = math.sqrt(0.9) - math.sqrt(0.1)
+    expect = math.sqrt(2 * v0 * v0)
+    assert hellinger_split_stat(seg) == pytest.approx(expect)
+    with pytest.raises(ValueError):
+        hellinger_split_stat(np.asarray([[1, 1, 1]]))
+
+
+def test_class_confidence_oracle():
+    seg = np.asarray([[5, 5], [5, 5]])
+    # confidences all 0.5 -> ratios 0.5 -> entropy 1 per segment
+    assert class_confidence_split_stat(seg) == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# ClassPartitionGenerator end-to-end vs brute-force oracle
+# ---------------------------------------------------------------------------
+
+def _gen_rows(n=160, seed=7):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        color = rng.choice(["red", "green", "blue"])
+        size = int(rng.integers(0, 100))
+        # plant: size>50 mostly Y, red mostly Y
+        p = 0.15 + 0.5 * (size > 50) + 0.25 * (color == "red")
+        label = "Y" if rng.random() < p else "N"
+        rows.append([f"R{i}", color, str(size), label])
+    return rows
+
+
+def test_class_partition_generator_at_root(tmp_path, mesh8):
+    rows = _gen_rows()
+    write_output(str(tmp_path / "in"), [",".join(r) for r in rows])
+    sch_path = tmp_path / "schema.json"
+    sch_path.write_text(json.dumps(TREE_SCHEMA))
+    cfg = JobConfig({"feature.schema.file.path": str(sch_path),
+                     "at.root": "true", "split.algorithm": "entropy"})
+    ClassPartitionGenerator(cfg).run(str(tmp_path / "in"),
+                                     str(tmp_path / "root"), mesh=mesh8)
+    stat = float(open(tmp_path / "root" / "part-r-00000").read().strip())
+    y = np.asarray([r[3] == "Y" for r in rows])
+    p = y.mean()
+    expect = -(p * math.log2(p) + (1 - p) * math.log2(1 - p))
+    assert stat == pytest.approx(expect, abs=1e-9)
+
+
+def test_class_partition_generator_gains(tmp_path, mesh8):
+    rows = _gen_rows()
+    write_output(str(tmp_path / "in"), [",".join(r) for r in rows])
+    sch_path = tmp_path / "schema.json"
+    sch_path.write_text(json.dumps(TREE_SCHEMA))
+    cfg = JobConfig({
+        "feature.schema.file.path": str(sch_path),
+        "split.algorithm": "entropy",
+        "split.attributes": "1,2",
+        "parent.info": "0.9",
+    })
+    ClassPartitionGenerator(cfg).run(str(tmp_path / "in"),
+                                     str(tmp_path / "out"), mesh=mesh8)
+    lines = open(tmp_path / "out" / "part-r-00000").read().splitlines()
+    got = {}
+    for line in lines:
+        attr, rest = line.split(",", 1)
+        key, val = rest.rsplit(",", 1)   # cat keys contain ", " internally
+        got[(int(attr), key)] = float(val)
+
+    # brute-force oracle over every candidate split
+    sch = _schema()
+    from avenir_tpu.models.split import enumerate_attr_splits
+    for attr in (1, 2):
+        field = sch.field_by_ordinal(attr)
+        col = np.asarray([r[attr] for r in rows], dtype=object) if attr == 1 \
+            else np.asarray([float(r[attr]) for r in rows])
+        y = np.asarray([r[3] == "Y" for r in rows])
+        for sp in enumerate_attr_splits(field, use_bucket_grid=True):
+            seg = sp.segment_index(col)
+            table = np.zeros((sp.segment_count, 2))
+            for s, c in zip(seg, y.astype(int)):
+                table[s, c] += 1
+            stat = weighted_split_stat(table, "entropy")
+            gain = 0.9 - stat
+            denom = split_info_content(table)
+            expect = gain / denom if denom else 0.0
+            assert got[(attr, sp.key)] == pytest.approx(expect, abs=1e-9), sp.key
+    # size>50 single-point split should be the best numeric candidate
+    best = max((k for k in got if k[0] == 2), key=lambda k: got[k])
+    assert best[1] == "50"
+
+
+# ---------------------------------------------------------------------------
+# DecisionTreeBuilder
+# ---------------------------------------------------------------------------
+
+def _dtb_config(tmp_path, **extra):
+    sch_path = tmp_path / "schema.json"
+    sch_path.write_text(json.dumps(TREE_SCHEMA))
+    props = {
+        "feature.schema.file.path": str(sch_path),
+        "decision.file.path": str(tmp_path / "decpath.json"),
+        "split.algorithm": "entropy",
+        "path.stopping.strategy": "maxDepth",
+        "max.depth.limit": "2",
+        "sub.sampling.strategy": "none",
+        "seed": "11",
+    }
+    props.update(extra)
+    return JobConfig(props)
+
+
+def test_decision_tree_root_and_level(tmp_path, mesh8):
+    rows = _gen_rows()
+    write_output(str(tmp_path / "in"), [",".join(r) for r in rows])
+    cfg = _dtb_config(tmp_path)
+    builder = DecisionTreeBuilder(cfg)
+
+    builder.run(str(tmp_path / "in"), str(tmp_path / "lvl0"), mesh=mesh8)
+    dpl = DecisionPathList.from_file(str(tmp_path / "decpath.json"))
+    assert len(dpl.paths) == 1
+    root = dpl.paths[0]
+    assert root.predicate_strs == ["$root"]
+    assert root.population == len(rows)
+    out0 = open(tmp_path / "lvl0" / "part-r-00000").read().splitlines()
+    assert all(l.startswith("$root,") for l in out0)
+    assert len(out0) == len(rows)
+
+    builder.run(str(tmp_path / "lvl0"), str(tmp_path / "lvl1"), mesh=mesh8)
+    dpl = DecisionPathList.from_file(str(tmp_path / "decpath.json"))
+    # children all share one selected attribute
+    attrs = {p.predicate_strs[0].split()[0] for p in dpl.paths}
+    assert len(attrs) == 1
+    # populations: each child's population equals the record count its
+    # predicate matches (oracle)
+    sch = _schema()
+    for p in dpl.paths:
+        pred = AttributePredicate.parse(
+            p.predicate_strs[0], sch.field_by_ordinal(int(p.predicate_strs[0].split()[0])))
+        field = sch.field_by_ordinal(pred.attr)
+        col = np.asarray([r[pred.attr] for r in rows], dtype=object) \
+            if field.is_categorical() \
+            else np.asarray([float(r[pred.attr]) for r in rows])
+        assert p.population == int(pred.evaluate(col).sum())
+        # depth-1 children are below the depth-2 limit
+        assert not p.stopped
+    # output lines carry extended paths, all resolvable in the new JSON
+    out1 = open(tmp_path / "lvl1" / "part-r-00000").read().splitlines()
+    assert out1 and all("," in l for l in out1)
+    known = {p.path_str for p in dpl.paths}
+    assert all(l.split(",", 1)[0] in known for l in out1)
+
+
+def test_decision_tree_run_loop_terminates(tmp_path, mesh8):
+    rows = _gen_rows(n=80)
+    write_output(str(tmp_path / "in"), [",".join(r) for r in rows])
+    cfg = _dtb_config(tmp_path)
+    builder = DecisionTreeBuilder(cfg)
+    dpl = builder.run_loop(str(tmp_path / "in"), str(tmp_path / "work"),
+                           max_levels=5, mesh=mesh8)
+    assert dpl.all_stopped()
+    assert all(p.depth() <= 2 for p in dpl.paths)
+
+
+def test_decision_tree_random_forest_sampling(tmp_path, mesh8):
+    rows = _gen_rows(n=100)
+    write_output(str(tmp_path / "in"), [",".join(r) for r in rows])
+    cfg = _dtb_config(
+        tmp_path, **{"sub.sampling.strategy": "withReplace",
+                     "sub.sampling.buffer.size": "40",
+                     "split.attribute.selection.strategy": "randomNotUsedYet",
+                     "random.split.set.size": "1"})
+    builder = DecisionTreeBuilder(cfg)
+    builder.run(str(tmp_path / "in"), str(tmp_path / "lvl0"), mesh=mesh8)
+    out0 = open(tmp_path / "lvl0" / "part-r-00000").read().splitlines()
+    assert len(out0) == len(rows)            # bootstrap preserves count
+    assert len(set(out0)) < len(rows)        # with duplicates (w.h.p.)
+
+
+# ---------------------------------------------------------------------------
+# DataPartitioner
+# ---------------------------------------------------------------------------
+
+def test_data_partitioner(tmp_path):
+    rows = _gen_rows(n=60)
+    node = tmp_path / "base" / "split=root" / "data"
+    os.makedirs(node)
+    (node / "partition.txt").write_text(
+        "\n".join(",".join(r) for r in rows) + "\n")
+    splits_dir = tmp_path / "base" / "split=root" / "splits"
+    os.makedirs(splits_dir)
+    # candidate lines attr;splitKey;stat — best is the size<=50 split
+    (splits_dir / "part-r-00000").write_text(
+        "2;50;0.9\n2;25:75;0.4\n1;[red]:[green, blue];0.2\n")
+    sch_path = tmp_path / "schema.json"
+    sch_path.write_text(json.dumps(TREE_SCHEMA))
+    cfg = JobConfig({
+        "feature.schema.file.path": str(sch_path),
+        "project.base.path": str(tmp_path / "base"),
+    })
+    DataPartitioner(cfg).run()
+    out = node / "split=0"
+    seg0 = open(out / "segment=0" / "data" / "partition.txt").read().splitlines()
+    seg1 = open(out / "segment=1" / "data" / "partition.txt").read().splitlines()
+    assert len(seg0) + len(seg1) == len(rows)
+    assert all(float(l.split(",")[2]) <= 50 for l in seg0)
+    assert all(float(l.split(",")[2]) > 50 for l in seg1)
