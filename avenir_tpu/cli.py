@@ -52,6 +52,10 @@ JOBS: Dict[str, tuple] = {
     "org.avenir.tree.SplitGenerator": ("tree", "SplitGenerator", ""),
     "org.avenir.tree.DecisionTreeBuilder": ("tree", "DecisionTreeBuilder", "dtb"),
     "org.avenir.tree.DataPartitioner": ("tree", "DataPartitioner", ""),
+    "org.sifarish.feature.SameTypeSimilarity": ("knn", "SameTypeSimilarity", ""),
+    "org.avenir.knn.FeatureCondProbJoiner": ("knn", "FeatureCondProbJoiner", ""),
+    "org.avenir.knn.NearestNeighbor": ("knn", "NearestNeighbor", ""),
+    "org.avenir.cluster.AgglomerativeGraphical": ("cluster", "AgglomerativeGraphical", ""),
     "org.avenir.association.FrequentItemsApriori": ("association", "FrequentItemsApriori", "fia"),
     "org.avenir.association.AssociationRuleMiner": ("association", "AssociationRuleMiner", "arm"),
     "org.avenir.association.InfrequentItemMarker": ("association", "InfrequentItemMarker", "iim"),

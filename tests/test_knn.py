@@ -1,0 +1,379 @@
+"""Stage-5 kNN + clustering: distance kernel vs numpy oracle, full pipeline
+(distance -> join -> classify), kernels, regression, greedy clustering."""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core import JobConfig, write_output
+from avenir_tpu.models.cluster import (AgglomerativeGraphical,
+                                       EntityDistanceStore)
+from avenir_tpu.models.knn import (FeatureCondProbJoiner, NearestNeighbor,
+                                   Neighborhood, SameTypeSimilarity)
+from avenir_tpu.ops.distance import pairwise_distances
+
+KNN_SCHEMA = {
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "x1", "ordinal": 1, "dataType": "int", "feature": True,
+         "min": 0, "max": 100},
+        {"name": "x2", "ordinal": 2, "dataType": "int", "feature": True,
+         "min": 0, "max": 100},
+        {"name": "grp", "ordinal": 3, "dataType": "categorical",
+         "feature": True, "cardinality": ["a", "b"]},
+        {"name": "label", "ordinal": 4, "dataType": "categorical",
+         "cardinality": ["N", "Y"]},
+    ]
+}
+
+
+def _write_schema(tmp_path):
+    p = tmp_path / "schema.json"
+    p.write_text(json.dumps(KNN_SCHEMA))
+    return str(p)
+
+
+def _make_points(n, seed=0):
+    """Two gaussian blobs: class Y near (80,80,'a'), N near (20,20,'b')."""
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        if i % 2:
+            cx, cy, g, lbl = 80, 80, "a", "Y"
+        else:
+            cx, cy, g, lbl = 20, 20, "b", "N"
+        x1 = int(np.clip(rng.normal(cx, 8), 0, 100))
+        x2 = int(np.clip(rng.normal(cy, 8), 0, 100))
+        rows.append([f"E{i}", str(x1), str(x2), g, lbl])
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# distance kernel vs numpy oracle
+# ---------------------------------------------------------------------------
+
+def test_pairwise_distances_oracle(mesh8):
+    rng = np.random.default_rng(1)
+    qnum = rng.uniform(0, 1, (13, 3))
+    tnum = rng.uniform(0, 1, (9, 3))
+    qcat = rng.integers(0, 3, (13, 2)).astype(np.int32)
+    tcat = rng.integers(0, 3, (9, 2)).astype(np.int32)
+    nw = np.asarray([1.0, 2.0, 1.0])
+    cw = np.asarray([1.0, 3.0])
+
+    dist, idx = pairwise_distances(qnum, qcat, tnum, tcat, nw, cw,
+                                   algorithm="euclidean", scale=1000,
+                                   mesh=mesh8)
+    assert idx is None
+    wsum = nw.sum() + cw.sum()
+    for i in range(13):
+        for j in range(9):
+            d2 = (nw * (qnum[i] - tnum[j]) ** 2).sum() \
+                + (cw * (qcat[i] != tcat[j])).sum()
+            expect = int(math.sqrt(d2 / wsum) * 1000)
+            assert abs(int(dist[i, j]) - expect) <= 1, (i, j)
+
+    # manhattan
+    dist_m, _ = pairwise_distances(qnum, qcat, tnum, tcat, nw, cw,
+                                   algorithm="manhattan", scale=1000,
+                                   mesh=mesh8)
+    for i in range(5):
+        for j in range(5):
+            d = (nw * np.abs(qnum[i] - tnum[j])).sum() \
+                + (cw * (qcat[i] != tcat[j])).sum()
+            expect = int(d / wsum * 1000)
+            assert abs(int(dist_m[i, j]) - expect) <= 1
+
+    # top_k returns ascending nearest neighbors
+    dk, ik = pairwise_distances(qnum, qcat, tnum, tcat, nw, cw,
+                                top_k=3, mesh=mesh8)
+    for i in range(13):
+        order = np.argsort(dist[i], kind="stable")[:3]
+        assert sorted(dk[i].tolist()) == dk[i].tolist()
+        assert set(ik[i].tolist()) == set(order.tolist())
+
+
+def test_pairwise_single_vs_multi_device(mesh8, mesh1):
+    rng = np.random.default_rng(2)
+    qnum = rng.uniform(0, 1, (11, 2))
+    tnum = rng.uniform(0, 1, (7, 2))
+    empty_cat = np.zeros((11, 0), dtype=np.int32)
+    empty_cat_t = np.zeros((7, 0), dtype=np.int32)
+    w = np.ones(2)
+    cw = np.zeros(0)
+    d8, _ = pairwise_distances(qnum, empty_cat, tnum, empty_cat_t, w, cw,
+                               mesh=mesh8)
+    d1, _ = pairwise_distances(qnum, empty_cat, tnum, empty_cat_t, w, cw,
+                               mesh=mesh1)
+    assert np.array_equal(d8, d1)
+
+
+# ---------------------------------------------------------------------------
+# SameTypeSimilarity job surface
+# ---------------------------------------------------------------------------
+
+def test_same_type_similarity_job(tmp_path, mesh8):
+    train = _make_points(20, seed=3)
+    test = _make_points(6, seed=4)
+    os.makedirs(tmp_path / "inp")
+    (tmp_path / "inp" / "tr_data.txt").write_text(
+        "\n".join(",".join(r) for r in train) + "\n")
+    (tmp_path / "inp" / "test_data.txt").write_text(
+        "\n".join(",".join(r) for r in test) + "\n")
+    cfg = JobConfig({
+        "feature.schema.file.path": _write_schema(tmp_path),
+        "base.set.split.prefix": "tr",
+        "distance.scale": "1000",
+    })
+    SameTypeSimilarity(cfg).run(str(tmp_path / "inp"),
+                                str(tmp_path / "simi"), mesh=mesh8)
+    lines = open(tmp_path / "simi" / "part-r-00000").read().splitlines()
+    assert len(lines) == 20 * 6
+    items = lines[0].split(",")
+    assert len(items) == 5                       # train,test,dist,trCls,teCls
+    assert items[0].startswith("E") and items[2].isdigit()
+    # same-class pairs should be nearer on average (planted blobs)
+    same, diff = [], []
+    for l in lines:
+        it = l.split(",")
+        (same if it[3] == it[4] else diff).append(int(it[2]))
+    assert np.mean(same) < np.mean(diff)
+
+
+def test_same_type_similarity_top_k(tmp_path, mesh8):
+    train = _make_points(30, seed=5)
+    test = _make_points(4, seed=6)
+    os.makedirs(tmp_path / "inp")
+    (tmp_path / "inp" / "tr.txt").write_text(
+        "\n".join(",".join(r) for r in train) + "\n")
+    (tmp_path / "inp" / "te.txt").write_text(
+        "\n".join(",".join(r) for r in test) + "\n")
+    cfg = JobConfig({
+        "feature.schema.file.path": _write_schema(tmp_path),
+        "output.top.matches": "5",
+    })
+    SameTypeSimilarity(cfg).run(str(tmp_path / "inp"),
+                                str(tmp_path / "simi"), mesh=mesh8)
+    lines = open(tmp_path / "simi" / "part-r-00000").read().splitlines()
+    assert len(lines) == 4 * 5
+
+
+# ---------------------------------------------------------------------------
+# Neighborhood kernels (integer parity with Neighborhood.java:126-160)
+# ---------------------------------------------------------------------------
+
+def test_neighborhood_kernels():
+    nb = Neighborhood("none")
+    assert nb.scores(np.asarray([5, 0])).tolist() == [1, 1]
+    nb = Neighborhood("linearMultiplicative")
+    assert nb.scores(np.asarray([0, 3, 200])).tolist() == [200, 33, 0]
+    nb = Neighborhood("linearAdditive")
+    assert nb.scores(np.asarray([30, 100])).tolist() == [70, 0]
+    nb = Neighborhood("gaussian", kernel_param=50)
+    assert nb.scores(np.asarray([0])).tolist() == [100]
+    assert nb.scores(np.asarray([50])).tolist() == [int(100 * math.exp(-0.5))]
+    with pytest.raises(ValueError):
+        Neighborhood("sigmoid").scores(np.asarray([1]))
+
+
+def test_neighborhood_weighted_scores():
+    nb = Neighborhood("none", class_cond_weighted=True,
+                      inverse_distance_weighted=True)
+    w = nb.weighted_scores(np.asarray([1, 1]), np.asarray([2, 4]),
+                           np.asarray([0.5, -1.0]))
+    assert w[0] == pytest.approx(0.25)    # 1 * 0.5 / 2
+    assert w[1] == pytest.approx(0.25)    # post<=0 -> score alone, / 4
+
+
+# ---------------------------------------------------------------------------
+# NearestNeighbor classifier end-to-end
+# ---------------------------------------------------------------------------
+
+def test_nearest_neighbor_classification(tmp_path, mesh8):
+    train = _make_points(40, seed=7)
+    test = _make_points(10, seed=8)
+    os.makedirs(tmp_path / "inp")
+    (tmp_path / "inp" / "tr.txt").write_text(
+        "\n".join(",".join(r) for r in train) + "\n")
+    (tmp_path / "inp" / "te.txt").write_text(
+        "\n".join(",".join(r) for r in test) + "\n")
+    schema = _write_schema(tmp_path)
+    SameTypeSimilarity(JobConfig({"feature.schema.file.path": schema})).run(
+        str(tmp_path / "inp"), str(tmp_path / "simi"), mesh=mesh8)
+    cfg = JobConfig({
+        "feature.schema.file.path": schema,
+        "top.match.count": "5",
+        "validation.mode": "true",
+        "kernel.function": "none",
+    })
+    counters = NearestNeighbor(cfg).run(str(tmp_path / "simi"),
+                                        str(tmp_path / "pred"))
+    lines = open(tmp_path / "pred" / "part-r-00000").read().splitlines()
+    assert len(lines) == 10
+    correct = sum(1 for l in lines
+                  if l.split(",")[-1] == l.split(",")[-2])
+    assert correct >= 9          # planted blobs are trivially separable
+    assert counters.get("Validation", "TruePositive") \
+        + counters.get("Validation", "TrueNagative") == correct
+
+
+def test_nearest_neighbor_class_cond_weighted_pipeline(tmp_path, mesh8):
+    """Full join pipeline: distance + NB feature-posterior -> joiner -> kNN
+    (resource/knn.sh joinFeatureDistr + knnClassifier)."""
+    train = _make_points(30, seed=9)
+    test = _make_points(8, seed=10)
+    os.makedirs(tmp_path / "inp")
+    (tmp_path / "inp" / "tr.txt").write_text(
+        "\n".join(",".join(r) for r in train) + "\n")
+    (tmp_path / "inp" / "te.txt").write_text(
+        "\n".join(",".join(r) for r in test) + "\n")
+    schema = _write_schema(tmp_path)
+    SameTypeSimilarity(JobConfig({"feature.schema.file.path": schema})).run(
+        str(tmp_path / "inp"), str(tmp_path / "simi"), mesh=mesh8)
+
+    # fake NB output.feature.prob.only lines: id, featPrior, N, pN, Y, pY, actual
+    prob_lines = []
+    for r in train:
+        p_y = 0.9 if r[4] == "Y" else 0.2
+        prob_lines.append(
+            f"{r[0]},0.01,N,{1 - p_y},Y,{p_y},{r[4]}")
+    os.makedirs(tmp_path / "pprob")
+    (tmp_path / "pprob" / "prDistr-r-00000").write_text(
+        "\n".join(prob_lines) + "\n")
+
+    jcfg = JobConfig({"feature.cond.prob.split.prefix": "prDistr"})
+    FeatureCondProbJoiner(jcfg).run(
+        f"{tmp_path}/simi,{tmp_path}/pprob", str(tmp_path / "join"))
+    jlines = open(tmp_path / "join" / "part-r-00000").read().splitlines()
+    assert len(jlines) == 30 * 8
+    it = jlines[0].split(",")
+    assert len(it) == 6 and it[4] in ("N", "Y")
+
+    cfg = JobConfig({
+        "feature.schema.file.path": schema,
+        "top.match.count": "5",
+        "validation.mode": "true",
+        "class.condtion.weighted": "true",   # reference spelling
+        "inverse.distance.weighted": "true",
+    })
+    NearestNeighbor(cfg).run(str(tmp_path / "join"), str(tmp_path / "pred"))
+    lines = open(tmp_path / "pred" / "part-r-00000").read().splitlines()
+    assert len(lines) == 8
+    correct = sum(1 for l in lines
+                  if l.split(",")[-1] == l.split(",")[-2])
+    assert correct >= 7
+
+
+def test_nearest_neighbor_regression(tmp_path):
+    # pair lines: trainId, testId, dist, trainTarget(int), [testActual]
+    lines = []
+    for i, (d, target) in enumerate([(10, 100), (20, 200), (30, 300),
+                                     (99, 900)]):
+        lines.append(f"T{i},Q0,{d},{target},0")
+    write_output(str(tmp_path / "in"), lines)
+    cfg = JobConfig({
+        "prediction.mode": "regression",
+        "regression.method": "average",
+        "top.match.count": "3",
+        "validation.mode": "true",
+    })
+    NearestNeighbor(cfg).run(str(tmp_path / "in"), str(tmp_path / "out"))
+    out = open(tmp_path / "out" / "part-r-00000").read().splitlines()
+    assert out[0].split(",")[-1] == "200"    # (100+200+300)/3
+
+    cfg.set("regression.method", "median")
+    NearestNeighbor(cfg).run(str(tmp_path / "in"), str(tmp_path / "out2"))
+    out = open(tmp_path / "out2" / "part-r-00000").read().splitlines()
+    assert out[0].split(",")[-1] == "200"
+
+
+def test_nearest_neighbor_decision_threshold(tmp_path):
+    # 3 Y vs 2 N among top 5: plain argmax says Y; threshold 2.0 demands
+    # pos/neg > 2 -> predicts N
+    cfg = JobConfig({
+        "top.match.count": "5", "validation.mode": "false",
+        "decision.threshold": "2.0", "class.attribute.values": "Y,N",
+    })
+    lines = [f"T{i},Q0,{10 + i},{c}"
+             for i, c in enumerate(["Y", "Y", "Y", "N", "N"])]
+    write_output(str(tmp_path / "in"), lines)
+    NearestNeighbor(cfg).run(str(tmp_path / "in"), str(tmp_path / "out"))
+    out = open(tmp_path / "out" / "part-r-00000").read().splitlines()
+    assert out[0].split(",")[-1] == "N"
+
+    # unanimous positive: pos/neg = Infinity > threshold -> positive
+    # (Neighborhood.java:300)
+    lines = [f"T{i},Q1,{10 + i},Y" for i in range(5)]
+    write_output(str(tmp_path / "in_pos"), lines)
+    NearestNeighbor(cfg).run(str(tmp_path / "in_pos"), str(tmp_path / "out2"))
+    out = open(tmp_path / "out2" / "part-r-00000").read().splitlines()
+    assert out[0].split(",")[-1] == "Y"
+
+
+def test_same_type_similarity_self_join_top_k(tmp_path, mesh8):
+    rows = _make_points(12, seed=11)
+    os.makedirs(tmp_path / "inp")
+    (tmp_path / "inp" / "tr.txt").write_text(
+        "\n".join(",".join(r) for r in rows) + "\n")
+    cfg = JobConfig({
+        "feature.schema.file.path": _write_schema(tmp_path),
+        "inter.set.matching": "false",
+        "output.top.matches": "4",
+    })
+    SameTypeSimilarity(cfg).run(str(tmp_path / "inp"),
+                                str(tmp_path / "simi"), mesh=mesh8)
+    lines = open(tmp_path / "simi" / "part-r-00000").read().splitlines()
+    # full k neighbors per entity even though the diagonal is skipped
+    assert len(lines) == 12 * 4
+    for l in lines:
+        it = l.split(",")
+        assert it[0] != it[1]
+
+
+# ---------------------------------------------------------------------------
+# clustering
+# ---------------------------------------------------------------------------
+
+def test_entity_distance_store(tmp_path):
+    write_output(str(tmp_path / "rows"), ["e1,e2,5.0,e3,7.5"])
+    store = EntityDistanceStore.from_row_file(str(tmp_path / "rows"))
+    assert store.read("e1") == {"e2": 5.0, "e3": 7.5}
+    write_output(str(tmp_path / "pairs"), ["a,b,3", "b,c,4"])
+    store = EntityDistanceStore.from_pair_file(str(tmp_path / "pairs"))
+    assert store.read("b") == {"a": 3.0, "c": 4.0}
+
+
+def test_agglomerative_clustering(tmp_path):
+    # two tight groups {A,B,C} (pairwise distance 10) and {X,Y} (10),
+    # cross-group distance 950; distance.scale=1000 -> weights 990 vs 50.
+    # The reference's running-average update dilutes slowly
+    # (EdgeWeightedCluster.java:47-81: (avg*edges + new)/(edges + size)),
+    # so the threshold must sit above the diluted cross value (520) and
+    # below the in-group value (990)
+    ids = ["A", "B", "C", "X", "Y"]
+    close = {("A", "B"), ("A", "C"), ("B", "C"), ("X", "Y")}
+    pair_lines = []
+    for i in range(len(ids)):
+        for j in range(i + 1, len(ids)):
+            a, b = ids[i], ids[j]
+            d = 10 if (a, b) in close else 950
+            pair_lines.append(f"{a},{b},{d}")
+    write_output(str(tmp_path / "dist"), pair_lines)
+    write_output(str(tmp_path / "in"), [f"{e},x" for e in ids])
+    cfg = JobConfig({
+        "min.av.edge.weight.threshold": "600",
+        "distance.file.path": str(tmp_path / "dist"),
+        "distance.file.format": "pair",
+        "distance.scale": "1000",
+        "seed": "3",
+    })
+    AgglomerativeGraphical(cfg).run(str(tmp_path / "in"),
+                                    str(tmp_path / "out"))
+    lines = open(tmp_path / "out" / "part-r-00000").read().splitlines()
+    assert len(lines) == 2
+    groups = [set(l.split(",")[1:-1]) for l in lines]
+    assert {"A", "B", "C"} in groups
+    assert {"X", "Y"} in groups
