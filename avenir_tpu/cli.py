@@ -59,6 +59,7 @@ JOBS: Dict[str, tuple] = {
     "org.avenir.association.FrequentItemsApriori": ("association", "FrequentItemsApriori", "fia"),
     "org.avenir.association.AssociationRuleMiner": ("association", "AssociationRuleMiner", "arm"),
     "org.avenir.association.InfrequentItemMarker": ("association", "InfrequentItemMarker", "iim"),
+    "org.avenir.regress.LogisticRegressionJob": ("regress", "LogisticRegressionJob", ""),
 }
 
 

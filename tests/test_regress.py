@@ -1,0 +1,151 @@
+"""Logistic regression: gradient oracle, history-file protocol, convergence
+criteria, and learning-rate training on a separable planted signal."""
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core import JobConfig, write_output
+from avenir_tpu.models.regress import (ALL_BELOW_THRESHOLD,
+                                       AVERAGE_BELOW_THRESHOLD, CONVERGED,
+                                       ITER_LIMIT, NOT_CONVERGED,
+                                       LogisticRegressionJob,
+                                       LogisticRegressor)
+
+SCHEMA = {
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "f1", "ordinal": 1, "dataType": "int", "feature": True},
+        {"name": "f2", "ordinal": 2, "dataType": "int", "feature": True},
+        {"name": "cls", "ordinal": 3, "dataType": "categorical"},
+    ]
+}
+
+
+def _write_inputs(tmp_path, rows, coeff_line, schema=SCHEMA):
+    import json
+    write_output(str(tmp_path / "in"), [",".join(r) for r in rows])
+    (tmp_path / "schema.json").write_text(json.dumps(schema))
+    (tmp_path / "coeff.txt").write_text(coeff_line + "\n")
+
+
+def _cfg(tmp_path, **extra):
+    props = {
+        "feature.schema.file.path": str(tmp_path / "schema.json"),
+        "coeff.file.path": str(tmp_path / "coeff.txt"),
+        "positive.class.value": "Y",
+    }
+    props.update({k.replace("_", "."): str(v) for k, v in extra.items()})
+    return JobConfig(props)
+
+
+def _oracle_grad(x, y, w):
+    """LogisticRegressor.aggregate (LogisticRegressor.java:61-73) in NumPy."""
+    p = 1.0 / (1.0 + np.exp(-(x @ w)))
+    return x.T @ (y - p)
+
+
+def test_ragged_rowcount_pads_correctly(tmp_path, mesh8):
+    rng = np.random.default_rng(7)
+    n = 37  # deliberately not a multiple of 8 to exercise pad/mask
+    feats = rng.integers(-5, 6, (n, 2))
+    y = rng.integers(0, 2, n)
+    rows = [[f"r{i}", str(feats[i, 0]), str(feats[i, 1]),
+             "Y" if y[i] else "N"] for i in range(n)]
+    w0 = np.asarray([0.1, -0.2, 0.3])
+    _write_inputs(tmp_path, rows, ",".join(repr(float(v)) for v in w0))
+
+    job = LogisticRegressionJob(_cfg(tmp_path, iteration_limit=99))
+    job.run(str(tmp_path / "in"), str(tmp_path / "out"))
+    x = np.concatenate([np.ones((n, 1)), feats], axis=1).astype(float)
+    want = _oracle_grad(x, y.astype(float), w0)
+    got = np.asarray([float(v) for v in
+                      (tmp_path / "coeff.txt").read_text().splitlines()[-1].split(",")])
+    np.testing.assert_allclose(got, want, rtol=1e-9)
+
+
+def test_iter_limit_semantics(tmp_path, mesh8):
+    rows = [["r0", "1", "2", "Y"], ["r1", "-1", "0", "N"]]
+    _write_inputs(tmp_path, rows, "0.0,0.0,0.0")
+    job = LogisticRegressionJob(_cfg(tmp_path, iteration_limit=3))
+    assert job.run(str(tmp_path / "in"), str(tmp_path / "out")) == NOT_CONVERGED
+    assert job.run(str(tmp_path / "in"), str(tmp_path / "out")) == CONVERGED
+    # history grew one line per iteration
+    lines = (tmp_path / "coeff.txt").read_text().splitlines()
+    assert len(lines) == 3
+
+
+def test_gradient_values_and_history_append(tmp_path, mesh8):
+    rng = np.random.default_rng(3)
+    n = 24
+    feats = rng.integers(0, 4, (n, 2))
+    y = rng.integers(0, 2, n)
+    rows = [[f"r{i}", str(feats[i, 0]), str(feats[i, 1]),
+             "Y" if y[i] else "N"] for i in range(n)]
+    w0 = np.asarray([0.05, 0.1, -0.15])
+    _write_inputs(tmp_path, rows, ",".join(repr(float(v)) for v in w0))
+    job = LogisticRegressionJob(_cfg(tmp_path, iteration_limit=99))
+    job.run(str(tmp_path / "in"), str(tmp_path / "out"))
+
+    x = np.concatenate([np.ones((n, 1)), feats], axis=1).astype(float)
+    want = _oracle_grad(x, y.astype(float), w0)
+    got = np.asarray([float(v) for v in
+                      (tmp_path / "coeff.txt").read_text().splitlines()[-1].split(",")])
+    np.testing.assert_allclose(got, want, rtol=1e-9)
+    # job output dir holds the same line
+    out = (tmp_path / "out" / "part-r-00000").read_text().strip()
+    np.testing.assert_allclose(
+        np.asarray([float(v) for v in out.split(",")]), want, rtol=1e-9)
+
+
+def test_convergence_thresholds():
+    prev = np.asarray([10.0, 10.0])
+    cur = np.asarray([10.4, 10.4])  # 4% change each
+    reg = LogisticRegressor(prev, cur)
+    assert reg.is_all_converged(5.0)
+    assert reg.is_average_converged(5.0)
+    assert not reg.is_all_converged(3.0)
+
+    # one big, one small: all fails, average (5.5 avg vs 6) passes
+    reg2 = LogisticRegressor(np.asarray([10.0, 10.0]),
+                             np.asarray([11.0, 10.1]))  # 10% and 1%
+    assert not reg2.is_all_converged(6.0)
+    assert reg2.is_average_converged(6.0)
+
+
+def test_all_below_threshold_job(tmp_path, mesh8):
+    rows = [["r0", "1", "2", "Y"], ["r1", "-1", "0", "N"]]
+    # seed history with two nearly-identical lines; the job appends a third
+    # and compares the LAST TWO
+    (tmp_path / "coeff.txt")  # created by _write_inputs below
+    _write_inputs(tmp_path, rows, "1.0,1.0,1.0")
+    cfg = _cfg(tmp_path, **{"convergence_criteria": ALL_BELOW_THRESHOLD,
+                            "convergence_threshold": "1e9"})
+    job = LogisticRegressionJob(cfg)
+    # astronomically loose threshold -> CONVERGED after one iteration
+    assert job.run(str(tmp_path / "in"), str(tmp_path / "out")) == CONVERGED
+
+    cfg2 = _cfg(tmp_path, **{"convergence_criteria": AVERAGE_BELOW_THRESHOLD,
+                             "convergence_threshold": "1e-12"})
+    job2 = LogisticRegressionJob(cfg2)
+    assert job2.run(str(tmp_path / "in"), str(tmp_path / "out")) == NOT_CONVERGED
+
+
+def test_learning_rate_mode_learns_separable(tmp_path, mesh8):
+    """With learning.rate set, run_loop performs real gradient ascent and the
+    final coefficients classify a linearly separable planted signal."""
+    rng = np.random.default_rng(11)
+    n = 200
+    feats = rng.integers(-10, 11, (n, 2))
+    y = (feats[:, 0] + 2 * feats[:, 1] > 0).astype(int)  # planted boundary
+    rows = [[f"r{i}", str(feats[i, 0]), str(feats[i, 1]),
+             "Y" if y[i] else "N"] for i in range(n)]
+    _write_inputs(tmp_path, rows, "0.0,0.0,0.0")
+    cfg = _cfg(tmp_path, iteration_limit=60, learning_rate=0.5)
+    job = LogisticRegressionJob(cfg)
+    status = job.run_loop(str(tmp_path / "in"), str(tmp_path / "out"))
+    assert status == CONVERGED
+    w = np.asarray([float(v) for v in
+                    (tmp_path / "coeff.txt").read_text().splitlines()[-1].split(",")])
+    x = np.concatenate([np.ones((n, 1)), feats], axis=1).astype(float)
+    pred = (1.0 / (1.0 + np.exp(-(x @ w))) > 0.5).astype(int)
+    assert (pred == y).mean() > 0.95
