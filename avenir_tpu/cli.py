@@ -60,6 +60,10 @@ JOBS: Dict[str, tuple] = {
     "org.avenir.association.AssociationRuleMiner": ("association", "AssociationRuleMiner", "arm"),
     "org.avenir.association.InfrequentItemMarker": ("association", "InfrequentItemMarker", "iim"),
     "org.avenir.regress.LogisticRegressionJob": ("regress", "LogisticRegressionJob", ""),
+    "org.avenir.reinforce.GreedyRandomBandit": ("bandit", "GreedyRandomBandit", ""),
+    "org.avenir.reinforce.AuerDeterministic": ("bandit", "AuerDeterministic", ""),
+    "org.avenir.reinforce.SoftMaxBandit": ("bandit", "SoftMaxBandit", ""),
+    "org.avenir.reinforce.RandomFirstGreedyBandit": ("bandit", "RandomFirstGreedyBandit", ""),
 }
 
 

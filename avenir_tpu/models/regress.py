@@ -107,6 +107,7 @@ class LogisticRegressionJob:
         self.counters = Counters()
         # device-resident batch, loaded lazily and reused across iterations
         self._resident = None
+        self._resident_path = None
 
     # -- history file -------------------------------------------------------
     def _read_history(self) -> List[str]:
@@ -120,12 +121,12 @@ class LogisticRegressionJob:
 
     # -- data ---------------------------------------------------------------
     def _load(self, in_path: str):
-        if self._resident is not None:
+        if self._resident is not None and self._resident_path == in_path:
             return self._resident
         delim = self.config.field_delim_regex()
         ords = [f.ordinal for f in self.schema.feature_fields()]
         class_ord = self.schema.class_attr_field().ordinal
-        pos_val = self.config.get("positive.class.value")
+        pos_val = self.config.must("positive.class.value")
 
         xs, ys = [], []
         for line in read_lines(in_path):
@@ -143,6 +144,7 @@ class LogisticRegressionJob:
         y, _ = pad_rows(y, d)
         self._resident = (jnp.asarray(x), jnp.asarray(y),
                           jnp.asarray(mask), mesh)
+        self._resident_path = in_path
         return self._resident
 
     # -- one iteration ------------------------------------------------------

@@ -1,0 +1,208 @@
+"""Streaming reinforcement learning — the Storm/Redis topology replacement.
+
+Reference surface being re-expressed (citations into /root/reference):
+- ``org.avenir.reinforce.ReinforcementLearnerTopology`` — properties file ->
+  Storm Config; RedisSpout(xN) shuffle-grouped to
+  ReinforcementLearnerBolt(xM); StormSubmitter
+  (reinforce/ReinforcementLearnerTopology.java:42-85).
+- ``RedisSpout`` — ``rpop`` of ``redis.event.queue``, events are
+  ``eventID,roundNum`` (reinforce/RedisSpout.java:86-100).
+- ``ReinforcementLearnerBolt`` — on an event: drain the reward queue into
+  ``learner.setReward``, select ``learner.nextActions()``, write
+  ``eventID,action[,action...]`` to the action queue; on a reward tuple:
+  apply it (reinforce/ReinforcementLearnerBolt.java:92-125); learner built
+  from config keys ``reinforcement.learner.type`` /
+  ``reinforcement.learrner.actions`` [sic — the reference's typo'd key is
+  accepted too] (:66-71).
+- ``RedisActionWriter`` / ``RedisRewardReader`` — queue adapters; rewards
+  are ``actionID,reward`` lines (reinforce/RedisActionWriter.java:45-58,
+  RedisRewardReader.java:53-88).
+
+Re-design: Storm's spout/bolt thread graph existed to scale trivial per-event
+math across JVM workers; a single host loop keeps up with any realistic event
+rate here, so the topology becomes ``StreamingLearnerLoop`` — a pull loop
+over a ``Transport`` with the same three queues and wire formats.
+``InMemoryTransport`` serves tests/embedding; ``RedisTransport`` is a
+drop-in for the reference's deployment (requires the optional ``redis``
+package; the queue names/keys match, so the reference's producers/consumers
+interoperate unchanged).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .reinforce import Action, ReinforcementLearner, create_learner
+
+
+class Transport:
+    """Queue transport: event source, reward source, action sink."""
+
+    def next_event(self) -> Optional[str]:
+        """Pop one ``eventID,roundNum`` message, or None when idle."""
+        raise NotImplementedError
+
+    def read_rewards(self) -> List[str]:
+        """Drain pending ``actionID,reward`` messages."""
+        raise NotImplementedError
+
+    def write_action(self, message: str) -> None:
+        """Push one ``eventID,action[,action...]`` message."""
+        raise NotImplementedError
+
+
+class InMemoryTransport(Transport):
+    """Process-local queues (tests / embedded use)."""
+
+    def __init__(self):
+        self.events: List[str] = []
+        self.rewards: List[str] = []
+        self.actions: List[str] = []
+
+    def push_event(self, event_id: str, round_num: int) -> None:
+        self.events.append(f"{event_id},{round_num}")
+
+    def push_reward(self, action_id: str, reward: int) -> None:
+        self.rewards.append(f"{action_id},{reward}")
+
+    def next_event(self) -> Optional[str]:
+        return self.events.pop(0) if self.events else None
+
+    def read_rewards(self) -> List[str]:
+        out, self.rewards = self.rewards, []
+        return out
+
+    def write_action(self, message: str) -> None:
+        self.actions.append(message)
+
+
+class RedisTransport(Transport):
+    """Redis-list transport matching the reference's queue protocol
+    (``rpop`` events, reward list, ``lpush`` actions)."""
+
+    def __init__(self, host: str, port: int, event_queue: str,
+                 reward_queue: str, action_queue: str):
+        import redis  # optional dependency; gate at construction
+        self._r = redis.Redis(host=host, port=port, decode_responses=True)
+        self.event_queue = event_queue
+        self.reward_queue = reward_queue
+        self.action_queue = action_queue
+
+    def next_event(self) -> Optional[str]:
+        return self._r.rpop(self.event_queue)
+
+    def read_rewards(self) -> List[str]:
+        out = []
+        while True:
+            msg = self._r.rpop(self.reward_queue)
+            if msg is None:
+                return out
+            out.append(msg)
+
+    def write_action(self, message: str) -> None:
+        self._r.lpush(self.action_queue, message)
+
+
+def _get(config: Dict, *keys, default=None, required=False):
+    """First non-None value among alternate key spellings (both dict and
+    JobConfig expose .get)."""
+    for k in keys:
+        v = config.get(k)
+        if v is not None:
+            return v
+    if required:
+        raise ValueError(f"missing required config: {keys[0]}")
+    return default
+
+
+class StreamingLearnerLoop:
+    """The topology+bolt equivalent: one learner, three queues, a pull loop.
+
+    ``step()`` processes at most one event (plus any pending rewards) and
+    returns whether it did work; ``run()`` loops until ``max_events`` or an
+    idle timeout — the Storm topology ran forever, so both bounds are
+    optional.
+    """
+
+    def __init__(self, config: Dict, transport: Optional[Transport] = None):
+        self.config = config
+        learner_type = _get(config, "reinforcement.learner.type", required=True)
+        actions = _get(config, "reinforcement.learner.actions",
+                       "reinforcement.learrner.actions", required=True)
+        if isinstance(actions, str):
+            actions = actions.split(",")
+        self.learner: ReinforcementLearner = create_learner(
+            learner_type, actions, config)
+        if transport is not None:
+            self.transport = transport
+        else:
+            writer = _get(config, "reinforcement.learner.action.writer",
+                          "reinforcement.learrner.action.writer",
+                          default="redis")
+            if writer != "redis":
+                raise ValueError(f"unknown action writer: {writer}")
+            self.transport = RedisTransport(
+                host=_get(config, "redis.server.host", required=True),
+                port=int(_get(config, "redis.server.port", required=True)),
+                event_queue=_get(config, "redis.event.queue", required=True),
+                reward_queue=_get(config, "redis.reward.queue", required=True),
+                action_queue=_get(config, "redis.action.queue", required=True))
+        self.event_count = 0
+        self.reward_count = 0
+
+    def apply_rewards(self) -> int:
+        """Drain the reward queue into the learner
+        (ReinforcementLearnerBolt.java:96-99)."""
+        n = 0
+        for msg in self.transport.read_rewards():
+            action_id, reward = msg.split(",")[:2]
+            self.learner.set_reward(action_id, int(reward))
+            n += 1
+        self.reward_count += n
+        return n
+
+    def step(self) -> bool:
+        """One spout+bolt cycle: rewards first, then one event -> actions."""
+        self.apply_rewards()
+        msg = self.transport.next_event()
+        if msg is None:
+            return False
+        event_id = msg.split(",")[0]
+        actions = self.learner.next_actions()
+        action_list = ",".join(a.id for a in actions)
+        self.transport.write_action(f"{event_id},{action_list}")
+        self.event_count += 1
+        return True
+
+    def run(self, max_events: Optional[int] = None,
+            idle_timeout: Optional[float] = 1.0,
+            poll_interval: float = 0.01) -> int:
+        """Pull loop; returns events processed.  Stops after ``max_events``
+        or after ``idle_timeout`` seconds with an empty event queue."""
+        processed = 0
+        idle_since = None
+        while max_events is None or processed < max_events:
+            if self.step():
+                processed += 1
+                idle_since = None
+            else:
+                if idle_timeout is None:
+                    time.sleep(poll_interval)
+                    continue
+                if idle_since is None:
+                    idle_since = time.monotonic()
+                elif time.monotonic() - idle_since > idle_timeout:
+                    break
+                time.sleep(poll_interval)
+        return processed
+
+
+class ReinforcementLearnerTopology:
+    """CLI-shaped alias mirroring the reference entry point
+    (``java -jar uber-avenir.jar <topologyName> <configFile>``)."""
+
+    @staticmethod
+    def build(config: Dict,
+              transport: Optional[Transport] = None) -> StreamingLearnerLoop:
+        return StreamingLearnerLoop(config, transport)

@@ -114,9 +114,6 @@ def test_convergence_thresholds():
 
 def test_all_below_threshold_job(tmp_path, mesh8):
     rows = [["r0", "1", "2", "Y"], ["r1", "-1", "0", "N"]]
-    # seed history with two nearly-identical lines; the job appends a third
-    # and compares the LAST TWO
-    (tmp_path / "coeff.txt")  # created by _write_inputs below
     _write_inputs(tmp_path, rows, "1.0,1.0,1.0")
     cfg = _cfg(tmp_path, **{"convergence_criteria": ALL_BELOW_THRESHOLD,
                             "convergence_threshold": "1e9"})

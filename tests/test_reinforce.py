@@ -1,0 +1,289 @@
+"""Reinforcement learning family: online learner library (factory, UCB
+oracle, convergence on planted bandits), batch MR bandit jobs, and the
+streaming loop (Storm-topology replacement)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core import JobConfig, write_output
+from avenir_tpu.core.stats import HistogramStat
+from avenir_tpu.models.bandit import (AuerDeterministic, ExplorationCounter,
+                                      GreedyRandomBandit,
+                                      RandomFirstGreedyBandit, SoftMaxBandit,
+                                      aggregate_rewards)
+from avenir_tpu.models.reinforce import (ReinforcementLearnerFactory,
+                                         UpperConfidenceBoundOneLearner,
+                                         create_learner)
+from avenir_tpu.models.streaming import (InMemoryTransport,
+                                         StreamingLearnerLoop)
+
+ACTIONS = ["a", "b", "c"]
+
+LEARNER_CONFIGS = {
+    "intervalEstimator": {"bin.width": "10", "confidence.limit": "90",
+                          "min.confidence.limit": "50",
+                          "confidence.limit.reduction.step": "5",
+                          "confidence.limit.reduction.round.interval": "10",
+                          "min.reward.distr.sample": "5"},
+    "sampsonSampler": {"min.sample.size": "5", "max.reward": "100"},
+    "optimisticSampsonSampler": {"min.sample.size": "5", "max.reward": "100"},
+    "randomGreedy": {},
+    "upperConfidenceBoundOne": {},
+    "upperConfidenceBoundTwo": {},
+    "softMax": {"temp.constant": "20", "temp.reduction.algorithm": "logLinear",
+                "min.temp.constant": "1"},
+    "actionPursuit": {"pursuit.learning.rate": "0.05"},
+    "rewardComparison": {"intial.reference.reward": "50"},
+    "exponentialWeight": {"distr.constant": "0.2", "reward.scale": "100"},
+}
+
+
+def _planted_reward(rng, action_id):
+    """Arm 'b' is best: mean 80 vs 40/20."""
+    means = {"a": 40, "b": 80, "c": 20}
+    return int(np.clip(rng.normal(means[action_id], 10), 0, 100))
+
+
+def test_factory_creates_all_reference_learner_types():
+    for name, extra in LEARNER_CONFIGS.items():
+        cfg = dict(extra)
+        cfg["random.seed"] = "42"
+        learner = create_learner(name, ACTIONS, cfg)
+        assert learner.find_action("a") is not None
+        # alias entry point
+        learner2 = ReinforcementLearnerFactory.create(name, ACTIONS, cfg)
+        assert type(learner2) is type(learner)
+    with pytest.raises(ValueError):
+        create_learner("noSuchLearner", ACTIONS, {})
+
+
+def test_ucb1_score_oracle():
+    learner = create_learner("upperConfidenceBoundOne", ["x", "y"],
+                             {"reward.scale": "1", "random.seed": "0"})
+    # deterministic history: x tried 3 times avg 10, y tried 1 time avg 5
+    for r in (9, 10, 11):
+        learner.find_action("x").select()
+        learner.set_reward("x", r)
+    learner.find_action("y").select()
+    learner.set_reward("y", 5)
+    learner.total_trial_count = 5
+    x, y = learner.find_action("x"), learner.find_action("y")
+    # UCB1 formula (UpperConfidenceBoundOneLearner.java:58)
+    assert learner._ucb_score(x) == pytest.approx(
+        10 + math.sqrt(2 * math.log(5) / 3))
+    assert learner._ucb_score(y) == pytest.approx(
+        5 + math.sqrt(2 * math.log(5) / 1))
+    learner.total_trial_count = 4  # next_action increments to 5 then scores
+    assert learner.next_action().id == "x"
+
+
+def test_ucb1_untried_arm_first():
+    learner = create_learner("upperConfidenceBoundOne", ACTIONS,
+                             {"random.seed": "0"})
+    first = {learner.next_action().id for _ in range(3)}
+    assert first == set(ACTIONS)  # +inf score until each arm tried once
+
+
+@pytest.mark.parametrize("name", ["randomGreedy", "upperConfidenceBoundOne",
+                                  "softMax", "sampsonSampler",
+                                  "optimisticSampsonSampler", "actionPursuit",
+                                  "exponentialWeight", "intervalEstimator",
+                                  "upperConfidenceBoundTwo",
+                                  "rewardComparison"])
+def test_learner_converges_to_best_arm(name):
+    """Every learner should concentrate on the planted best arm 'b' —
+    SURVEY §4: planted-signal recovery as the integration test."""
+    cfg = dict(LEARNER_CONFIGS[name])
+    cfg.update({"random.seed": "123", "min.trial": "10"})
+    learner = create_learner(name, ACTIONS, cfg)
+    rng = np.random.default_rng(7)
+    for _ in range(600):
+        action = learner.next_action()
+        learner.set_reward(action.id, _planted_reward(rng, action.id))
+    picks = {a: 0 for a in ACTIONS}
+    for _ in range(200):
+        action = learner.next_action()
+        picks[action.id] += 1
+        learner.set_reward(action.id, _planted_reward(rng, action.id))
+    assert picks["b"] == max(picks.values()), (name, picks)
+
+
+def test_min_trial_bootstrap():
+    learner = create_learner("upperConfidenceBoundOne", ACTIONS,
+                             {"min.trial": "5", "random.seed": "1"})
+    for _ in range(15):
+        a = learner.next_action()
+        learner.set_reward(a.id, 100 if a.id == "a" else 0)
+    # all arms forced to >= min.trial despite 'a' dominating
+    assert all(learner.find_action(x).trial_count >= 5 for x in ACTIONS)
+
+
+def test_histogram_confidence_bounds():
+    h = HistogramStat(10)
+    for v in [5, 15, 15, 25, 25, 25, 35, 35, 45, 95]:
+        h.add(v)
+    lo, hi = h.get_confidence_bounds(100)
+    assert lo == 0 and hi == 100  # full range
+    lo, hi = h.get_confidence_bounds(60)
+    assert lo >= 10 and hi <= 50  # tails trimmed
+
+
+# ---------------------------------------------------------------------------
+# batch bandit jobs
+# ---------------------------------------------------------------------------
+
+def _bandit_rows(counts, rewards):
+    rows = []
+    for g, items in counts.items():
+        for item, cnt in items.items():
+            rows.append(f"{g},{item},{cnt},{rewards[g][item]}")
+    return rows
+
+
+def _bandit_cfg(tmp_path, **extra):
+    props = {"count.ordinal": "2", "reward.ordinal": "3",
+             "group.item.count.path": str(tmp_path / "batch.txt"),
+             "random.seed": "9"}
+    props.update({k.replace("_", "."): str(v) for k, v in extra.items()})
+    return JobConfig(props)
+
+
+def test_greedy_random_bandit_late_round_exploits(tmp_path):
+    counts = {"g1": {"p1": 20, "p2": 20, "p3": 20}}
+    rewards = {"g1": {"p1": 10, "p2": 90, "p3": 30}}
+    write_output(str(tmp_path / "in"), _bandit_rows(counts, rewards))
+    (tmp_path / "batch.txt").write_text("g1,1\n")
+    cfg = _bandit_cfg(tmp_path, current_round_num=50)
+    GreedyRandomBandit(cfg).run(str(tmp_path / "in"), str(tmp_path / "out"))
+    lines = (tmp_path / "out" / "part-r-00000").read_text().splitlines()
+    assert lines == ["g1,p2"]  # epsilon ~ 0.5/50 -> exploit best reward
+
+
+def test_greedy_random_bandit_auer_untried_first(tmp_path):
+    counts = {"g1": {"p1": 5, "p2": 0, "p3": 5}}
+    rewards = {"g1": {"p1": 50, "p2": 0, "p3": 60}}
+    write_output(str(tmp_path / "in"), _bandit_rows(counts, rewards))
+    (tmp_path / "batch.txt").write_text("g1,2\n")
+    cfg = _bandit_cfg(tmp_path, current_round_num=3,
+                      **{"prob.reduction.algorithm": "AuerGreedy"})
+    GreedyRandomBandit(cfg).run(str(tmp_path / "in"), str(tmp_path / "out"))
+    lines = (tmp_path / "out" / "part-r-00000").read_text().splitlines()
+    assert "g1,p2" in lines  # untried item always selected
+    assert len(lines) == 2
+
+
+def test_auer_deterministic_ucb(tmp_path):
+    counts = {"g1": {"p1": 100, "p2": 100, "p3": 1}}
+    rewards = {"g1": {"p1": 50, "p2": 55, "p3": 40}}
+    write_output(str(tmp_path / "in"), _bandit_rows(counts, rewards))
+    (tmp_path / "batch.txt").write_text("g1,2\n")
+    cfg = _bandit_cfg(tmp_path, current_round_num=20)
+    AuerDeterministic(cfg).run(str(tmp_path / "in"), str(tmp_path / "out"))
+    lines = (tmp_path / "out" / "part-r-00000").read_text().splitlines()
+    # p2 = best mean; p3 = huge exploration bonus (1 trial vs 100)
+    assert set(lines) == {"g1,p2", "g1,p3"}
+
+
+def test_softmax_bandit_prefers_high_reward(tmp_path):
+    counts = {"g1": {f"p{i}": 10 for i in range(1, 6)}}
+    rewards = {"g1": {"p1": 5, "p2": 5, "p3": 100, "p4": 5, "p5": 5}}
+    write_output(str(tmp_path / "in"), _bandit_rows(counts, rewards))
+    (tmp_path / "batch.txt").write_text("g1,1\n")
+    wins = 0
+    for seed in range(20):
+        cfg = _bandit_cfg(tmp_path, current_round_num=2, random_seed=seed,
+                          **{"temp.constant": "0.1"})
+        SoftMaxBandit(cfg).run(str(tmp_path / "in"), str(tmp_path / "out"))
+        lines = (tmp_path / "out" / "part-r-00000").read_text().splitlines()
+        wins += lines == ["g1,p3"]
+    assert wins >= 18  # cold softmax -> near-deterministic argmax
+
+
+def test_random_first_greedy_phases(tmp_path):
+    # 4 items, exploration.count.factor=2 -> 8 exploration selections
+    rows = [f"g1,p{i},{r}" for i, r in zip(range(1, 5), [10, 90, 30, 50])]
+    write_output(str(tmp_path / "in"), rows)
+    (tmp_path / "batch.txt").write_text("g1,4,2\n")
+    # round 2: still exploring (8 - 1*2 = 6 remaining)
+    cfg = _bandit_cfg(tmp_path, current_round_num=2)
+    RandomFirstGreedyBandit(cfg).run(str(tmp_path / "in"), str(tmp_path / "o1"))
+    explore = (tmp_path / "o1" / "part-r-00000").read_text().splitlines()
+    assert len(explore) == 2
+    # round 10: exploration exhausted -> exploit top rewards
+    cfg = _bandit_cfg(tmp_path, current_round_num=10)
+    RandomFirstGreedyBandit(cfg).run(str(tmp_path / "in"), str(tmp_path / "o2"))
+    exploit = (tmp_path / "o2" / "part-r-00000").read_text().splitlines()
+    assert exploit == ["g1,p2", "g1,p4"]  # two highest rewards, in rank order
+
+
+def test_exploration_counter_ranges():
+    ec = ExplorationCounter("g", count=5, exploration_count=12, batch_size=2)
+    ec.select_next_round(1)  # remaining 12 -> beg=12%5=2, end=3
+    assert ec.is_in_exploration()
+    assert ec.should_explore(2) and ec.should_explore(3)
+    assert not ec.should_explore(0) and not ec.should_explore(4)
+    ec.select_next_round(7)  # remaining 0 -> exploitation
+    assert not ec.is_in_exploration()
+    ec.select_next_round(5)  # remaining 4 -> beg=4, end=5 wraps to (4,4),(0,0)
+    assert ec.should_explore(4) and ec.should_explore(0)
+    assert not ec.should_explore(2)
+
+
+def test_aggregate_rewards_running_average():
+    prev = ["g1,p1,2,50"]
+    scored = ["g1,p1,80", "g1,p2,60"]
+    out = aggregate_rewards(scored, prev)
+    state = {tuple(l.split(",")[:2]): l.split(",")[2:] for l in out}
+    assert state[("g1", "p1")] == ["3", "60"]  # (2*50+80)/3
+    assert state[("g1", "p2")] == ["1", "60"]
+
+
+# ---------------------------------------------------------------------------
+# streaming loop (Storm topology replacement)
+# ---------------------------------------------------------------------------
+
+def test_streaming_loop_protocol():
+    config = {"reinforcement.learner.type": "randomGreedy",
+              "reinforcement.learner.actions": "a,b,c",
+              "random.seed": "5", "batch.size": "2"}
+    transport = InMemoryTransport()
+    loop = StreamingLearnerLoop(config, transport)
+    transport.push_event("e1", 1)
+    transport.push_reward("b", 80)
+    assert loop.step() is True
+    assert loop.reward_count == 1
+    assert len(transport.actions) == 1
+    event_id, *actions = transport.actions[0].split(",")
+    assert event_id == "e1" and len(actions) == 2
+    assert all(a in ("a", "b", "c") for a in actions)
+    assert loop.step() is False  # queue drained
+
+
+def test_streaming_loop_converges_on_simulated_feedback():
+    config = {"reinforcement.learner.type": "upperConfidenceBoundOne",
+              "reinforcement.learner.actions": "a,b,c",
+              "reward.scale": "1", "random.seed": "5"}
+    transport = InMemoryTransport()
+    loop = StreamingLearnerLoop(config, transport)
+    rng = np.random.default_rng(3)
+    picks = {a: 0 for a in "abc"}
+    for i in range(400):
+        transport.push_event(f"e{i}", i)
+        loop.run(max_events=1, idle_timeout=0.0)
+        _, action = transport.actions[-1].split(",")
+        if i >= 300:
+            picks[action] += 1
+        transport.push_reward(action, _planted_reward(rng, action))
+    assert picks["b"] == max(picks.values())
+
+
+def test_streaming_accepts_reference_typo_keys():
+    """The reference's config keys have a typo (reinforcement.learrner.*);
+    both spellings must work so reference properties files run unchanged."""
+    config = {"reinforcement.learner.type": "randomGreedy",
+              "reinforcement.learrner.actions": "x,y",
+              "random.seed": "1"}
+    loop = StreamingLearnerLoop(config, InMemoryTransport())
+    assert loop.learner.find_action("x") is not None
