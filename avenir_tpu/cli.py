@@ -64,6 +64,9 @@ JOBS: Dict[str, tuple] = {
     "org.avenir.reinforce.AuerDeterministic": ("bandit", "AuerDeterministic", ""),
     "org.avenir.reinforce.SoftMaxBandit": ("bandit", "SoftMaxBandit", ""),
     "org.avenir.reinforce.RandomFirstGreedyBandit": ("bandit", "RandomFirstGreedyBandit", ""),
+    "org.avenir.sequence.CandidateGenerationWithSelfJoin": ("sequence", "CandidateGenerationWithSelfJoin", "cgs"),
+    "org.avenir.sequence.SequencePositionalCluster": ("sequence", "SequencePositionalCluster", ""),
+    "org.avenir.text.WordCounter": ("text", "WordCounter", ""),
 }
 
 

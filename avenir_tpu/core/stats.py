@@ -8,8 +8,6 @@ Reference usage sites:
 - ``CategoricalSampler``: probability-weighted action sampling
   (reinforce/SoftMaxLearner.java:36, ActionPursuitLearner.java:34,
   ExponentialWeightLearner.java:34, RewardComparisonLearner.java:36).
-- ``RandomSampler``: integer-scaled distribution sampling
-  (reinforce/SoftMaxBandit.java:89,183-198, DISTR_SCALE=1000).
 - ``HistogramStat``: binned reward distribution with confidence bounds
   (reinforce/IntervalEstimatorLearner.java:43,64,118).
 
@@ -88,14 +86,6 @@ class CategoricalSampler:
         if total <= 0:
             return self._keys[int(rng.integers(len(self._keys)))]
         return self._keys[int(rng.choice(len(self._keys), p=probs / total))]
-
-
-class RandomSampler(CategoricalSampler):
-    """Integer-scaled distribution sampling (chombo RandomSampler;
-    SoftMaxBandit adds ``(id, int(exp(...)*1000))`` entries)."""
-
-    def add_to_distr(self, key: str, scaled: int) -> None:
-        self.add(key, float(scaled))
 
 
 class HistogramStat:

@@ -185,10 +185,14 @@ class GreedyRandomBandit(_BanditJobBase):
         n_avail = grouped.size()
         for _ in range(min(batch_size, n_avail)):
             count += 1
+            # early rounds (count <= 1, incl. the unset round default -1)
+            # explore at the full base probability instead of dividing by
+            # zero / going negative
+            t = max(count, 1)
             if log_linear:
-                cur_prob = rand_prob * red_const * math.log(max(count, 1)) / count
+                cur_prob = rand_prob * red_const * math.log(t) / t
             else:
-                cur_prob = rand_prob * red_const / count
+                cur_prob = rand_prob * red_const / t
             cur_prob = min(cur_prob, rand_prob)
             # explore with the decaying prob, exploit otherwise (see module
             # docstring re the reference's flipped comparison); the picked
@@ -320,7 +324,12 @@ class SoftMaxBandit(_BanditJobBase):
                 ids = [it["itemID"] for it in grouped.items]
                 distr = np.asarray([it["reward"] / max(max_reward, 1)
                                     for it in grouped.items])
-                scaled = (np.exp(distr / temp) * self.DISTR_SCALE).astype(int)
+                # max-subtracted exponent keeps the int scaling in range at
+                # cold temperatures (the reference's raw (int) cast saturates
+                # at Integer.MAX_VALUE — SoftMaxBandit.java:187); shifting
+                # leaves the softmax distribution unchanged
+                scaled = (np.exp((distr - distr.max()) / temp)
+                          * self.DISTR_SCALE).astype(np.int64)
                 probs = scaled / scaled.sum()
                 take = min(batch - len(selected), len(ids))
                 picks = self.rng.choice(len(ids), size=take, replace=False,

@@ -1,0 +1,91 @@
+"""Text analytics: analyzed word count.
+
+Reference surface being re-expressed (citations into /root/reference):
+- ``org.avenir.text.WordCounter`` — mapper tokenizes the configured text
+  column (``text.field.ordinal``; ordinal <= 0 means the whole line —
+  text/WordCounter.java:98-103) with Lucene's ``StandardAnalyzer``
+  (lowercasing + English stop-word removal, no stemming;
+  text/WordCounter.java:94,117-128), emits ``(token, 1)``; reducer counts and
+  writes ``word,count`` lines (:139-151).  The same analyzer backs
+  BayesianDistribution's text mode.
+
+TPU re-design: tokenization and vocab assignment are host passes (strings
+never go on device — SURVEY §7.3 item 1); the count itself runs through the
+framework's sharded counting engine (``count_table`` under ``sharded_reduce``,
+the same mapper+shuffle+reducer collapse every trainer uses), which is where
+the scale lives when the corpus is large.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+import numpy as np
+
+from ..core.config import JobConfig
+from ..core.io import read_lines, split_line, write_output
+from ..core.metrics import Counters
+from ..ops.counting import count_table, sharded_reduce
+
+# Lucene StandardAnalyzer's default English stop set (StopAnalyzer
+# ENGLISH_STOP_WORDS_SET, the list StandardAnalyzer(Version.LUCENE_35) uses)
+LUCENE_STOP_WORDS = frozenset("""
+a an and are as at be but by for if in into is it no not of on or such that
+the their then there these they this to was will with
+""".split())
+
+_TOKEN = re.compile(r"[0-9A-Za-z']+")
+
+
+def standard_tokenize(text: str) -> List[str]:
+    """StandardAnalyzer-equivalent: lowercase alphanumeric tokens minus
+    English stop words (no stemming — the reference's ``tokenize`` comment
+    says stemming but StandardAnalyzer does none)."""
+    return [t for t in (m.group(0).lower() for m in _TOKEN.finditer(text))
+            if t not in LUCENE_STOP_WORDS]
+
+
+class WordCounter:
+    """Analyzed word-count job."""
+
+    def __init__(self, config: JobConfig):
+        self.config = config
+
+    def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
+        counters = Counters()
+        cfg = self.config
+        delim = cfg.field_delim_out()
+        text_ord = cfg.must_int("text.field.ordinal")
+        delim_regex = cfg.field_delim_regex()
+
+        vocab: dict = {}
+        ids: List[int] = []
+        for line in read_lines(in_path):
+            if text_ord > 0:
+                text = split_line(line, delim_regex)[text_ord]
+            else:
+                text = line
+            for token in standard_tokenize(text):
+                ids.append(vocab.setdefault(token, len(vocab)))
+        words = list(vocab)
+        counters.set("Words", "Distinct", len(words))
+        counters.set("Words", "Total", len(ids))
+
+        if not words:
+            write_output(out_path, [])
+            return counters
+
+        # the count runs through the sharded engine: per-shard bincount
+        # (mapper+combiner) + psum over the data axis (shuffle+reducer)
+        id_arr = np.asarray(ids, dtype=np.int32)
+        counts = np.asarray(sharded_reduce(
+            _wc_local, id_arr, mesh=mesh, static_args=(len(words),)))
+
+        out = [f"{w}{delim}{int(counts[i])}" for i, w in enumerate(words)]
+        write_output(out_path, out)
+        return counters
+
+
+def _wc_local(ids, mask, n_words):
+    return count_table((n_words,), (ids,), weights=None, mask=mask)

@@ -1,0 +1,149 @@
+"""Sequence package (GSP candidate self-join, positional clustering, the
+hoidla-equivalent window/criteria) and text word count."""
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core import JobConfig, write_output
+from avenir_tpu.core.window import (Criteria, EventLocalityContext,
+                                    TimeBoundEventLocalityAnalyzer,
+                                    TimeStampedValue)
+from avenir_tpu.models.sequence import (CandidateGenerationWithSelfJoin,
+                                        SequencePositionalCluster,
+                                        gsp_candidates)
+from avenir_tpu.models.text import WordCounter, standard_tokenize
+
+
+# ---------------------------------------------------------------------------
+# GSP candidate generation
+# ---------------------------------------------------------------------------
+
+def test_gsp_candidates_oracle():
+    seqs = [("a", "b"), ("b", "c"), ("b", "d"), ("c", "a")]
+    cands = gsp_candidates(seqs)
+    # a,b joins b,c and b,d; b,c joins c,a; c,a joins a,b
+    assert set(cands) == {("a", "b", "c"), ("a", "b", "d"),
+                          ("b", "c", "a"), ("c", "a", "b")}
+
+
+def test_gsp_same_token_self_join():
+    # all-same-token sequence joins itself (CandidateGenerationWithSelfJoin
+    # .java:217-236)
+    assert gsp_candidates([("x", "x")]) == [("x", "x", "x")]
+    # a non-uniform sequence does not self-extend
+    assert gsp_candidates([("a", "b")]) == []
+
+
+def test_candidate_generation_job(tmp_path):
+    write_output(str(tmp_path / "in"), ["a,b", "b,c", "x,x"])
+    cfg = JobConfig({"cgs.item.set.length": "2"}, prefix="cgs")
+    CandidateGenerationWithSelfJoin(cfg).run(
+        str(tmp_path / "in"), str(tmp_path / "out"))
+    lines = set((tmp_path / "out" / "part-r-00000").read_text().splitlines())
+    assert lines == {"a,b,c", "x,x,x"}
+
+
+# ---------------------------------------------------------------------------
+# window / criteria (hoidla equivalents)
+# ---------------------------------------------------------------------------
+
+def test_criteria_expressions():
+    c = Criteria.create_criteria_from_expression("$0 > 100 && $0 <= 500")
+    assert c.get_num_predicates() == 2
+    assert c.evaluate([200, 200])
+    assert not c.evaluate([600, 600])
+    assert not c.evaluate([50, 50])
+    c2 = Criteria.create_criteria_from_expression("$0 < 10 || $0 > 90")
+    assert c2.evaluate([5]) and c2.evaluate([95]) and not c2.evaluate([50])
+    with pytest.raises(ValueError):
+        Criteria.create_criteria_from_expression("$0 LIKE 'x'")
+
+
+def test_event_locality_window_scores_clusters():
+    ctx = EventLocalityContext(min_occurence=3, max_interval_average=5,
+                               max_interval_max=10,
+                               preferred_strategies=["count", "averageInterval"])
+    w = TimeBoundEventLocalityAnalyzer(window_time_span=100, time_step=1,
+                                      context=ctx)
+    # sparse qualifying events -> low score
+    for t in (0, 40, 80):
+        w.add(TimeStampedValue(1.0, t, condition_met=(t == 40)))
+    assert w.get_score() < 1.0
+    # burst of qualifying events -> full score
+    for t in (81, 82, 83, 84):
+        w.add(TimeStampedValue(1.0, t, condition_met=True))
+    assert w.get_score() == 1.0
+
+
+def test_window_evicts_old_events():
+    ctx = EventLocalityContext(min_occurence=2,
+                               preferred_strategies=["count"])
+    w = TimeBoundEventLocalityAnalyzer(window_time_span=10, time_step=1,
+                                      context=ctx)
+    w.add(TimeStampedValue(1.0, 0, True))
+    w.add(TimeStampedValue(1.0, 1, True))
+    assert w.get_score() == 1.0
+    # 50 is far past the span; both old events evicted
+    w.add(TimeStampedValue(1.0, 50, False))
+    assert w.get_score() == 0.0
+
+
+def test_positional_cluster_job(tmp_path):
+    # rows: id,quant,seqNum — quant > 50 qualifies; plant a dense burst of
+    # qualifying events late in the stream
+    rows = []
+    t = 0
+    for i in range(30):
+        t += 10
+        rows.append(f"e{i},10,{t}")  # sparse non-qualifying
+    for i in range(5):
+        t += 2
+        rows.append(f"b{i},80,{t}")  # qualifying burst
+    write_output(str(tmp_path / "in"), rows)
+    cfg = JobConfig({
+        "window.time.span": "50", "processing.time.step": "1",
+        "quant.field.ordinal": "1", "seq.num.field.ordinal": "2",
+        "weighted.strategy": "false",
+        "min.occurence": "3", "max.interval.average": "5",
+        "max.interval.max": "10", "preferred.strategies": "count,averageInterval",
+        "score.threshold": "0.9", "cond.expression": "$0 > 50",
+    })
+    SequencePositionalCluster(cfg).run(str(tmp_path / "in"),
+                                       str(tmp_path / "out"))
+    lines = (tmp_path / "out" / "part-r-00000").read_text().splitlines()
+    assert lines, "burst should exceed the score threshold"
+    # emissions only happen inside the qualifying burst
+    emitted_quants = {l.split(",")[1] for l in lines}
+    assert emitted_quants == {"80"}
+
+
+# ---------------------------------------------------------------------------
+# word count
+# ---------------------------------------------------------------------------
+
+def test_standard_tokenize():
+    toks = standard_tokenize("The quick brown Fox AND the dog, the dog!")
+    assert toks == ["quick", "brown", "fox", "dog", "dog"]
+
+
+def test_word_counter_job(tmp_path, mesh8):
+    write_output(str(tmp_path / "in"),
+                 ["r1,hello world hello", "r2,world of worlds"])
+    cfg = JobConfig({"text.field.ordinal": "1"})
+    WordCounter(cfg).run(str(tmp_path / "in"), str(tmp_path / "out"),
+                         mesh=mesh8)
+    counts = dict(l.split(",") for l in
+                  (tmp_path / "out" / "part-r-00000").read_text().splitlines())
+    # "of" is in the Lucene English stop set -> dropped by the analyzer
+    assert counts == {"hello": "2", "world": "2", "worlds": "1"}
+
+
+def test_word_counter_whole_line_mode(tmp_path, mesh8):
+    # text.field.ordinal <= 0 -> whole line is the text (WordCounter.java:98)
+    write_output(str(tmp_path / "in"), ["alpha beta", "beta gamma"])
+    cfg = JobConfig({"text.field.ordinal": "0"})
+    WordCounter(cfg).run(str(tmp_path / "in"), str(tmp_path / "out"),
+                         mesh=mesh8)
+    counts = dict(l.split(",") for l in
+                  (tmp_path / "out" / "part-r-00000").read_text().splitlines())
+    assert counts == {"alpha": "1", "beta": "2", "gamma": "1"}
