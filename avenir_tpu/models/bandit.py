@@ -330,6 +330,9 @@ class SoftMaxBandit(_BanditJobBase):
                 # leaves the softmax distribution unchanged
                 scaled = (np.exp((distr - distr.max()) / temp)
                           * self.DISTR_SCALE).astype(np.int64)
+                # floor at 1 so cold temperatures cannot zero an arm out of
+                # the replace=False draw entirely
+                scaled = np.maximum(scaled, 1)
                 probs = scaled / scaled.sum()
                 take = min(batch - len(selected), len(ids))
                 picks = self.rng.choice(len(ids), size=take, replace=False,

@@ -35,7 +35,9 @@ a an and are as at be but by for if in into is it no not of on or such that
 the their then there these they this to was will with
 """.split())
 
-_TOKEN = re.compile(r"[0-9A-Za-z']+")
+# apostrophes only BETWEEN letters (UAX#29, as StandardTokenizer does:
+# don't -> don't, 'hello' -> hello)
+_TOKEN = re.compile(r"[0-9A-Za-z]+(?:'[0-9A-Za-z]+)*")
 
 
 def standard_tokenize(text: str) -> List[str]:

@@ -1,0 +1,244 @@
+"""Tutorial-runbook integration suite: the reference ships 14
+``resource/*_tutorial*.txt`` scripts as its de-facto integration tests
+(SURVEY §4) — generate planted data, chain several jobs through the driver
+CLI, assert the planted signal is recovered.  Each test here is one of those
+runbooks end-to-end through ``cli.main`` with real properties files — the
+exact user surface (``python -m avenir_tpu <Job> -Dconf.path=... in out``)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from avenir_tpu.cli import main as cli_main
+from avenir_tpu.core import write_output
+from avenir_tpu.datagen import (gen_price_rounds, gen_state_sequences,
+                                gen_telecom_churn, gen_transactions)
+
+CHURN_SCHEMA = {
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "plan", "ordinal": 1, "dataType": "categorical", "feature": True},
+        {"name": "minUsed", "ordinal": 2, "dataType": "int", "feature": True,
+         "min": 0, "max": 2200, "bucketWidth": 200},
+        {"name": "dataUsed", "ordinal": 3, "dataType": "int", "feature": True,
+         "min": 0, "max": 1000, "bucketWidth": 100},
+        {"name": "csCall", "ordinal": 4, "dataType": "int", "feature": True,
+         "min": 0, "max": 14, "bucketWidth": 2},
+        {"name": "csEmail", "ordinal": 5, "dataType": "int", "feature": True,
+         "min": 0, "max": 22, "bucketWidth": 4},
+        {"name": "network", "ordinal": 6, "dataType": "int", "feature": True},
+        {"name": "churned", "ordinal": 7, "dataType": "categorical",
+         "cardinality": ["N", "Y"]},
+    ]
+}
+
+
+def _props(path, **kv):
+    path.write_text("".join(f"{k}={v}\n" for k, v in kv.items()))
+    return str(path)
+
+
+def _run(job, props, in_path, out_path):
+    rc = cli_main([job, f"-Dconf.path={props}", str(in_path), str(out_path)])
+    assert rc == 0, f"{job} exited {rc}"
+
+
+def _outlines(out_path):
+    return (out_path / "part-r-00000").read_text().splitlines()
+
+
+def test_tutorial_churn_bayesian(tmp_path, mesh8):
+    """cust_churn_bayesian_prediction.txt: generate churn -> train NB ->
+    predict -> accuracy beats the base rate."""
+    (tmp_path / "schema.json").write_text(json.dumps(CHURN_SCHEMA))
+    rows = gen_telecom_churn(3000, seed=29)
+    train, test = rows[:2400], rows[2400:]
+    write_output(str(tmp_path / "train"), [",".join(r) for r in train])
+    write_output(str(tmp_path / "test"), [",".join(r) for r in test])
+
+    props = _props(tmp_path / "nb.properties",
+                   **{"feature.schema.file.path": str(tmp_path / "schema.json")})
+    _run("BayesianDistribution", props, tmp_path / "train", tmp_path / "model")
+
+    pprops = _props(
+        tmp_path / "bp.properties",
+        **{"feature.schema.file.path": str(tmp_path / "schema.json"),
+           "bayesian.model.file.path": str(tmp_path / "model")})
+    _run("BayesianPredictor", pprops, tmp_path / "test", tmp_path / "pred")
+
+    lines = _outlines(tmp_path / "pred")
+    assert len(lines) == len(test)
+    # output = input line + predicted class + int prob (BayesianPredictor)
+    correct = sum(1 for l, r in zip(lines, test)
+                  if l.split(",")[-2] == r[7])
+    base_rate = max(sum(r[7] == "N" for r in test),
+                    sum(r[7] == "Y" for r in test)) / len(test)
+    assert correct / len(test) > base_rate
+
+
+def test_tutorial_churn_markov(tmp_path, mesh8):
+    """cust_churn_markov_chain_classifier_tutorial.txt: state sequences from
+    two class-conditional chains -> per-class transition model -> log-odds
+    classifier -> accuracy >= 0.85."""
+    states = ["LL", "LH", "HL", "HH"]
+    # loyal chain mixes states; churner chain gets absorbed in HH
+    t_loyal = np.full((4, 4), 0.25)
+    t_churn = np.asarray([[0.1, 0.1, 0.1, 0.7]] * 4)
+    rows = gen_state_sequences(
+        800, states, {"L": t_loyal, "C": t_churn}, seq_len=(15, 25), seed=31)
+    train, test = rows[:600], rows[600:]
+    write_output(str(tmp_path / "train"), [",".join(r) for r in train])
+    write_output(str(tmp_path / "test"), [",".join(r) for r in test])
+
+    props = _props(tmp_path / "mst.properties",
+                   **{"model.states": ",".join(states),
+                      "class.label.field.ord": "1",
+                      "skip.field.count": "1",
+                      "trans.prob.scale": "1000"})
+    _run("MarkovStateTransitionModel", props, tmp_path / "train",
+         tmp_path / "model")
+
+    cprops = _props(tmp_path / "mmc.properties",
+                    **{"mm.model.path": str(tmp_path / "model"),
+                       "class.label.based.model": "true",
+                       "class.labels": "L,C",
+                       "validation.mode": "true",
+                       "class.label.field.ord": "1",
+                       "skip.field.count": "1"})
+    _run("MarkovModelClassifier", cprops, tmp_path / "test", tmp_path / "pred")
+
+    lines = _outlines(tmp_path / "pred")
+    correct = sum(1 for l, r in zip(lines, test)
+                  if l.split(",")[1] == r[1])
+    assert correct / len(test) >= 0.85
+
+
+def test_tutorial_freq_items_apriori(tmp_path, mesh8):
+    """freq_items_apriori_tutorial.txt: transactions with a planted triple ->
+    3 Apriori passes -> rule miner; the planted itemset and its rules
+    survive."""
+    rows = gen_transactions(400, 60, planted=((3, 7, 11),),
+                            planted_support=0.5, seed=37)
+    write_output(str(tmp_path / "trans"), [",".join(r) for r in rows])
+    # trans-id mode = the runbook's configuration (fit.properties
+    # fia.emit.trans.id=true): distinct-transaction supports, id lists carried
+    # between passes; the FINAL pass drops the ids (fia.trans.id.output=false)
+    # so its output is ``items...,support`` — the rule miner's input format
+    base = {"fia.skip.field.count": "1", "fia.tans.id.ord": "0",
+            "fia.support.threshold": "0.1", "fia.total.tans.count": "400",
+            "fia.emit.trans.id": "true"}
+
+    import os
+    os.makedirs(tmp_path / "freq_all")
+    for k in (1, 2, 3):
+        kv = dict(base, **{"fia.item.set.length": str(k)})
+        if k > 1:
+            kv["fia.item.set.file.path"] = str(tmp_path / f"k{k-1}")
+        props = _props(tmp_path / f"fia{k}.properties", **kv)
+        _run("FrequentItemsApriori", props, tmp_path / "trans",
+             tmp_path / f"k{k}")
+        # the id-free variant of each pass feeds the rule miner (the
+        # reference unions all passes' ``items...,support`` outputs)
+        kv["fia.trans.id.output"] = "false"
+        props = _props(tmp_path / f"fia{k}f.properties", **kv)
+        _run("FrequentItemsApriori", props, tmp_path / "trans",
+             tmp_path / f"k{k}f")
+        (tmp_path / "freq_all" / f"part-{k}").write_text(
+            (tmp_path / f"k{k}f" / "part-r-00000").read_text())
+
+    k3 = _outlines(tmp_path / "k3f")
+    assert any(l.split(",")[:3] == ["I00003", "I00007", "I00011"] for l in k3)
+
+    rprops = _props(tmp_path / "arm.properties",
+                    **{"arm.conf.threshold": "0.5", "arm.max.ante.size": "2"})
+    _run("AssociationRuleMiner", rprops, tmp_path / "freq_all",
+         tmp_path / "rules")
+    rules = _outlines(tmp_path / "rules")
+    assert any("I00003" in r and "I00011" in r for r in rules)
+
+
+def test_tutorial_knn_pipeline(tmp_path, mesh8):
+    """knn.sh: distance job (the in-framework sifarish replacement) ->
+    NearestNeighbor voting -> accuracy on planted blobs."""
+    schema = {"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "x", "ordinal": 1, "dataType": "double", "feature": True,
+         "min": -10, "max": 20},
+        {"name": "y", "ordinal": 2, "dataType": "double", "feature": True,
+         "min": -10, "max": 20},
+        {"name": "cls", "ordinal": 3, "dataType": "categorical",
+         "cardinality": ["A", "B"]},
+    ]}
+    (tmp_path / "schema.json").write_text(json.dumps(schema))
+    rng = np.random.default_rng(41)
+    train_rows, test_rows = [], []
+    for i in range(120):
+        c = "A" if i % 2 == 0 else "B"
+        cx = 0.0 if c == "A" else 8.0
+        row = (f"E{i},{cx + rng.normal():.3f},"
+               f"{cx + rng.normal():.3f},{c}")
+        (train_rows if i < 100 else test_rows).append(row)
+    # train/test split is by FILE name prefix (base.set.split.prefix),
+    # mirroring the reference's HDFS dir layout (resource/knn.sh)
+    import os
+    os.makedirs(tmp_path / "inp")
+    (tmp_path / "inp" / "tr-00000").write_text("\n".join(train_rows) + "\n")
+    (tmp_path / "inp" / "te-00000").write_text("\n".join(test_rows) + "\n")
+
+    dprops = _props(tmp_path / "sim.properties",
+                    **{"feature.schema.file.path": str(tmp_path / "schema.json"),
+                       "base.set.split.prefix": "tr"})
+    _run("SameTypeSimilarity", dprops, tmp_path / "inp", tmp_path / "simi")
+
+    kprops = _props(tmp_path / "knn.properties",
+                    **{"feature.schema.file.path": str(tmp_path / "schema.json"),
+                       "top.match.count": "5",
+                       "validation.mode": "true",
+                       "kernel.function": "none"})
+    _run("NearestNeighbor", kprops, tmp_path / "simi", tmp_path / "pred")
+    lines = _outlines(tmp_path / "pred")
+    assert len(lines) == 20
+    correct = sum(1 for l in lines if l.split(",")[-1] == l.split(",")[-2])
+    assert correct >= 18
+
+
+def test_tutorial_price_optimization_rounds(tmp_path, mesh8):
+    """price_optimize_tutorial.txt: bandit rounds with external reward
+    scoring; by the late rounds most products select their best price."""
+    from avenir_tpu.models.bandit import aggregate_rewards
+
+    n_prod, n_price = 15, 4
+    _, mean_profit, _ = gen_price_rounds(n_prod, n_price, seed=43)
+    best = mean_profit.argmax(axis=1)
+    rng = np.random.default_rng(0)
+    # state rows: group,item,count,avgReward (scaled int rewards)
+    state = {(p, k): [0, 0] for p in range(n_prod) for k in range(n_price)}
+    (tmp_path / "batch.txt").write_text(
+        "\n".join(f"prod{p},1" for p in range(n_prod)))
+
+    for rnd in range(1, 41):
+        write_output(str(tmp_path / "in"),
+                     [f"prod{p},price{k},{c},{r}"
+                      for (p, k), (c, r) in state.items()])
+        props = _props(tmp_path / "grb.properties",
+                       **{"count.ordinal": "2", "reward.ordinal": "3",
+                          "group.item.count.path": str(tmp_path / "batch.txt"),
+                          "current.round.num": str(rnd),
+                          "random.seed": str(rnd),
+                          "prob.reduction.algorithm": "AuerGreedy",
+                          "auer.greedy.constant": "1"})
+        _run("GreedyRandomBandit", props, tmp_path / "in", tmp_path / "out")
+        for line in _outlines(tmp_path / "out"):
+            g, item = line.split(",")
+            p, k = int(g[4:]), int(item[5:])
+            # score with a clear best/rest margin so the Auer ε schedule
+            # (ε = K/(d²t)) falls below 1 within the simulated rounds
+            reward = int((1000 if k == best[p] else 400) + rng.normal(0, 50))
+            c, r = state[(p, k)]
+            state[(p, k)] = [c + 1, (c * r + reward) // (c + 1)]
+
+    hits = sum(1 for line in _outlines(tmp_path / "out")
+               for g, item in [line.split(",")]
+               if int(item[5:]) == best[int(g[4:])])
+    assert hits >= int(0.7 * n_prod)
