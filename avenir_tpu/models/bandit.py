@@ -138,7 +138,16 @@ class _BanditJobBase:
     def _batch_size(batch_sizes, group_id) -> int:
         if not batch_sizes:
             return 1
-        return batch_sizes[group_id][-1]
+        try:
+            return batch_sizes[group_id][-1]
+        except KeyError:
+            raise ValueError(
+                f"group {group_id!r} present in the input but missing from "
+                f"the group.item.count.path side file") from None
+        except IndexError:
+            raise ValueError(
+                f"group {group_id!r} line in the group.item.count.path side "
+                f"file has no batch-size column") from None
 
 
 class GreedyRandomBandit(_BanditJobBase):

@@ -64,9 +64,18 @@ class LogisticRegressor:
         self.aggregates = np.asarray(aggregates, dtype=np.float64)
 
     def coeff_diff(self) -> np.ndarray:
-        """|(new - old) * 100 / old| per coefficient."""
-        return np.abs((self.aggregates - self.coefficients) * 100.0
-                      / self.coefficients)
+        """|(new - old) * 100 / old| per coefficient.
+
+        A coefficient that is exactly 0 in the previous line (the natural
+        all-zero starting point) would make the reference formula divide by
+        zero and never converge; treat 0 -> 0 as 0% change and 0 -> nonzero
+        as infinite change so thresholds behave sensibly.
+        """
+        with np.errstate(divide="ignore", invalid="ignore"):
+            diff = np.abs((self.aggregates - self.coefficients) * 100.0
+                          / self.coefficients)
+        both_zero = (self.coefficients == 0.0) & (self.aggregates == 0.0)
+        return np.where(both_zero, 0.0, diff)
 
     def is_all_converged(self, threshold: float) -> bool:
         return bool(np.all(self.coeff_diff() <= threshold))
@@ -203,11 +212,20 @@ class LogisticRegressionJob:
     # -- the outer do-while (reference main) --------------------------------
     def run_loop(self, in_path: str, out_path: str,
                  max_iterations: Optional[int] = None) -> int:
+        # finite default bound: a threshold criterion that never fires (e.g.
+        # a coefficient stuck at +/-inf percent change) must not spin forever;
+        # an iterLimit run keeps its full configured budget even past the cap
+        if max_iterations is None:
+            max_iterations = self.config.get_int("max.iterations", 1000)
+            criteria = self.config.get("convergence.criteria", ITER_LIMIT)
+            if criteria == ITER_LIMIT:
+                max_iterations = max(max_iterations,
+                                     self.config.get_int("iteration.limit", 10))
         status = NOT_CONVERGED
         it = 0
         while status == NOT_CONVERGED:
             status = self.run(in_path, out_path)
             it += 1
-            if max_iterations is not None and it >= max_iterations:
+            if it >= max_iterations:
                 break
         return status

@@ -438,8 +438,10 @@ class SoftMaxLearner(ReinforcementLearner):
                     self.sampler.add(a.id, exp_distr[a.id] / total)
                 self.rewarded = False
             action = self.find_action(self.sampler.sample(self.rng))
-            # temperature decay (SoftMaxLearner.java:96-109)
-            soft_max_round = self.total_trial_count - max(self.min_trial, 0)
+            # temperature decay (SoftMaxLearner.java:96-109); min_trial is
+            # subtracted raw — it defaults to -1, so with min.trial unset the
+            # divisor is totalTrialCount+1, exactly as in the reference
+            soft_max_round = self.total_trial_count - self.min_trial
             if soft_max_round > 1:
                 if self.temp_red_algorithm == self.TEMP_RED_LINEAR:
                     self.temp_constant /= soft_max_round

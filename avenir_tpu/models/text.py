@@ -21,6 +21,8 @@ from __future__ import annotations
 import re
 from typing import List
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..core.config import JobConfig
@@ -90,4 +92,8 @@ class WordCounter:
 
 
 def _wc_local(ids, mask, n_words):
-    return count_table((n_words,), (ids,), weights=None, mask=mask)
+    # int64 counts when x64 is on (the CLI enables it): a token can exceed
+    # 2^31 occurrences in a large corpus and must not silently overflow
+    dtype = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    return count_table((n_words,), (ids,), weights=None, mask=mask,
+                       dtype=dtype)

@@ -146,3 +146,33 @@ def test_learning_rate_mode_learns_separable(tmp_path, mesh8):
     x = np.concatenate([np.ones((n, 1)), feats], axis=1).astype(float)
     pred = (1.0 / (1.0 + np.exp(-(x @ w))) > 0.5).astype(int)
     assert (pred == y).mean() > 0.95
+
+
+def test_coeff_diff_zero_to_zero_is_converged():
+    """A coefficient that stays exactly 0 across iterations counts as 0%
+    change (the reference formula divides by the old value and would yield
+    NaN, making threshold convergence unreachable from the natural all-zero
+    starting line)."""
+    reg = LogisticRegressor(np.asarray([0.0, 2.0]), np.asarray([0.0, 2.01]))
+    diff = reg.coeff_diff()
+    assert diff[0] == 0.0
+    assert diff[1] == pytest.approx(0.5)
+    assert reg.is_all_converged(1.0)
+    # 0 -> nonzero is infinite change, never converged
+    reg2 = LogisticRegressor(np.asarray([0.0]), np.asarray([1.0]))
+    assert not reg2.is_all_converged(1e9)
+
+
+def test_run_loop_has_finite_default_bound(tmp_path, mesh8):
+    """run_loop must terminate even when the convergence criterion can never
+    fire (no learning.rate: aggregates are raw gradients that keep moving)."""
+    rows = [["r0", "1", "2", "Y"], ["r1", "-1", "-2", "N"]]
+    _write_inputs(tmp_path, rows, "0.0,0.0,0.0")
+    cfg = _cfg(tmp_path, **{"convergence_criteria": ALL_BELOW_THRESHOLD,
+                            "convergence_threshold": "1e-30",
+                            "max_iterations": "5"})
+    job = LogisticRegressionJob(cfg)
+    status = job.run_loop(str(tmp_path / "in"), str(tmp_path / "out"))
+    assert status == NOT_CONVERGED
+    history = (tmp_path / "coeff.txt").read_text().splitlines()
+    assert len(history) == 6  # initial line + 5 bounded iterations

@@ -287,3 +287,28 @@ def test_streaming_accepts_reference_typo_keys():
               "random.seed": "1"}
     loop = StreamingLearnerLoop(config, InMemoryTransport())
     assert loop.learner.find_action("x") is not None
+
+
+def test_softmax_decay_divisor_matches_reference():
+    """SoftMaxLearner.java:97 subtracts the raw minTrial (default -1), so
+    with min.trial unset the decay divisor is totalTrialCount + 1."""
+    learner = create_learner(
+        "softMax", ACTIONS,
+        {"temp.constant": "8", "temp.reduction.algorithm": "linear",
+         "random.seed": "5"})
+    learner.rewarded = True
+    for a in ACTIONS:
+        learner.reward_stats[a].add(10)
+    learner.next_action()
+    # after the first trial: softMaxRound = 1 - (-1) = 2 > 1 -> temp /= 2
+    assert learner.temp_constant == pytest.approx(8.0 / 2.0)
+
+
+def test_bandit_missing_group_in_side_file_raises_value_error(tmp_path):
+    write_output(str(tmp_path / "batch.txt"), ["g0,3"])
+    write_output(str(tmp_path / "in"),
+                 ["gX,item1,0,0", "gX,item2,0,0"])
+    cfg = _bandit_cfg(tmp_path)
+    with pytest.raises(ValueError, match="gX"):
+        GreedyRandomBandit(cfg).run(str(tmp_path / "in"),
+                                    str(tmp_path / "out"))
