@@ -143,3 +143,38 @@ def test_itemset_list_loader(tmp_path):
     s = isl.get_item_set_list()[0]
     assert s.items == ["a", "b"]
     assert s.contains_trans("T1") and not s.contains_trans("T3")
+
+
+def test_distinct_mode_dedupes_transaction_ids(tmp_path, mesh8):
+    """A transaction split across input lines counts ONCE in distinct
+    (emit.trans.id) mode — the reference reducer unions trans-id strings
+    (FrequentItemsApriori.java:311-326) — while count mode counts each
+    supporting input row."""
+    lines = ["T1,A,B", "T1,A,B", "T2,A,B", "T3,C"]
+    write_output(str(tmp_path / "trans"), lines)
+    base = {"fia.skip.field.count": "1", "fia.tans.id.ord": "0",
+            "fia.support.threshold": "0.1", "fia.total.tans.count": "3"}
+
+    def run(k, mode, out):
+        props = dict(base)
+        props["fia.item.set.length"] = str(k)
+        props["fia.emit.trans.id"] = mode
+        if k > 1:
+            props["fia.item.set.file.path"] = str(tmp_path / f"k1_{mode}")
+        job = FrequentItemsApriori(JobConfig(props))
+        job.run(str(tmp_path / "trans"), str(tmp_path / out), mesh=mesh8)
+        return open(str(tmp_path / out / "part-r-00000")).read().splitlines()
+
+    # distinct mode: A appears in tids {T1, T2} -> support 2/3, deduped tids
+    k1d = run(1, "true", "k1_true")
+    a_line = [l for l in k1d if l.startswith("A,")][0]
+    assert a_line == "A,T1,T2,0.667"
+    k2d = run(2, "true", "k2_true")
+    ab = [l for l in k2d if l.startswith("A,B,")][0]
+    assert ab == "A,B,T1,T2,0.667"
+    # count mode: every occurrence/row counts (A occurs on 3 rows)
+    k1c = run(1, "false", "k1_false")
+    assert [l for l in k1c if l.startswith("A,")][0] == "A,3,1.000"
+    k2c = run(2, "false", "k2_false")
+    # 3 supporting rows x multiplicity 2 (both 1-subsets frequent)
+    assert [l for l in k2c if l.startswith("A,B,")][0] == "A,B,6,2.000"
