@@ -77,8 +77,18 @@ class EncodedDataset:
     binned_mask: np.ndarray          # bool [F]: column is binned
     vocabs: Dict[int, Vocab]         # per feature ordinal (categorical cols)
     class_vocab: Optional[Vocab]
-    ids: List[str] = dc_field(default_factory=list)
+    ids_raw: object = None           # List[str] or S-bytes ndarray (lazy)
     rows: List[List[str]] = dc_field(default_factory=list)
+
+    @property
+    def ids(self) -> List[str]:
+        """Row ids as Python strings (materialized from the native ingest's
+        bytes column on first access — the training path never pays for it)."""
+        if self.ids_raw is None:
+            self.ids_raw = []
+        elif isinstance(self.ids_raw, np.ndarray):
+            self.ids_raw = [s.decode() for s in self.ids_raw.tolist()]
+        return self.ids_raw
 
     @property
     def n_rows(self) -> int:
@@ -114,54 +124,99 @@ class DatasetEncoder:
             Vocab(self.class_field.cardinality or ()) if self.class_field else None
         )
 
-    def encode(self, records: Iterable[Sequence[str]],
-               keep_rows: bool = False) -> EncodedDataset:
+    def _encode_categorical(self, vocab: Vocab, col: np.ndarray) -> np.ndarray:
+        """Vectorized vocab encode of one string (or bytes) column.
+
+        New values are registered in FIRST-SEEN order (np.unique sorts, so the
+        first-occurrence indices recover document order) — identical ordinal
+        assignment to the original per-row ``vocab.add`` loop, which the model
+        text formats depend on for reproducible bin labels.
+        """
+        uniq, first, inv = np.unique(col, return_index=True,
+                                     return_inverse=True)
+        lut = np.empty(len(uniq), dtype=np.int32)
+        for k in np.argsort(first, kind="stable"):
+            u = uniq[k]
+            lut[k] = vocab.add(u.decode() if isinstance(u, bytes) else str(u))
+        return lut[inv.reshape(-1)]
+
+    def encode(self, records, keep_rows: bool = False) -> EncodedDataset:
+        """Encode records into the columnar device-ready form.
+
+        ``records`` may be a 2-D string ndarray (the bulk-ingest fast path
+        from ``read_field_matrix``) or any iterable of field lists. Either
+        way the encode itself is column-vectorized: one NumPy pass per schema
+        column (vocab via ``np.unique``, bucket binning via vectorized
+        truncated division) instead of the per-row/per-field Python loop the
+        reference's mappers imply (BayesianDistribution.java:144-175).
+        """
         ffields = self.feature_fields
         n_f = len(ffields)
-        xs: List[List[int]] = []
-        vs: List[List[float]] = []
-        ys: List[int] = []
-        ids: List[str] = []
-        kept: List[List[str]] = []
 
-        binned_mask = np.array(
-            [f.is_categorical() or f.is_bucket_width_defined() for f in ffields],
-            dtype=bool)
+        if isinstance(records, np.ndarray) and records.ndim == 2:
+            arr = records
+            n = arr.shape[0]
 
-        for items in records:
-            xrow = [0] * n_f
-            vrow = [0.0] * n_f
-            for j, f in enumerate(ffields):
-                raw = items[f.ordinal]
-                if f.is_categorical():
-                    xrow[j] = self.vocabs[f.ordinal].add(raw)
-                elif f.is_bucket_width_defined():
-                    v, w = int(raw), int(f.bucketWidth)
+            def col(ordinal: int) -> np.ndarray:
+                if ordinal >= arr.shape[1]:
+                    raise IndexError(
+                        f"schema ordinal {ordinal} out of range for "
+                        f"{arr.shape[1]}-column input")
+                return arr[:, ordinal]
+
+            kept = [list(r) for r in arr.tolist()] if keep_rows else []
+        else:
+            rows = records if isinstance(records, list) else [list(r) for r in records]
+            n = len(rows)
+
+            def col(ordinal: int) -> np.ndarray:
+                return np.asarray([r[ordinal] for r in rows], dtype=str)
+
+            kept = [list(r) for r in rows] if keep_rows else []
+
+        x = np.zeros((n, n_f), dtype=np.int32)
+        values = np.zeros((n, n_f), dtype=np.float64)
+        for j, f in enumerate(ffields):
+            if f.is_categorical():
+                if n:
+                    x[:, j] = self._encode_categorical(
+                        self.vocabs[f.ordinal], col(f.ordinal))
+            elif f.is_bucket_width_defined():
+                if n:
+                    v = col(f.ordinal).astype(np.int64)
+                    w = int(f.bucketWidth)
                     # Java integer division truncates toward zero
-                    xrow[j] = -((-v) // w) if v < 0 else v // w
-                    vrow[j] = float(raw)
-                else:
-                    xrow[j] = -1
-                    vrow[j] = float(raw)
-            xs.append(xrow)
-            vs.append(vrow)
-            if self.class_field is not None:
-                ys.append(self.class_vocab.add(items[self.class_field.ordinal]))
-            if self.id_field is not None:
-                ids.append(items[self.id_field.ordinal])
-            if keep_rows:
-                kept.append(list(items))
+                    x[:, j] = np.where(v < 0, -((-v) // w), v // w)
+                    values[:, j] = v
+            else:
+                x[:, j] = -1
+                if n:
+                    values[:, j] = col(f.ordinal).astype(np.float64)
+
+        if self.class_field is not None and n:
+            y = self._encode_categorical(self.class_vocab,
+                                         col(self.class_field.ordinal))
+        else:
+            y = np.full(n, -1, dtype=np.int32)
+        ids = [str(s) for s in col(self.id_field.ordinal)] \
+            if self.id_field is not None and n else []
+
+        return self._assemble(x, values, y, ids, kept)
+
+    def _assemble(self, x, values, y, ids, kept) -> EncodedDataset:
+        """Shared tail: negative-bin shift, bin extents, dataset packing."""
+        ffields = self.feature_fields
+        n = x.shape[0]
 
         # shift any negative-binned column so dense count tensors stay
         # zero-based; bin_label() adds the offset back for output parity
-        bin_offset = np.zeros(n_f, dtype=np.int32)
+        bin_offset = np.zeros(len(ffields), dtype=np.int32)
         for j, f in enumerate(ffields):
-            if f.is_bucket_width_defined() and xs:
-                lo = min(r[j] for r in xs)
+            if f.is_bucket_width_defined() and n:
+                lo = int(x[:, j].min())
                 if lo < 0:
                     bin_offset[j] = lo
-                    for r in xs:
-                        r[j] -= lo
+                    x[:, j] -= lo
 
         num_bins = []
         for j, f in enumerate(ffields):
@@ -169,28 +224,107 @@ class DatasetEncoder:
                 num_bins.append(len(self.vocabs[f.ordinal]))
             elif f.is_bucket_width_defined():
                 declared = f.num_bins() if f.max is not None else 0
-                seen = int(max(r[j] for r in xs)) + 1 if xs else 0
+                seen = int(x[:, j].max()) + 1 if n else 0
                 num_bins.append(max(declared, seen))
             else:
                 num_bins.append(0)
 
+        binned_mask = np.array(
+            [f.is_categorical() or f.is_bucket_width_defined()
+             for f in ffields], dtype=bool)
         return EncodedDataset(
             schema=self.schema,
             feature_fields=ffields,
-            x=np.asarray(xs, dtype=np.int32).reshape(len(xs), n_f),
-            values=np.asarray(vs, dtype=np.float64).reshape(len(vs), n_f),
-            y=np.asarray(ys, dtype=np.int32) if ys else
-              np.full(len(xs), -1, dtype=np.int32),
+            x=x,
+            values=values,
+            y=np.asarray(y, dtype=np.int32),
             num_bins=num_bins,
             bin_offset=bin_offset,
             binned_mask=binned_mask,
             vocabs=self.vocabs,
             class_vocab=self.class_vocab,
-            ids=ids,
+            ids_raw=ids,
             rows=kept,
         )
 
+    def _encode_path_native(self, path: str,
+                            delim: str) -> Optional[EncodedDataset]:
+        """C-kernel ingest: one native pass parses, bucket-bins, and
+        categorical-hash-encodes every schema column straight into the final
+        int32/float64 matrices — no Python string objects, no U-dtype
+        matrix.  Returns None when the fast path does not apply."""
+        from . import io as _io
+        from .. import native
+
+        if native.get_lib() is None:
+            return None
+        files = _io._input_files(path)
+        if not files:
+            return None
+        with open(files[0], "r") as fh:
+            first = fh.readline().rstrip("\n")
+        if not first:
+            return None
+        n_cols = first.count(delim) + 1
+
+        ffields = self.feature_fields
+        specs = []
+        for j, f in enumerate(ffields):
+            if f.is_categorical():
+                specs.append((f.ordinal, native.CAT, j, 0))
+            elif f.is_bucket_width_defined():
+                specs.append((f.ordinal, native.BUCKET, j, int(f.bucketWidth)))
+            else:
+                specs.append((f.ordinal, native.FLOATVAL, j, 0))
+        if self.class_field is not None:
+            specs.append((self.class_field.ordinal, native.CAT,
+                          native.Y_DEST, 0))
+        if self.id_field is not None and self.id_field.ordinal >= n_cols:
+            return None     # fall back so the schema misfit errors loudly
+        id_ord = self.id_field.ordinal if self.id_field is not None else -1
+
+        res = native.encode_schema(path, specs, n_cols, len(ffields),
+                                   self.class_field is not None,
+                                   id_ordinal=id_ord, delim=delim)
+        if res is None:
+            return None
+        n, x, values, y, ids, cat_uniques = res
+
+        # remap C first-seen codes -> stable vocab ids (declared cardinality
+        # first, then first-seen appended — same order vocab.add produces)
+        for j, f in enumerate(ffields):
+            if f.is_categorical():
+                x[:, j] = self._cat_lut(self.vocabs[f.ordinal],
+                                        cat_uniques[f.ordinal])[x[:, j]]
+            elif not f.is_bucket_width_defined():
+                x[:, j] = -1
+        if self.class_field is not None and n:
+            y = self._cat_lut(self.class_vocab,
+                              cat_uniques[self.class_field.ordinal])[y]
+        else:
+            y = np.full(n, -1, dtype=np.int32)
+        return self._assemble(x, values, y,
+                              ids if ids is not None else [], [])
+
+    @staticmethod
+    def _cat_lut(vocab: Vocab, uniques) -> np.ndarray:
+        lut = np.empty(max(len(uniques), 1), dtype=np.int32)
+        for k, u in enumerate(uniques):
+            lut[k] = vocab.add(u.decode())
+        return lut
+
     def encode_path(self, path: str, delim_regex: str = ",",
                     keep_rows: bool = False) -> EncodedDataset:
-        from .io import read_records
-        return self.encode(read_records(path, delim_regex), keep_rows=keep_rows)
+        from .io import is_plain_delim, read_field_matrix, read_records
+        if not keep_rows and is_plain_delim(delim_regex):
+            try:
+                ds = self._encode_path_native(path, delim_regex)
+            except (ValueError, OSError):
+                ds = None
+            if ds is not None:
+                return ds
+        arr = read_field_matrix(path, delim_regex)
+        if arr is not None:
+            return self.encode(arr, keep_rows=keep_rows)
+        return self.encode([list(r) for r in read_records(path, delim_regex)],
+                           keep_rows=keep_rows)

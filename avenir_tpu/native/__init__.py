@@ -1,0 +1,232 @@
+"""Native runtime components (C, loaded via ctypes).
+
+The compute path of the framework is XLA-compiled JAX; the host runtime
+around it — here, CSV ingest — is native C.  The reference's equivalent
+layer is Hadoop's record readers + JVM string handling (SURVEY §2.0: the
+reference has no native code of its own; its "native" layer is the JVM).
+
+The kernel source lives next to this file and is compiled on demand with the
+system C compiler into ``_csv_ingest.so`` (rebuilt when the source is newer).
+Every entry point degrades gracefully: if no compiler is available or the
+input doesn't fit the fast path, callers fall back to the pure-NumPy ingest
+in ``core.binning``/``core.io``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "csv_ingest.c")
+_SO = os.path.join(_HERE, "_csv_ingest.so")
+
+_lock = threading.Lock()
+_lib = None
+_lib_failed = False
+
+# column type codes shared with csv_ingest.c
+SKIP, INT64, FLOAT64, BYTES = 0, 1, 2, 3
+BUCKET, FLOATVAL, CAT = 1, 2, 4      # csv_encode column roles
+Y_DEST = -2                          # feat_idx routing a CAT column to ycol
+
+
+def _compile() -> bool:
+    for cc in ("cc", "gcc", "g++"):
+        try:
+            proc = subprocess.run(
+                [cc, "-O3", "-shared", "-fPIC", "-o", _SO, _SRC],
+                capture_output=True, timeout=120)
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        if proc.returncode == 0:
+            return True
+    return False
+
+
+def get_lib():
+    """The loaded C kernel, or None if it can't be built on this host."""
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                if not _compile():
+                    raise OSError("no working C compiler")
+            lib = ctypes.CDLL(_SO)
+            lib.csv_scan.restype = ctypes.c_longlong
+            lib.csv_scan.argtypes = [
+                ctypes.c_char_p, ctypes.c_longlong, ctypes.c_char,
+                ctypes.c_int, ctypes.POINTER(ctypes.c_int)]
+            lib.csv_parse.restype = ctypes.c_int
+            lib.csv_parse.argtypes = [
+                ctypes.c_char_p, ctypes.c_longlong, ctypes.c_char,
+                ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_void_p), ctypes.c_longlong]
+            lib.csv_encode.restype = ctypes.c_int
+            lib.csv_encode.argtypes = [
+                ctypes.c_char_p, ctypes.c_longlong, ctypes.c_char,
+                ctypes.c_int,                        # n_cols
+                ctypes.POINTER(ctypes.c_int),        # col_type
+                ctypes.POINTER(ctypes.c_int),        # feat_idx
+                ctypes.POINTER(ctypes.c_longlong),   # bucket_w
+                ctypes.c_int, ctypes.c_longlong,     # F, n_rows
+                ctypes.c_void_p, ctypes.c_void_p,    # x, values
+                ctypes.c_void_p,                     # ycol
+                ctypes.POINTER(ctypes.c_void_p),     # bytes_out
+                ctypes.POINTER(ctypes.c_int),        # bytes_width
+                ctypes.c_void_p, ctypes.c_void_p,    # uniq_start, uniq_len
+                ctypes.c_void_p, ctypes.c_int]       # n_uniq, max_uniq
+            _lib = lib
+        except Exception as e:  # pragma: no cover - environment-dependent
+            print(f"avenir_tpu.native: C ingest unavailable ({e}); "
+                  f"using NumPy fallback", file=sys.stderr)
+            _lib_failed = True
+    return _lib
+
+
+def _read_buffer(path: str) -> bytes:
+    """Concatenate a file or every part file of a job-output directory."""
+    from ..core.io import _input_files
+    parts = []
+    for fp in _input_files(path):
+        with open(fp, "rb") as fh:
+            parts.append(fh.read())
+    return b"\n".join(parts)
+
+
+def parse_csv_columns(path: str, col_types: Sequence[int], delim: str = ","
+                      ) -> Optional[Tuple[int, Dict[int, np.ndarray]]]:
+    """Parse a delimited file (or part-file dir) into typed NumPy columns.
+
+    ``col_types[i]`` is SKIP/INT64/FLOAT64/BYTES for column ordinal ``i``;
+    trailing file columns beyond ``len(col_types)`` are not allowed (the
+    caller sizes ``col_types`` to the file's column count).  Returns
+    ``(n_rows, {ordinal: array})`` or None when the fast path does not apply
+    (no compiler, ragged rows, unparseable numerics) — callers then fall
+    back to the NumPy path.
+    """
+    lib = get_lib()
+    if lib is None or len(delim) != 1:
+        return None
+    buf = _read_buffer(path)
+    n_cols = len(col_types)
+    bdelim = ctypes.c_char(delim.encode())
+    widths = (ctypes.c_int * n_cols)(*([0] * n_cols))
+    n_rows = lib.csv_scan(buf, len(buf), bdelim, n_cols, widths)
+    if n_rows < 0:
+        return None
+
+    cols: Dict[int, np.ndarray] = {}
+    outs = (ctypes.c_void_p * n_cols)(*([None] * n_cols))
+    ctypes_types = (ctypes.c_int * n_cols)(*col_types)
+    for j, t in enumerate(col_types):
+        if t == INT64:
+            a = np.empty(n_rows, dtype=np.int64)
+        elif t == FLOAT64:
+            a = np.empty(n_rows, dtype=np.float64)
+        elif t == BYTES:
+            a = np.empty(n_rows, dtype=f"S{max(int(widths[j]), 1)}")
+        else:
+            continue
+        cols[j] = a
+        outs[j] = a.ctypes.data
+    rc = lib.csv_parse(buf, len(buf), bdelim, n_cols, ctypes_types, widths,
+                       outs, n_rows)
+    if rc != 0:
+        return None
+    return int(n_rows), cols
+
+
+def encode_schema(path: str, col_specs: Sequence[Tuple[int, int, int]],
+                  n_file_cols: int, n_feat: int, has_class: bool,
+                  id_ordinal: int = -1, delim: str = ",",
+                  max_uniq: int = 1 << 16):
+    """Single-pass schema-aware encode: the DatasetEncoder hot path in C.
+
+    ``col_specs`` is ``(ordinal, role, arg)`` per schema column where role is
+    BUCKET (arg = bucket width), FLOATVAL, or CAT, and ``arg`` for CAT is the
+    destination feature index (or Y_DEST for the class attribute). BUCKET and
+    FLOATVAL specs carry their feature index in ``arg2``... — concretely each
+    spec is ``(file_ordinal, role, feat_idx, extra)`` with ``extra`` the
+    bucket width for BUCKET columns.
+
+    Returns ``(n_rows, x, values, y, ids, cat_uniques)`` where
+    ``cat_uniques[ordinal]`` is the first-seen list of raw byte values of
+    each categorical column (codes in ``x``/``y`` index into it), or None
+    when the fast path does not apply.
+    """
+    lib = get_lib()
+    if lib is None or len(delim) != 1:
+        return None
+    buf = _read_buffer(path)
+    bdelim = ctypes.c_char(delim.encode())
+
+    col_type = [SKIP] * n_file_cols
+    feat_idx = [-1] * n_file_cols
+    bucket_w = [1] * n_file_cols
+    for ordinal, role, fj, extra in col_specs:
+        if ordinal >= n_file_cols:
+            return None
+        col_type[ordinal] = role
+        feat_idx[ordinal] = fj
+        if role == BUCKET:
+            if extra <= 0:
+                return None
+            bucket_w[ordinal] = extra
+
+    widths = (ctypes.c_int * n_file_cols)(*([0] * n_file_cols))
+    n_rows = lib.csv_scan(buf, len(buf), bdelim, n_file_cols, widths)
+    if n_rows < 0:
+        return None
+
+    ids = None
+    bytes_out = (ctypes.c_void_p * n_file_cols)(*([None] * n_file_cols))
+    if id_ordinal >= 0:
+        col_type[id_ordinal] = BYTES
+        ids = np.empty(n_rows, dtype=f"S{max(int(widths[id_ordinal]), 1)}")
+        bytes_out[id_ordinal] = ids.ctypes.data
+
+    x = np.zeros((n_rows, n_feat), dtype=np.int32)
+    values = np.zeros((n_rows, n_feat), dtype=np.float64)
+    y = np.empty(n_rows, dtype=np.int32) if has_class else None
+    cat_ordinals = [o for o, t, _, _ in col_specs if t == CAT]
+    uniq_start = np.zeros((n_file_cols, max_uniq), dtype=np.int64) \
+        if cat_ordinals else np.zeros((1, 1), dtype=np.int64)
+    uniq_len = np.zeros_like(uniq_start, dtype=np.int32)
+    n_uniq = np.zeros(n_file_cols, dtype=np.int32)
+
+    rc = lib.csv_encode(
+        buf, len(buf), bdelim, n_file_cols,
+        (ctypes.c_int * n_file_cols)(*col_type),
+        (ctypes.c_int * n_file_cols)(*feat_idx),
+        (ctypes.c_longlong * n_file_cols)(*bucket_w),
+        n_feat, n_rows,
+        x.ctypes.data, values.ctypes.data,
+        y.ctypes.data if y is not None else None,
+        bytes_out, widths,
+        uniq_start.ctypes.data, uniq_len.ctypes.data, n_uniq.ctypes.data,
+        uniq_start.shape[1])
+    if rc == -3 and max_uniq < (1 << 22):   # vocab overflow: one retry, 64x
+        return encode_schema(path, col_specs, n_file_cols, n_feat, has_class,
+                             id_ordinal, delim, max_uniq=1 << 22)
+    if rc != 0:
+        return None
+
+    cat_uniques: Dict[int, List[bytes]] = {}
+    for o in cat_ordinals:
+        k = int(n_uniq[o])
+        cat_uniques[o] = [bytes(buf[int(s):int(s) + int(l)])
+                          for s, l in zip(uniq_start[o, :k], uniq_len[o, :k])]
+    return int(n_rows), x, values, y, ids, cat_uniques

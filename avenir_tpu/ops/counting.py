@@ -144,6 +144,10 @@ def feature_class_counts(x: jnp.ndarray, y: jnp.ndarray,
         c = jnp.einsum("nc,nfb->cfb", oy, ox,
                        preferred_element_type=jnp.float32)
         return c.astype(dtype)
+    # scatter indices must be >= int32 (narrow dtypes are a host->device
+    # transfer optimization; widening here happens on device for free)
+    x = x.astype(jnp.int32) if x.dtype.itemsize < 4 else x
+    y = y.astype(jnp.int32) if y.dtype.itemsize < 4 else y
     col = jnp.broadcast_to(jnp.arange(F, dtype=x.dtype)[None, :], (n, F))
     ycol = jnp.broadcast_to(y[:, None], (n, F))
     m = None if mask is None else jnp.broadcast_to(jnp.asarray(mask)[:, None], (n, F))

@@ -50,13 +50,8 @@ def bench_apriori():
     implementation is file-IO-bound; at 100x the support matmul dominates
     and the comparison is meaningful.  Baseline: the same counting in
     single-core NumPy."""
-    import os
     import shutil
     import tempfile
-
-    from avenir_tpu.core import JobConfig, write_output
-    from avenir_tpu.datagen import gen_transactions
-    from avenir_tpu.models.association import FrequentItemsApriori
 
     tmp = tempfile.mkdtemp(prefix="apriori_bench_")
     try:

@@ -1,0 +1,131 @@
+"""Native C ingest kernel: parity with the pure-NumPy encode path.
+
+The C path (avenir_tpu/native) must produce bit-identical encodings to
+DatasetEncoder's NumPy path — same bin indices, same vocab ordinal
+assignment (declared cardinality first, then first-seen), same raw values —
+since model text formats depend on the encoding (SURVEY §7.3 hard part 1).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from avenir_tpu import native
+from avenir_tpu.core import DatasetEncoder, FeatureSchema, write_output
+from avenir_tpu.core.io import read_field_matrix
+
+SCHEMA = FeatureSchema.from_json(json.dumps({"fields": [
+    {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+    {"name": "color", "ordinal": 1, "dataType": "categorical", "feature": True,
+     "cardinality": ["red", "green"]},
+    {"name": "amount", "ordinal": 2, "dataType": "int", "feature": True,
+     "min": -100, "max": 100, "bucketWidth": 7},
+    {"name": "score", "ordinal": 3, "dataType": "double", "feature": True},
+    {"name": "label", "ordinal": 4, "dataType": "categorical",
+     "cardinality": ["N", "Y"]},
+]}))
+
+
+def _rows(n=200, seed=3):
+    rng = np.random.default_rng(seed)
+    colors = ["blue", "red", "grey", "green", "teal"]
+    return [[f"id{i:04d}",
+             colors[rng.integers(len(colors))],
+             str(int(rng.integers(-100, 100))),
+             f"{rng.uniform(-5, 5):.4f}",
+             "Y" if rng.random() < 0.3 else "N"]
+            for i in range(n)]
+
+
+def _write(tmp_path, rows, name="in", eol="\n"):
+    p = tmp_path / name
+    p.write_text(eol.join(",".join(r) for r in rows) + eol)
+    return str(p)
+
+
+@pytest.fixture
+def have_native():
+    if native.get_lib() is None:
+        pytest.skip("C toolchain unavailable")
+
+
+def test_native_matches_numpy_path(tmp_path, have_native):
+    rows = _rows()
+    path = _write(tmp_path, rows)
+
+    enc_native = DatasetEncoder(SCHEMA)
+    ds_n = enc_native._encode_path_native(path, ",")
+    assert ds_n is not None, "native path unexpectedly unavailable"
+
+    enc_py = DatasetEncoder(SCHEMA)
+    ds_p = enc_py.encode([list(r) for r in rows])
+
+    np.testing.assert_array_equal(ds_n.x, ds_p.x)
+    np.testing.assert_array_equal(ds_n.y, ds_p.y)
+    np.testing.assert_allclose(ds_n.values, ds_p.values)
+    assert ds_n.num_bins == ds_p.num_bins
+    np.testing.assert_array_equal(ds_n.bin_offset, ds_p.bin_offset)
+    for ordinal in enc_py.vocabs:
+        assert enc_native.vocabs[ordinal].values == enc_py.vocabs[ordinal].values
+    assert enc_native.class_vocab.values == enc_py.class_vocab.values
+    assert ds_n.ids == ds_p.ids  # lazy bytes -> str materialization
+
+
+def test_encode_path_uses_native_and_matches(tmp_path, have_native):
+    rows = _rows(seed=11)
+    path = _write(tmp_path, rows)
+    ds = DatasetEncoder(SCHEMA).encode_path(path)
+    ds_ref = DatasetEncoder(SCHEMA).encode([list(r) for r in rows])
+    np.testing.assert_array_equal(ds.x, ds_ref.x)
+    np.testing.assert_array_equal(ds.y, ds_ref.y)
+
+
+def test_native_crlf_and_part_dirs(tmp_path, have_native):
+    rows = _rows(60, seed=5)
+    # CRLF file
+    crlf = _write(tmp_path, rows, name="crlf.csv", eol="\r\n")
+    ds_c = DatasetEncoder(SCHEMA)._encode_path_native(crlf, ",")
+    ds_ref = DatasetEncoder(SCHEMA).encode([list(r) for r in rows])
+    np.testing.assert_array_equal(ds_c.x, ds_ref.x)
+    np.testing.assert_array_equal(ds_c.y, ds_ref.y)
+    # job-output directory with two part files
+    write_output(str(tmp_path / "dir"), [",".join(r) for r in rows[:30]])
+    write_output(str(tmp_path / "dir"), [",".join(r) for r in rows[30:]],
+                 shard=1)
+    ds_d = DatasetEncoder(SCHEMA)._encode_path_native(str(tmp_path / "dir"), ",")
+    assert ds_d.n_rows == len(rows)
+
+
+def test_native_java_negative_division(tmp_path, have_native):
+    # Java/C integer division truncates toward zero: -13/7 == -1, not -2
+    rows = [["a", "red", "-13", "0.0", "N"], ["b", "red", "13", "0.0", "Y"]]
+    path = _write(tmp_path, rows)
+    ds = DatasetEncoder(SCHEMA)._encode_path_native(path, ",")
+    ref = DatasetEncoder(SCHEMA).encode([list(r) for r in rows])
+    np.testing.assert_array_equal(ds.x, ref.x)
+    assert int(ds.bin_offset[1]) == -1
+
+
+def test_native_falls_back_on_bad_numeric(tmp_path, have_native):
+    rows = [["a", "red", "oops", "0.0", "N"]]
+    path = _write(tmp_path, rows)
+    assert DatasetEncoder(SCHEMA)._encode_path_native(path, ",") is None
+
+
+def test_read_field_matrix_ragged_returns_none(tmp_path):
+    (tmp_path / "r.csv").write_text("a,b,c\na,b\n")
+    assert read_field_matrix(str(tmp_path / "r.csv")) is None
+
+
+def test_parse_csv_columns_roundtrip(tmp_path, have_native):
+    p = tmp_path / "t.csv"
+    p.write_text("1,x,2.5\n-7,yy,0.125\n42,zzz,-3\n")
+    res = native.parse_csv_columns(
+        str(p), [native.INT64, native.BYTES, native.FLOAT64])
+    assert res is not None
+    n, cols = res
+    assert n == 3
+    np.testing.assert_array_equal(cols[0], [1, -7, 42])
+    assert cols[1].tolist() == [b"x", b"yy", b"zzz"]
+    np.testing.assert_allclose(cols[2], [2.5, 0.125, -3.0])
