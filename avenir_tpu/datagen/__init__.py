@@ -14,4 +14,16 @@ from .generators import (  # noqa: F401
     gen_hmm_sequences,
     gen_price_rounds,
     gen_numeric_classed,
+    gen_text_classified,
+    gen_elearn,
+    gen_retarget,
+    gen_hosp_readmit,
+    gen_disease,
+    gen_usage,
+    gen_visit_history,
+    gen_event_seq,
+    gen_xactions,
+    ctr_reward_sampler,
+    RETARGET_CONVERSION,
+    EVENT_SEQ_STATES,
 )

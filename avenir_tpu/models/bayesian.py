@@ -10,6 +10,14 @@ Reference surface being re-expressed (citations into /root/reference):
   model text (BayesianPredictor.java:186-224), computes per-class
   ``P(C|x) ∝ P(x|C)P(C)/P(x)`` scaled to int percent (:396-421), arbitrates
   max-prob / cost-based (:342-391), emits prediction + confusion counters.
+- text mode (``tabular.input=false``) — the trainer alternatively consumes
+  ``text<delim>classVal`` lines, Lucene-tokenizes the text, and counts token
+  presence per class at the fixed feature ordinal 1
+  (BayesianDistribution.java:126-131 analyzer setup, :187-196 mapText);
+  the model file uses the same format with tokens as bin labels.  The
+  matching predictor text mode here is net-new (the reference ships no text
+  predictor): it tokenizes, scores ``P(C)·Π P(tok|C) / Π P(tok)`` through
+  the loaded model, and arbitrates exactly like the tabular path.
 
 TPU re-design: binning happens once in ingest (core.binning); the whole
 mapper+shuffle+reducer collapses into one ``feature_class_counts`` /
@@ -100,13 +108,20 @@ class BayesianDistribution:
 
     def __init__(self, config: JobConfig, schema: Optional[FeatureSchema] = None):
         self.config = config
-        self.schema = schema or FeatureSchema.from_file(
-            config.must("feature.schema.file.path"))
+        self.tabular = config.get_boolean("tabular.input", True)
+        if self.tabular:
+            self.schema = schema or FeatureSchema.from_file(
+                config.must("feature.schema.file.path"))
+        else:
+            self.schema = schema      # text mode needs no feature schema
 
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         delim_in = self.config.field_delim_regex()
         delim = self.config.field_delim_out()
+        if not self.tabular:
+            return self._run_text(in_path, out_path, counters, delim_in,
+                                  delim, mesh)
 
         enc = DatasetEncoder(self.schema)
         ds = enc.encode_path(in_path, delim_in)
@@ -185,6 +200,53 @@ class BayesianDistribution:
             std = _jstd(int(vsq), int(cnt), mean)
             lines.append(f"{delim}{ordinal}{delim}{delim}{mean}{delim}{std}")
         return lines
+
+    # -- text-classification mode -----------------------------------------
+    TEXT_ORDINAL = 1   # fixed featureAttrOrdinal (BayesianDistribution.java:121)
+
+    def _run_text(self, in_path: str, out_path: str, counters: Counters,
+                  delim_in: str, delim: str, mesh=None) -> Counters:
+        """``tabular.input=false``: each record is ``text<delim>classVal``;
+        tokens are counted as binned feature values of ordinal 1
+        (BayesianDistribution.java:187-196).  Tokenization and vocab
+        assignment are host passes (strings never go on device); the count
+        itself is the same sharded engine as the tabular path, flattened to
+        one (record, token) row per token occurrence."""
+        from ..core.binning import Vocab
+        from .text import standard_tokenize
+
+        vocab = Vocab()
+        class_vocab = Vocab()
+        tok_ids: List[int] = []
+        cls_ids: List[int] = []
+        for line in read_lines(in_path):
+            items = split_line(line, delim_in)
+            cv = class_vocab.add(items[1])
+            for tok in standard_tokenize(items[0]):
+                tok_ids.append(vocab.add(tok))
+                cls_ids.append(cv)
+
+        x = np.asarray(tok_ids, dtype=np.int32)[:, None]
+        y = np.asarray(cls_ids, dtype=np.int32)
+        counts = np.asarray(sharded_reduce(
+            _nb_local, x, y, mesh=mesh,
+            static_args=(len(class_vocab), max(len(vocab), 1))))
+
+        lines: List[str] = []
+        o = self.TEXT_ORDINAL
+        for c, class_val in enumerate(class_vocab.values):
+            for b, tok in enumerate(vocab.values):
+                cnt = int(counts[c, 0, b])
+                if cnt == 0:
+                    continue
+                counters.incr("Distribution Data", "Feature posterior binned ")
+                lines.append(f"{class_val}{delim}{o}{delim}{tok}{delim}{cnt}")
+                counters.incr("Distribution Data", "Class prior")
+                lines.append(f"{class_val}{delim}{delim}{delim}{cnt}")
+                counters.incr("Distribution Data", "Feature prior binned ")
+                lines.append(f"{delim}{o}{delim}{tok}{delim}{cnt}")
+        write_output(out_path, lines)
+        return counters
 
 
 # ---------------------------------------------------------------------------
@@ -286,20 +348,26 @@ class BayesianPredictor:
     def __init__(self, config: JobConfig, schema: Optional[FeatureSchema] = None,
                  model: Optional[NaiveBayesModel] = None):
         self.config = config
-        self.schema = schema or FeatureSchema.from_file(
-            config.must("feature.schema.file.path"))
+        self.tabular = config.get_boolean("tabular.input", True)
+        if self.tabular:
+            self.schema = schema or FeatureSchema.from_file(
+                config.must("feature.schema.file.path"))
+        else:
+            self.schema = schema
         self.model = model or NaiveBayesModel.load(
             config.must("bayesian.model.file.path"),
             config.field_delim_regex())
 
         delim = self.config.field_delim_out()
-        cls_field = self.schema.class_attr_field()
         pc = self.config.get("bp.predict.class")
         if pc is not None:
             self.predicting_classes = pc.split(delim)
-        else:
-            card = cls_field.cardinality
+        elif self.schema is not None:
+            card = self.schema.class_attr_field().cardinality
             self.predicting_classes = [card[0], card[1]]
+        else:
+            # text mode without bp.predict.class: the model's classes
+            self.predicting_classes = list(self.model.class_count)[:2]
 
         costs = self.config.get("bp.predict.class.cost")
         self.arbitrator = None
@@ -378,11 +446,34 @@ class BayesianPredictor:
         counters = Counters()
         delim_regex = self.config.field_delim_regex()
         delim = self.config.field_delim_out()
-        schema = self.schema
 
-        enc = DatasetEncoder(schema)
         raw_lines = list(read_lines(in_path))
         records = [split_line(l, delim_regex) for l in raw_lines]
+
+        if not self.tabular:
+            # text mode: host-scored through the loaded model (token vocab
+            # lives in model text; see module docstring — net-new surface)
+            from .text import standard_tokenize
+            o = BayesianDistribution.TEXT_ORDINAL
+            n, C = len(records), len(self.predicting_classes)
+            probs = np.zeros((n, C), dtype=np.int64)
+            feat_prior = np.zeros(n)
+            feat_post = np.zeros((n, C))
+            for i, items in enumerate(records):
+                fv = [(o, t) for t in standard_tokenize(items[0])]
+                feat_prior[i] = self.model.feature_prior_prob(fv)
+                for ci, cv in enumerate(self.predicting_classes):
+                    feat_post[i, ci] = self.model.feature_post_prob(cv, fv)
+                    ratio = (feat_post[i, ci]
+                             * self.model.class_prior_prob(cv)
+                             / max(feat_prior[i], 1e-300))
+                    probs[i, ci] = int(ratio * 100)
+            actuals = [items[1] for items in records]
+            return self._emit(raw_lines, records, actuals, probs, feat_prior,
+                              feat_post, delim, counters, out_path)
+
+        schema = self.schema
+        enc = DatasetEncoder(schema)
         ds = enc.encode(records)
 
         tables = self._build_tables(ds)
@@ -394,10 +485,17 @@ class BayesianPredictor:
         feat_post = np.asarray(feat_post)
 
         cls_field = schema.class_attr_field()
+        actuals = [records[i][cls_field.ordinal] for i in range(len(records))]
+        return self._emit(raw_lines, records, actuals, probs, feat_prior,
+                          feat_post, delim, counters, out_path)
+
+    def _emit(self, raw_lines, records, actuals, probs, feat_prior, feat_post,
+              delim, counters, out_path) -> Counters:
+        """Shared arbitration + output emission (tabular and text modes)."""
         conf = ConfusionMatrix(self.predicting_classes[0], self.predicting_classes[1])
         out: List[str] = []
         for i, line in enumerate(raw_lines):
-            actual = records[i][cls_field.ordinal]
+            actual = actuals[i]
             if self.output_feature_prob_only:
                 parts = [records[i][0], str(feat_prior[i])]
                 for ci, cv in enumerate(self.predicting_classes):

@@ -182,6 +182,70 @@ class MarkovModel:
         return cls(list(read_lines(path)), class_label_based)
 
 
+# ---------------------------------------------------------------------------
+# transaction -> state conversion + marketing plan (L0 resource scripts)
+# ---------------------------------------------------------------------------
+
+MARKETING_STATES = ["SL", "SE", "SG", "ML", "ME", "MG", "LL", "LE", "LG"]
+
+
+def _pair_state(pr_date, pr_amt: int, date, amt: int) -> str:
+    """One (prev, cur) transaction pair -> 2-letter state: days-gap letter
+    S/M/L x amount-trend letter L/E/G (resource/xaction_state.rb:24-39)."""
+    days = (date - pr_date).days
+    dd = "S" if days < 30 else ("M" if days < 60 else "L")
+    ad = "L" if pr_amt < 0.9 * amt else ("E" if pr_amt < 1.1 * amt else "G")
+    return dd + ad
+
+
+def _group_xactions(rows):
+    """Group custID,xid,date,amount rows into per-customer (date, amount)
+    histories preserving input order (resource/xaction_seq.rb:9-19)."""
+    import datetime
+
+    hist: Dict[str, list] = {}
+    for items in rows:
+        hist.setdefault(items[0], []).append(
+            (datetime.date.fromisoformat(items[2]), int(items[3])))
+    return hist
+
+
+def xactions_to_state_seqs(rows) -> List[List[str]]:
+    """resource/xaction_seq.rb equivalent: raw transactions -> one
+    ``custID,state,state,...`` row per customer with >= 2 transactions —
+    the Markov trainer's input format."""
+    out = []
+    for cid, hist in _group_xactions(rows).items():
+        seq = [_pair_state(*hist[i - 1], *hist[i])
+               for i in range(1, len(hist))]
+        if seq:
+            out.append([cid] + seq)
+    return out
+
+
+def marketing_next_dates(rows, model: "MarkovModel") -> List[str]:
+    """resource/mark_plan.rb:39-92 equivalent: per customer, map the last
+    observed transaction state through the trained (non-class) transition
+    matrix, take the most likely next state, and schedule the next
+    marketing contact 15/45/90 days after the last transaction depending on
+    the predicted gap letter.  Emits ``custID,ISO-date`` lines."""
+    import datetime
+
+    trans = model.trans
+    assert trans is not None, "marketing plan needs a non-class-based model"
+    out = []
+    for cid, hist in _group_xactions(rows).items():
+        if len(hist) < 2:
+            continue
+        last_state = _pair_state(*hist[-2], *hist[-1])
+        row = trans[model.index[last_state]]
+        next_state = model.states[int(np.argmax(row))]
+        gap = {"S": 15, "M": 45}.get(next_state[0], 90)
+        next_date = hist[-1][0] + datetime.timedelta(days=gap)
+        out.append(f"{cid},{next_date.isoformat()}")
+    return out
+
+
 class MarkovModelClassifier:
     """Map-only log-odds classifier, vectorized over the sequence batch."""
 
