@@ -600,11 +600,14 @@ def test_tutorial_visit_history_pst(tmp_path, mesh8):
          tmp_path / "out")
     lines = _outlines(tmp_path / "out")
     counts = {tuple(l.split(",")[:-1]): int(l.split(",")[-1]) for l in lines}
-    # per-class unigram rates of the conversion-skewed state LH vs HL
+    # PST emits n-grams length 2..max only (ProbabilisticSuffixTreeGenerator
+    # .java:152-190); recover per-class state rates of the conversion-skewed
+    # LH vs HL states by marginalizing bigrams over their last symbol
     def rate(cls, state):
-        n = sum(v for k, v in counts.items()
-                if k[0] == cls and len(k) == 2 and k[1] != "$")
-        return counts.get((cls, state), 0) / max(n, 1)
+        bigrams = {k: v for k, v in counts.items()
+                   if k[0] == cls and len(k) == 3 and "$" not in k}
+        n = sum(bigrams.values())
+        return sum(v for k, v in bigrams.items() if k[2] == state) / max(n, 1)
     assert rate("T", "LH") > rate("F", "LH")
     assert rate("F", "HL") > rate("T", "HL")
 
