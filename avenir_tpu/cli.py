@@ -67,6 +67,9 @@ JOBS: Dict[str, tuple] = {
     "org.avenir.sequence.CandidateGenerationWithSelfJoin": ("sequence", "CandidateGenerationWithSelfJoin", "cgs"),
     "org.avenir.sequence.SequencePositionalCluster": ("sequence", "SequencePositionalCluster", ""),
     "org.avenir.text.WordCounter": ("text", "WordCounter", ""),
+    # streaming entry point: positional args are (topologyName, configFile)
+    # per the reference main() (ReinforcementLearnerTopology.java:42-47)
+    "org.avenir.reinforce.ReinforcementLearnerTopology": ("streaming", "ReinforcementLearnerTopology", ""),
 }
 
 

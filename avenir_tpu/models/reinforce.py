@@ -620,3 +620,42 @@ class ReinforcementLearnerFactory:
     def create(learner_type: str, actions: Sequence[str],
                config: Dict) -> ReinforcementLearner:
         return create_learner(learner_type, actions, config)
+
+
+class ReinforcementLearnerGroup:
+    """Per-entity learner map (reinforce/ReinforcementLearnerGroup.java:30-70):
+    one independent learner per entity id (user, product, campaign ...), all
+    built by the factory from shared config.  Config keys match the
+    reference: ``learner.type`` (default ``randomGreedy``) and the required
+    ``action.list`` comma list.
+    """
+
+    def __init__(self, config: Dict):
+        self.config = config
+        self.learner_type = _cfg(config, "learner.type", "randomGreedy")
+        actions = _cfg(config, "action.list", required=True)
+        self.actions = (actions.split(",")
+                        if isinstance(actions, str) else list(actions))
+        self.learners: Dict[str, ReinforcementLearner] = {}
+
+    def add_learner(self, learner_id: str) -> ReinforcementLearner:
+        learner = create_learner(self.learner_type, self.actions, self.config)
+        self.learners[learner_id] = learner
+        return learner
+
+    def get_learner(self, learner_id: str) -> Optional[ReinforcementLearner]:
+        return self.learners.get(learner_id)
+
+    def _require(self, learner_id: str) -> ReinforcementLearner:
+        learner = self.learners.get(learner_id)
+        if learner is None:
+            raise ValueError(
+                f"unknown learner id {learner_id!r}; call add_learner first "
+                f"(known: {sorted(self.learners)[:10]})")
+        return learner
+
+    def next_actions(self, learner_id: str) -> List[Action]:
+        return self._require(learner_id).next_actions()
+
+    def set_reward(self, learner_id: str, action_id: str, reward: int) -> None:
+        self._require(learner_id).set_reward(action_id, reward)

@@ -200,9 +200,50 @@ class StreamingLearnerLoop:
 
 class ReinforcementLearnerTopology:
     """CLI-shaped alias mirroring the reference entry point
-    (``java -jar uber-avenir.jar <topologyName> <configFile>``)."""
+    (``java -jar uber-avenir.jar <topologyName> <configFile>``,
+    ReinforcementLearnerTopology.java:42-85).
+
+    Registered in the CLI job table so
+    ``python -m avenir_tpu ReinforcementLearnerTopology <topologyName>
+    <configFile>`` submits the streaming loop the way ``StormSubmitter``
+    submitted the topology.  The two positional args keep the reference's
+    order; properties may equivalently come via ``-Dconf.path``.  The loop
+    runs until the event queue stays idle for ``topology.idle.timeout.sec``
+    (default 1.0; the Storm topology ran forever — pass ``none`` to match)
+    or ``topology.max.events`` is reached.
+    """
+
+    def __init__(self, config: Optional[Dict] = None):
+        self.config = dict(getattr(config, "props", config) or {})
 
     @staticmethod
     def build(config: Dict,
               transport: Optional[Transport] = None) -> StreamingLearnerLoop:
         return StreamingLearnerLoop(config, transport)
+
+    def run(self, topology_name: str, config_file: str,
+            transport: Optional[Transport] = None):
+        """Job-driver surface: args mirror the reference main()'s
+        ``(topologyName, configFile)``; returns event/reward Counters."""
+        from ..core.config import parse_properties
+        from ..core.metrics import Counters
+
+        props: Dict[str, str] = {}
+        if config_file:
+            with open(config_file, "r") as fh:
+                props.update(parse_properties(fh.read()))
+        # -D defines (and -Dconf.path contents) overlay the positional file,
+        # matching load_job_config precedence (core/config.py:154-165)
+        props.update(self.config)
+        loop = StreamingLearnerLoop(props, transport)
+
+        max_events = _get(props, "topology.max.events")
+        idle = _get(props, "topology.idle.timeout.sec", default="1.0")
+        idle_timeout = None if str(idle).lower() == "none" else float(idle)
+        loop.run(max_events=int(max_events) if max_events else None,
+                 idle_timeout=idle_timeout)
+
+        counters = Counters()
+        counters.incr("Topology", "EventsProcessed", loop.event_count)
+        counters.incr("Topology", "RewardsApplied", loop.reward_count)
+        return counters

@@ -312,3 +312,66 @@ def test_bandit_missing_group_in_side_file_raises_value_error(tmp_path):
     with pytest.raises(ValueError, match="gX"):
         GreedyRandomBandit(cfg).run(str(tmp_path / "in"),
                                     str(tmp_path / "out"))
+
+
+def test_reinforcement_learner_group_per_entity_state():
+    """ReinforcementLearnerGroup.java:30-70: one independent learner per
+    entity id, all built from shared config."""
+    from avenir_tpu.models.reinforce import ReinforcementLearnerGroup
+
+    group = ReinforcementLearnerGroup(
+        {"learner.type": "upperConfidenceBoundOne", "action.list": "a,b,c",
+         "random.seed": "9"})
+    group.add_learner("user1")
+    group.add_learner("user2")
+    assert group.get_learner("user1") is not group.get_learner("user2")
+    assert group.get_learner("nope") is None
+
+    # rewards applied to user1 don't leak into user2's state
+    for _ in range(30):
+        act = group.next_actions("user1")[0]
+        group.set_reward("user1", act.id, 90 if act.id == "b" else 5)
+    u1 = group.get_learner("user1")
+    u2 = group.get_learner("user2")
+    assert sum(a.trial_count for a in u1.actions) == 30
+    assert sum(a.trial_count for a in u2.actions) == 0
+    assert u1.find_best_action().id == "b"
+
+    import pytest
+    with pytest.raises(ValueError, match="unknown learner id"):
+        group.next_actions("ghost")
+
+
+def test_reinforcement_learner_group_default_type():
+    from avenir_tpu.models.reinforce import ReinforcementLearnerGroup
+
+    group = ReinforcementLearnerGroup({"action.list": "x,y"})
+    assert group.learner_type == "randomGreedy"
+
+
+def test_topology_cli_entry(tmp_path):
+    """ReinforcementLearnerTopology registered as a CLI job: positional
+    args (topologyName, configFile) per the reference main()
+    (ReinforcementLearnerTopology.java:42-47)."""
+    from avenir_tpu.models.streaming import ReinforcementLearnerTopology
+
+    conf = tmp_path / "topo.properties"
+    conf.write_text(
+        "reinforcement.learner.type=randomGreedy\n"
+        "reinforcement.learner.actions=a,b\n"
+        "random.seed=3\n"
+        "topology.idle.timeout.sec=0.01\n")
+    transport = InMemoryTransport()
+    for i in range(5):
+        transport.push_event(f"e{i}", 1)
+    job = ReinforcementLearnerTopology({})
+    counters = job.run("learnerTopo", str(conf), transport=transport)
+    assert counters.get("Topology", "EventsProcessed") == 5
+    assert len(transport.actions) == 5
+
+
+def test_topology_in_cli_registry():
+    from avenir_tpu.cli import resolve
+
+    mod, cls, _ = resolve("ReinforcementLearnerTopology")
+    assert (mod, cls) == ("streaming", "ReinforcementLearnerTopology")
