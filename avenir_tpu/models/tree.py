@@ -69,7 +69,8 @@ from ..core.config import JobConfig
 from ..core.io import read_lines, split_line, write_output
 from ..core.metrics import Counters
 from ..core.schema import FeatureField, FeatureSchema
-from ..ops.counting import count_table, sharded_reduce
+from ..ops.counting import (count_on_mxu, count_table, onehot_dtype,
+                            sharded_reduce)
 from .split import (ALG_ENTROPY, ALG_GINI_INDEX, AttributePredicate, Split,
                     class_probabilities, enumerate_attr_splits, info_content,
                     segment_predicates, split_info_content, split_stat)
@@ -101,19 +102,45 @@ def _column(records: List[List[str]], field: FeatureField) -> np.ndarray:
 # Module-level local_fns so sharded_reduce's compiled-function cache hits
 # across iterations (tree levels / partition rounds).
 
-def _seg_class_count_local(seg, y, mask, n_splits, max_seg, n_class):
+def _seg_class_count_local(seg, y, mask, n_splits, max_seg, n_class,
+                           force_mxu=None):
     """C[split, segment, class] += 1; seg is the [n, n_splits] segment-index
     matrix (the vectorized AttributeSplitHandler.getSegmentIndex)."""
+    n = seg.shape[0]
+    if count_on_mxu(n, force_mxu, onehot_elems=n * n_splits * max_seg):
+        ohdt = onehot_dtype()
+        ym = jnp.where(mask, y, -1)
+        oy = (ym[:, None] == jnp.arange(n_class, dtype=y.dtype)).astype(ohdt)
+        og = (seg[:, :, None]
+              == jnp.arange(max_seg, dtype=seg.dtype)).astype(ohdt)
+        c = jnp.einsum("nsg,nc->sgc", og, oy,
+                       preferred_element_type=jnp.float32)
+        return c.astype(jnp.int32)
     ids = jnp.arange(n_splits, dtype=jnp.int32)[None, :]
     return count_table((n_splits, max_seg, n_class),
                        (ids, seg, y[:, None]), mask=mask[:, None])
 
 
 def _path_pred_class_count_local(path_id, y, bmat, mask, n_paths, n_preds,
-                                 n_class):
+                                 n_class, force_mxu=None):
     """C[path, predicate, class] += 1 where bmat[n, preds] marks satisfied
     predicates — the whole BuilderMapper emit loop + shuffle + BuilderReducer
-    histogram as one masked scatter."""
+    histogram (DecisionTreeBuilder.java:245-321,350-423) as one pass.
+
+    TPU path: C[(path, class), pred] is a single MXU matmul between the
+    one-hot of the fused (path, class) cell and the predicate matrix —
+    the per-record emit loop becomes the contraction over n."""
+    n = path_id.shape[0]
+    if count_on_mxu(n, force_mxu, onehot_elems=n * n_paths * n_class):
+        ohdt = onehot_dtype()
+        cell = jnp.where(mask, path_id * n_class + y, -1)
+        oc = (cell[:, None] == jnp.arange(n_paths * n_class,
+                                          dtype=cell.dtype)).astype(ohdt)
+        bm = (bmat & mask[:, None]).astype(ohdt)
+        c = jnp.einsum("nz,nk->zk", oc, bm,
+                       preferred_element_type=jnp.float32)
+        return (c.reshape(n_paths, n_class, n_preds)
+                .transpose(0, 2, 1).astype(jnp.int32))
     ids = jnp.arange(n_preds, dtype=jnp.int32)[None, :]
     return count_table((n_paths, n_preds, n_class),
                        (path_id[:, None], ids, y[:, None]),

@@ -35,6 +35,27 @@ from jax.sharding import PartitionSpec as P
 from ..parallel.mesh import get_mesh, pad_rows
 
 
+def count_on_mxu(n_elems: int, force_mxu: Optional[bool] = None,
+                 onehot_elems: Optional[int] = None) -> bool:
+    """Gate for the one-hot-contraction counting strategy: random-index
+    scatter-adds serialize on TPU, so small dense tables run as bf16 one-hot
+    contractions with an f32 accumulator instead — exact for per-shard
+    element counts below 2^24.  ``onehot_elems`` optionally caps the
+    materialized one-hot expansion (elements, not bytes) so wide tables fall
+    back to the scatter path instead of exhausting HBM."""
+    backend_ok = (jax.default_backend() == "tpu" if force_mxu is None
+                  else force_mxu)
+    if not backend_ok or n_elems >= (1 << 24):
+        return False
+    return onehot_elems is None or onehot_elems < (1 << 28)
+
+
+def onehot_dtype():
+    """bf16 one-hots feed the MXU on TPU; CPU's dot lacks bf16 so the
+    forced-on test path uses f32 (same exactness: values are 0/1)."""
+    return jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+
+
 def _ravel(sizes: Sequence[int], indices: Sequence[jnp.ndarray]) -> jnp.ndarray:
     """Row-major ravel of a composite integer key."""
     flat = jnp.zeros_like(jnp.asarray(indices[0]))
@@ -132,12 +153,8 @@ def feature_class_counts(x: jnp.ndarray, y: jnp.ndarray,
     n, F = x.shape
     # force_mxu exists so the CPU test suite can exercise the production
     # einsum branch against the scatter oracle
-    use_mxu = (jax.default_backend() == "tpu" if force_mxu is None
-               else force_mxu) and n < (1 << 24)
-    if use_mxu:
-        # bf16 one-hots feed the MXU on TPU; CPU's dot lacks bf16 so the
-        # forced-on test path uses f32 (same exactness: values are 0/1)
-        ohdt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    if count_on_mxu(n, force_mxu, onehot_elems=n * F * max_bins):
+        ohdt = onehot_dtype()
         ymask = y if mask is None else jnp.where(mask, y, -1)
         oy = (ymask[:, None] == jnp.arange(n_class, dtype=y.dtype)).astype(ohdt)
         ox = (x[:, :, None] == jnp.arange(max_bins, dtype=x.dtype)).astype(ohdt)

@@ -1,10 +1,14 @@
-"""Benchmark: both north-star workloads (BASELINE.json).
+"""Benchmark: both north-star workloads (BASELINE.json) plus kernel evidence.
 
 1. telecom-churn Naive Bayes training throughput (rows/sec/chip) — the
    primary metric on the JSON line.
 2. Apriori k=1..3 frequent-itemset pipeline wall-clock at tutorial scale
    (2,000 transactions x 50k items, freq_items_apriori_tutorial.txt:19-24) —
    reported in ``extra_metrics`` on the same line.
+3. kNN distance engine achieved GFLOP/s (+ MFU where the chip's bf16 peak
+   is known) — the O(n^2) MXU kernel behind knn/cluster.
+4. Decision-tree level pass rows/sec/chip — the per-level
+   C[path, predicate, class] histogram that replaces one whole MR job.
 
 The reference publishes no numbers (BASELINE.md), so each baseline is a
 measured single-core NumPy implementation of the identical computation — a
@@ -19,6 +23,16 @@ import json
 import time
 
 import numpy as np
+
+# Methodology note (BASELINE.md): the bench runs through a tunneled device
+# backend whose fixed per-dispatch round-trip is ~80 ms — orders of magnitude
+# above the kernels being measured.  Steady-state throughput metrics
+# therefore run R iterations inside ONE jitted ``fori_loop`` (each iteration
+# data-dependent on the loop index so XLA cannot hoist it) and divide by R;
+# production training amortizes dispatch the same way by pipelining steps.
+# End-to-end pipeline metrics (Apriori) keep raw wall-clock, overhead and
+# all.  NumPy baselines are single-pass best-of (no dispatch overhead —
+# generous to the baseline).
 
 
 def numpy_baseline(x, y, values, n_class, max_bins, cont_cols, reps=3):
@@ -140,6 +154,176 @@ def _apriori_numpy_baseline(rows, n_trans, threshold=0.1, reps=3):
     return best
 
 
+_BF16_PEAK_BY_KIND = (
+    # substring of jax device_kind (lowercased) -> per-chip bf16 peak FLOP/s
+    ("v6e", 918e12), ("v6 lite", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12), ("v5 lite", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def _bf16_peak():
+    import jax
+    kind = jax.devices()[0].device_kind.lower()
+    for sub, peak in _BF16_PEAK_BY_KIND:
+        if sub in kind:
+            return peak
+    return None
+
+
+def bench_knn_distance():
+    """kNN distance engine: the sharded MXU |a-b|^2 matmul + per-query
+    ``top_k`` that replaces the external sifarish SameTypeSimilarity job and
+    the reference's secondary-sort top-K (NearestNeighbor.java:80-81).
+    Reports achieved GFLOP/s on the cross-term matmul (2*nq*nt*F FLOPs) and
+    MFU against the chip's bf16 peak when the device kind is known.
+    Baseline: the same distance + argpartition top-k in single-core NumPy."""
+    from avenir_tpu.parallel.mesh import make_mesh
+
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from avenir_tpu.ops.distance import _block_dist, topk_smallest
+    from avenir_tpu.parallel.mesh import shard_rows
+
+    nq, nt, F, k, R = 16384, 16384, 256, 16, 10
+    rng = np.random.default_rng(0)
+    qnum = rng.uniform(0, 1, (nq, F)).astype(np.float32)
+    tnum = rng.uniform(0, 1, (nt, F)).astype(np.float32)
+    wcat = jnp.zeros((0,), dtype=jnp.float32)
+    mesh = make_mesh()
+    n_chips = mesh.devices.size
+
+    qd = shard_rows(qnum, mesh)
+    td = jax.device_put(tnum)
+
+    def local(q, t):
+        # R distance+select passes per dispatch; the +i*1e-6 query shift
+        # makes each iteration index-dependent so XLA cannot hoist it
+        empty = jnp.zeros((q.shape[0], 0), dtype=jnp.int32)
+        tempty = jnp.zeros((t.shape[0], 0), dtype=jnp.int32)
+
+        def body(i, acc):
+            d = _block_dist(q + i * 1e-6, empty, t, tempty, wcat, float(F),
+                            "euclidean", 1000)
+            v, ii = topk_smallest(d, k)
+            return acc + v.sum().astype(jnp.int64) + ii.sum().astype(
+                jnp.int64)
+
+        # init derived from q so the carry is data-varying from the start
+        init = (q[0, 0] * 0).astype(jnp.int64)
+        return jax.lax.fori_loop(0, R, body, init)[None]
+
+    fn = jax.jit(shard_map(local, mesh=mesh, in_specs=(P("data"), P()),
+                           out_specs=P("data")))
+    np.asarray(fn(qd, td))  # warmup/compile
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(fn(qd, td))
+        best = min(best, time.perf_counter() - t0)
+    per_iter = best / R
+
+    flops = 2.0 * nq * nt * F
+    gflops_chip = flops / per_iter / 1e9 / n_chips
+
+    # single-core NumPy baseline: identical math incl. int scale + top-k
+    wall = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        q2 = (qnum * qnum).sum(1)[:, None]
+        t2 = (tnum * tnum).sum(1)[None, :]
+        dist = np.sqrt(np.maximum(q2 + t2 - 2.0 * (qnum @ tnum.T), 0.0))
+        disti = (dist * 1000).astype(np.int32)
+        np.argpartition(disti, k, axis=1)[:, :k]
+        wall = min(wall, time.perf_counter() - t0)
+    base_gflops = flops / wall / 1e9
+
+    out = {"metric": "knn_distance_topk_gflops_per_chip",
+           "value": round(gflops_chip, 1),
+           "unit": "GFLOP/s/chip (MXU cross-term + exact top-k, "
+                   "dispatch-amortized)",
+           "vs_baseline": round(gflops_chip / base_gflops, 3)}
+    peak = _bf16_peak()
+    if peak is not None:
+        out["mfu_vs_bf16_peak"] = round(gflops_chip * 1e9 / peak, 4)
+        out["device_kind"] = jax.devices()[0].device_kind
+    return out
+
+
+def bench_tree_level():
+    """One decision-tree level pass, device-resident: the
+    C[path, predicate, class] masked histogram that fuses the reference's
+    BuilderMapper per-predicate emit loop + shuffle + BuilderReducer
+    histogram (DecisionTreeBuilder.java:245-321,350-423) into one sharded
+    scatter-add.  rows/sec/chip at 2M rows x 64 predicates.
+    Baseline: the same counting as 64 NumPy bincounts (vectorized
+    single-core — generous vs the reference's per-record emit loop)."""
+    from avenir_tpu.models.tree import _path_pred_class_count_local
+    from avenir_tpu.parallel.mesh import make_mesh, shard_rows
+
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n, n_paths, n_preds, n_class, R = 2_000_000, 8, 64, 2, 20
+    rng = np.random.default_rng(0)
+    path_id = rng.integers(0, n_paths, n).astype(np.int32)
+    y = rng.integers(0, n_class, n).astype(np.int32)
+    bmat = rng.uniform(size=(n, n_preds)) < 0.5
+    mesh = make_mesh()
+    n_chips = mesh.devices.size
+
+    pd_ = shard_rows(path_id, mesh)
+    yd = shard_rows(y, mesh)
+    bd = shard_rows(bmat, mesh)
+    md = shard_rows(np.ones(n, dtype=bool), mesh)
+
+    def local(p, yy, b, m):
+        # R level passes per dispatch; the class rotation by i makes each
+        # iteration index-dependent so XLA cannot hoist the count
+        def body(i, acc):
+            c = _path_pred_class_count_local((p + i) % n_paths, yy, b, m,
+                                             n_paths, n_preds, n_class)
+            return acc + jax.lax.psum(c, "data")
+
+        init = jnp.zeros((n_paths, n_preds, n_class), dtype=jnp.int32)
+        return jax.lax.fori_loop(0, R, body, init)
+
+    fn = jax.jit(shard_map(local, mesh=mesh, in_specs=(P("data"),) * 4,
+                           out_specs=P()))
+    np.asarray(fn(pd_, yd, bd, md))  # warmup/compile
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(fn(pd_, yd, bd, md))
+        best = min(best, time.perf_counter() - t0)
+    rows_per_sec_chip = n / (best / R) / n_chips
+
+    # NumPy baseline: per-predicate bincount over (path, class) cells
+    wall = float("inf")
+    cell = path_id * n_class + y
+    for _ in range(2):
+        t0 = time.perf_counter()
+        C = np.empty((n_paths * n_class, n_preds), dtype=np.int64)
+        for p in range(n_preds):
+            C[:, p] = np.bincount(cell, weights=bmat[:, p],
+                                  minlength=n_paths * n_class)
+        wall = min(wall, time.perf_counter() - t0)
+    base_rows = n / wall
+
+    return {"metric": "tree_level_pass_rows_per_sec_per_chip",
+            "value": round(rows_per_sec_chip),
+            "unit": "rows/sec/chip (2M rows x 64 predicates, "
+                    "dispatch-amortized)",
+            "vs_baseline": round(rows_per_sec_chip / base_rows, 3)}
+
+
 def main():
     import avenir_tpu
     avenir_tpu.enable_x64()
@@ -148,7 +332,6 @@ def main():
     from avenir_tpu.datagen import gen_telecom_churn
     from avenir_tpu.core import DatasetEncoder, FeatureSchema
     from avenir_tpu.models.bayesian import _host_moments, _nb_local
-    from avenir_tpu.ops.counting import sharded_reduce_resident
     from avenir_tpu.parallel.mesh import make_mesh, shard_rows
 
     n_rows = 2_000_000
@@ -181,39 +364,56 @@ def main():
     mesh = make_mesh()
     n_chips = mesh.devices.size
 
-    static = (n_class, max_bins)
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
     # steady-state residency: the binned matrix lives in HBM sharded over
     # rows (SURVEY §7.1); ingest/transfer is a one-time cost, counted apart
     xd = shard_rows(x, mesh)
     yd = shard_rows(y, mesh)
     md = shard_rows(np.ones(n, dtype=bool), mesh)
+    F = x.shape[1]
+    R = 20
 
-    # warmup/compile
-    res = sharded_reduce_resident(_nb_local, xd, yd, mask=md, mesh=mesh,
-                                  static_args=static)
-    np.asarray(res)
+    def local(xx, yy, m):
+        # R counting passes per dispatch; the class rotation by i makes
+        # each iteration index-dependent so XLA cannot hoist the count
+        def body(i, acc):
+            c = _nb_local(xx, (yy + i) % n_class, m, n_class, max_bins)
+            return acc + jax.lax.psum(c, "data")
 
+        init = jnp.zeros((n_class, F, max_bins), dtype=jnp.int32)
+        return jax.lax.fori_loop(0, R, body, init)
+
+    fn = jax.jit(shard_map(local, mesh=mesh, in_specs=(P("data"),) * 3,
+                           out_specs=P()))
+    np.asarray(fn(xd, yd, md))  # warmup/compile
     best = float("inf")
-    for _ in range(5):
+    for _ in range(3):
         t0 = time.perf_counter()
-        res = sharded_reduce_resident(_nb_local, xd, yd, mask=md,
-                                      mesh=mesh, static_args=static)
-        moms = _host_moments(values, y, n_class, cont_cols)
-        # host materialization: block_until_ready does not reliably block on
-        # tunneled backends, so pull the (tiny) count table back to host
-        np.asarray(res)
+        np.asarray(fn(xd, yd, md))
         best = min(best, time.perf_counter() - t0)
 
-    rows_per_sec_chip = n / best / n_chips
+    # the Gaussian moments are computed host-side per training pass
+    # (models/bayesian.py design note); measured once and added per-step
+    mom_best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _host_moments(values, y, n_class, cont_cols)
+        mom_best = min(mom_best, time.perf_counter() - t0)
+
+    rows_per_sec_chip = n / (best / R + mom_best) / n_chips
     base_t = numpy_baseline(x, y, values, n_class, max_bins, cont_cols)
     base_rows_per_sec = n / base_t
 
-    extra = [bench_apriori()]
+    extra = [bench_apriori(), bench_knn_distance(), bench_tree_level()]
 
     print(json.dumps({
         "metric": "telecom_churn_nb_train_rows_per_sec_per_chip",
         "value": round(rows_per_sec_chip),
-        "unit": "rows/sec/chip",
+        "unit": "rows/sec/chip (dispatch-amortized, incl. host moments)",
         "vs_baseline": round(rows_per_sec_chip / base_rows_per_sec, 3),
         "extra_metrics": extra,
     }))

@@ -377,3 +377,35 @@ def test_agglomerative_clustering(tmp_path):
     groups = [set(l.split(",")[1:-1]) for l in lines]
     assert {"A", "B", "C"} in groups
     assert {"X", "Y"} in groups
+
+
+def test_topk_smallest_chunked_matches_flat():
+    """The chunked exact selection must match lax.top_k bit-for-bit —
+    values, indices, and lowest-index-first tie order — including when the
+    candidate axis is not a multiple of the chunk and carries heavy ties."""
+    import jax
+    import jax.numpy as jnp
+    from avenir_tpu.ops.distance import topk_smallest
+    rng = np.random.default_rng(11)
+    for nt, k in ((1500, 16), (4096, 1), (1030, 64)):
+        d = rng.integers(0, 7, (37, nt)).astype(np.int32)  # heavy ties
+        want_neg, want_idx = jax.lax.top_k(-jnp.asarray(d), k)
+        got_v, got_idx = topk_smallest(jnp.asarray(d), k)
+        np.testing.assert_array_equal(np.asarray(got_v), -np.asarray(want_neg))
+        np.testing.assert_array_equal(np.asarray(got_idx),
+                                      np.asarray(want_idx))
+
+
+def test_topk_smallest_approx_mode():
+    """approx mode returns k plausible neighbors (values sorted ascending,
+    indices valid); exact recall is not guaranteed by contract."""
+    from avenir_tpu.ops.distance import topk_smallest
+    import jax.numpy as jnp
+    rng = np.random.default_rng(3)
+    d = rng.uniform(0, 1000, (8, 2048)).astype(np.float32)
+    v, i = topk_smallest(jnp.asarray(d), 8, method="approx")
+    v, i = np.asarray(v), np.asarray(i)
+    assert v.shape == (8, 8) and i.shape == (8, 8)
+    assert (np.diff(v, axis=1) >= 0).all()
+    assert ((i >= 0) & (i < 2048)).all()
+    np.testing.assert_allclose(v, np.take_along_axis(d, i, 1))

@@ -342,3 +342,30 @@ def test_data_partitioner(tmp_path):
     assert len(seg0) + len(seg1) == len(rows)
     assert all(float(l.split(",")[2]) <= 50 for l in seg0)
     assert all(float(l.split(",")[2]) > 50 for l in seg1)
+
+
+def test_tree_count_mxu_branches_match_scatter():
+    """The TPU one-hot-matmul branches of the tree counting kernels, forced
+    on CPU, must match the scatter path bit-for-bit (mask + bmat + -1s)."""
+    from avenir_tpu.models.tree import (_path_pred_class_count_local,
+                                        _seg_class_count_local)
+    import jax.numpy as jnp
+    rng = np.random.default_rng(7)
+    n, n_paths, n_preds, n_class = 600, 5, 9, 3
+    path_id = rng.integers(0, n_paths, n).astype(np.int32)
+    y = rng.integers(0, n_class, n).astype(np.int32)
+    bmat = rng.random((n, n_preds)) < 0.5
+    mask = rng.random(n) < 0.8
+    args = (jnp.asarray(path_id), jnp.asarray(y), jnp.asarray(bmat),
+            jnp.asarray(mask), n_paths, n_preds, n_class)
+    a = np.asarray(_path_pred_class_count_local(*args, force_mxu=True))
+    b = np.asarray(_path_pred_class_count_local(*args, force_mxu=False))
+    np.testing.assert_array_equal(a, b)
+
+    n_splits, max_seg = 6, 4
+    seg = rng.integers(0, max_seg, (n, n_splits)).astype(np.int32)
+    sargs = (jnp.asarray(seg), jnp.asarray(y), jnp.asarray(mask),
+             n_splits, max_seg, n_class)
+    a = np.asarray(_seg_class_count_local(*sargs, force_mxu=True))
+    b = np.asarray(_seg_class_count_local(*sargs, force_mxu=False))
+    np.testing.assert_array_equal(a, b)
