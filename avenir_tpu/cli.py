@@ -98,8 +98,21 @@ def main(argv=None) -> int:
         return 2
 
     job_name, rest = argv[0], argv[1:]
+    # --profile-dir=<dir>: capture a jax.profiler trace of the whole job
+    # (SURVEY §5 tracing rebuild note); view with TensorBoard or Perfetto
+    profile_dir = None
+    filtered = []
+    for a in rest:
+        if a.startswith("--profile-dir="):
+            profile_dir = a.split("=", 1)[1]
+            if not profile_dir:
+                print("--profile-dir requires a non-empty directory",
+                      file=sys.stderr)
+                return 2
+        else:
+            filtered.append(a)
     modname, clsname, prefix = resolve(job_name)
-    defines, positional = parse_cli_args(rest)
+    defines, positional = parse_cli_args(filtered)
     if len(positional) < 2:
         print("expected <input path> <output path>", file=sys.stderr)
         return 2
@@ -109,7 +122,12 @@ def main(argv=None) -> int:
 
     config = load_job_config(defines, prefix)
     job = _lazy(modname, clsname)(config)
-    result = job.run(positional[0], positional[1])
+    if profile_dir:
+        import jax
+        with jax.profiler.trace(profile_dir):
+            result = job.run(positional[0], positional[1])
+    else:
+        result = job.run(positional[0], positional[1])
 
     if isinstance(result, Counters):
         print(result.format(), file=sys.stderr)

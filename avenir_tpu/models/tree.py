@@ -69,8 +69,8 @@ from ..core.config import JobConfig
 from ..core.io import read_lines, split_line, write_output
 from ..core.metrics import Counters
 from ..core.schema import FeatureField, FeatureSchema
-from ..ops.counting import (count_on_mxu, count_table, onehot_dtype,
-                            sharded_reduce)
+from ..ops.counting import (count_on_mxu, count_table, masked_onehot,
+                            onehot_dtype, sharded_reduce)
 from .split import (ALG_ENTROPY, ALG_GINI_INDEX, AttributePredicate, Split,
                     class_probabilities, enumerate_attr_splits, info_content,
                     segment_predicates, split_info_content, split_stat)
@@ -108,11 +108,8 @@ def _seg_class_count_local(seg, y, mask, n_splits, max_seg, n_class,
     matrix (the vectorized AttributeSplitHandler.getSegmentIndex)."""
     n = seg.shape[0]
     if count_on_mxu(n, force_mxu, onehot_elems=n * n_splits * max_seg):
-        ohdt = onehot_dtype()
-        ym = jnp.where(mask, y, -1)
-        oy = (ym[:, None] == jnp.arange(n_class, dtype=y.dtype)).astype(ohdt)
-        og = (seg[:, :, None]
-              == jnp.arange(max_seg, dtype=seg.dtype)).astype(ohdt)
+        oy = masked_onehot(y, n_class, mask=mask)
+        og = masked_onehot(seg, max_seg)
         c = jnp.einsum("nsg,nc->sgc", og, oy,
                        preferred_element_type=jnp.float32)
         return c.astype(jnp.int32)
@@ -132,11 +129,14 @@ def _path_pred_class_count_local(path_id, y, bmat, mask, n_paths, n_preds,
     the per-record emit loop becomes the contraction over n."""
     n = path_id.shape[0]
     if count_on_mxu(n, force_mxu, onehot_elems=n * n_paths * n_class):
-        ohdt = onehot_dtype()
-        cell = jnp.where(mask, path_id * n_class + y, -1)
-        oc = (cell[:, None] == jnp.arange(n_paths * n_class,
-                                          dtype=cell.dtype)).astype(ohdt)
-        bm = (bmat & mask[:, None]).astype(ohdt)
+        # the fused (path, class) cell can alias a neighboring cell when a
+        # component is out of range, so validity is checked per component
+        # (the scatter path's count_table does the same range drop)
+        valid = (mask & (y >= 0) & (y < n_class)
+                 & (path_id >= 0) & (path_id < n_paths))
+        cell = path_id * n_class + y
+        oc = masked_onehot(cell, n_paths * n_class, mask=valid)
+        bm = (bmat & mask[:, None]).astype(onehot_dtype())
         c = jnp.einsum("nz,nk->zk", oc, bm,
                        preferred_element_type=jnp.float32)
         return (c.reshape(n_paths, n_class, n_preds)

@@ -56,6 +56,18 @@ def onehot_dtype():
     return jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
 
 
+def masked_onehot(idx, size: int, mask=None, dtype=None):
+    """One-hot of ``idx`` over ``[0, size)`` with the scatter path's
+    drop-invalid contract: rows where ``mask`` is False or ``idx`` is out of
+    range become all-zero (contribute nothing to the contraction)."""
+    dtype = dtype or onehot_dtype()
+    valid = (idx >= 0) & (idx < size)
+    if mask is not None:
+        valid &= mask
+    safe = jnp.where(valid, idx, -1)
+    return (safe[..., None] == jnp.arange(size, dtype=idx.dtype)).astype(dtype)
+
+
 def _ravel(sizes: Sequence[int], indices: Sequence[jnp.ndarray]) -> jnp.ndarray:
     """Row-major ravel of a composite integer key."""
     flat = jnp.zeros_like(jnp.asarray(indices[0]))
@@ -154,10 +166,8 @@ def feature_class_counts(x: jnp.ndarray, y: jnp.ndarray,
     # force_mxu exists so the CPU test suite can exercise the production
     # einsum branch against the scatter oracle
     if count_on_mxu(n, force_mxu, onehot_elems=n * F * max_bins):
-        ohdt = onehot_dtype()
-        ymask = y if mask is None else jnp.where(mask, y, -1)
-        oy = (ymask[:, None] == jnp.arange(n_class, dtype=y.dtype)).astype(ohdt)
-        ox = (x[:, :, None] == jnp.arange(max_bins, dtype=x.dtype)).astype(ohdt)
+        oy = masked_onehot(y, n_class, mask=mask)
+        ox = masked_onehot(x, max_bins)
         c = jnp.einsum("nc,nfb->cfb", oy, ox,
                        preferred_element_type=jnp.float32)
         return c.astype(dtype)

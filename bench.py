@@ -35,6 +35,18 @@ import numpy as np
 # generous to the baseline).
 
 
+def best_of(fn, reps=3):
+    """Best-of-N wall-clock of ``fn()``; the caller warms up first and makes
+    ``fn`` materialize its result (np.asarray) so the tunnel cannot hide
+    incomplete work behind async dispatch."""
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
 def numpy_baseline(x, y, values, n_class, max_bins, cont_cols, reps=3):
     """Single-core NumPy stand-in for the NB counting step (combiner+reducer);
     moments use the same _host_moments the measured path uses."""
@@ -48,12 +60,7 @@ def numpy_baseline(x, y, values, n_class, max_bins, cont_cols, reps=3):
         np.add.at(C.reshape(-1), flat[valid], 1)
         return C, _host_moments(values, y, n_class, cont_cols)
 
-    best = float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        run()
-        best = min(best, time.perf_counter() - t0)
-    return best
+    return best_of(run, reps)
 
 
 def bench_apriori():
@@ -101,11 +108,7 @@ def _bench_apriori_in(tmp):
                 os.path.join(tmp, "trans"), os.path.join(tmp, f"k{k}"))
 
     run_pipeline()  # warmup: compile + encode cache
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        run_pipeline()
-        best = min(best, time.perf_counter() - t0)
+    best = best_of(run_pipeline)
 
     # planted-signal check: all 3 triples recovered
     k3 = open(os.path.join(tmp, "k3", "part-r-00000")).read().splitlines()
@@ -125,9 +128,7 @@ def _apriori_numpy_baseline(rows, n_trans, threshold=0.1, reps=3):
     """Single-core NumPy k=1..3: occurrence bincount + dense incidence
     matmuls over the frequent-pruned vocabulary (same algorithm, no device,
     no sharding)."""
-    best = float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
+    def run():
         tokens = [it for r in rows for it in r[1:]]
         lengths = [len(r) - 1 for r in rows]
         rrows = np.repeat(np.arange(len(rows)), lengths)
@@ -150,8 +151,8 @@ def _apriori_numpy_baseline(rows, n_trans, threshold=0.1, reps=3):
         m = pj > rowcol
         v3 = inc[:, rowcol[m]] * inc[:, pj[m]]
         v3.T @ inc
-        best = min(best, time.perf_counter() - t0)
-    return best
+
+    return best_of(run, reps)
 
 
 _BF16_PEAK_BY_KIND = (
@@ -221,27 +222,20 @@ def bench_knn_distance():
     fn = jax.jit(shard_map(local, mesh=mesh, in_specs=(P("data"), P()),
                            out_specs=P("data")))
     np.asarray(fn(qd, td))  # warmup/compile
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        np.asarray(fn(qd, td))
-        best = min(best, time.perf_counter() - t0)
-    per_iter = best / R
+    per_iter = best_of(lambda: np.asarray(fn(qd, td))) / R
 
     flops = 2.0 * nq * nt * F
     gflops_chip = flops / per_iter / 1e9 / n_chips
 
     # single-core NumPy baseline: identical math incl. int scale + top-k
-    wall = float("inf")
-    for _ in range(2):
-        t0 = time.perf_counter()
+    def np_run():
         q2 = (qnum * qnum).sum(1)[:, None]
         t2 = (tnum * tnum).sum(1)[None, :]
         dist = np.sqrt(np.maximum(q2 + t2 - 2.0 * (qnum @ tnum.T), 0.0))
         disti = (dist * 1000).astype(np.int32)
         np.argpartition(disti, k, axis=1)[:, :k]
-        wall = min(wall, time.perf_counter() - t0)
-    base_gflops = flops / wall / 1e9
+
+    base_gflops = flops / best_of(np_run, 2) / 1e9
 
     out = {"metric": "knn_distance_topk_gflops_per_chip",
            "value": round(gflops_chip, 1),
@@ -298,24 +292,19 @@ def bench_tree_level():
     fn = jax.jit(shard_map(local, mesh=mesh, in_specs=(P("data"),) * 4,
                            out_specs=P()))
     np.asarray(fn(pd_, yd, bd, md))  # warmup/compile
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        np.asarray(fn(pd_, yd, bd, md))
-        best = min(best, time.perf_counter() - t0)
+    best = best_of(lambda: np.asarray(fn(pd_, yd, bd, md)))
     rows_per_sec_chip = n / (best / R) / n_chips
 
     # NumPy baseline: per-predicate bincount over (path, class) cells
-    wall = float("inf")
     cell = path_id * n_class + y
-    for _ in range(2):
-        t0 = time.perf_counter()
+
+    def np_run():
         C = np.empty((n_paths * n_class, n_preds), dtype=np.int64)
         for p in range(n_preds):
             C[:, p] = np.bincount(cell, weights=bmat[:, p],
                                   minlength=n_paths * n_class)
-        wall = min(wall, time.perf_counter() - t0)
-    base_rows = n / wall
+
+    base_rows = n / best_of(np_run, 2)
 
     return {"metric": "tree_level_pass_rows_per_sec_per_chip",
             "value": round(rows_per_sec_chip),
@@ -390,19 +379,11 @@ def main():
     fn = jax.jit(shard_map(local, mesh=mesh, in_specs=(P("data"),) * 3,
                            out_specs=P()))
     np.asarray(fn(xd, yd, md))  # warmup/compile
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        np.asarray(fn(xd, yd, md))
-        best = min(best, time.perf_counter() - t0)
+    best = best_of(lambda: np.asarray(fn(xd, yd, md)))
 
     # the Gaussian moments are computed host-side per training pass
     # (models/bayesian.py design note); measured once and added per-step
-    mom_best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        _host_moments(values, y, n_class, cont_cols)
-        mom_best = min(mom_best, time.perf_counter() - t0)
+    mom_best = best_of(lambda: _host_moments(values, y, n_class, cont_cols))
 
     rows_per_sec_chip = n / (best / R + mom_best) / n_chips
     base_t = numpy_baseline(x, y, values, n_class, max_bins, cont_cols)

@@ -352,8 +352,11 @@ def test_tree_count_mxu_branches_match_scatter():
     import jax.numpy as jnp
     rng = np.random.default_rng(7)
     n, n_paths, n_preds, n_class = 600, 5, 9, 3
-    path_id = rng.integers(0, n_paths, n).astype(np.int32)
-    y = rng.integers(0, n_class, n).astype(np.int32)
+    # ranges deliberately include out-of-range values (-1 and size), which
+    # the scatter path drops and the fused-cell MXU path must drop too
+    # rather than alias into a neighboring (path, class) cell
+    path_id = rng.integers(-1, n_paths + 1, n).astype(np.int32)
+    y = rng.integers(-1, n_class + 1, n).astype(np.int32)
     bmat = rng.random((n, n_preds)) < 0.5
     mask = rng.random(n) < 0.8
     args = (jnp.asarray(path_id), jnp.asarray(y), jnp.asarray(bmat),

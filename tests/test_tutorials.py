@@ -706,3 +706,26 @@ def test_tutorial_lead_gen_streaming(mesh8):
             picks[action] += 1
         transport.push_reward(action, sample(action))
     assert picks["page3"] == max(picks.values())
+
+
+def test_cli_profile_dir_writes_trace(tmp_path, mesh8):
+    """--profile-dir captures a jax.profiler trace around the job (SURVEY §5
+    tracing note) without disturbing the job's own arguments or output."""
+    from avenir_tpu.datagen import gen_telecom_churn
+
+    rows = gen_telecom_churn(200, seed=2)
+    in_path = tmp_path / "in"
+    in_path.mkdir()
+    (in_path / "churn.csv").write_text(
+        "\n".join(",".join(r) for r in rows) + "\n")
+    (tmp_path / "schema.json").write_text(json.dumps(CHURN_SCHEMA))
+    props = _props(tmp_path / "nb.properties",
+                   **{"feature.schema.file.path": str(tmp_path / "schema.json")})
+    trace_dir = tmp_path / "trace"
+    rc = cli_main(["BayesianDistribution", f"-Dconf.path={props}",
+                   f"--profile-dir={trace_dir}",
+                   str(in_path), str(tmp_path / "out")])
+    assert rc == 0
+    assert (tmp_path / "out" / "part-r-00000").exists()
+    traces = list(trace_dir.rglob("*.xplane.pb"))
+    assert traces, f"no trace files under {trace_dir}"
