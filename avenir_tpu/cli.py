@@ -103,10 +103,11 @@ def main(argv=None) -> int:
     profile_dir = None
     filtered = []
     for a in rest:
-        if a.startswith("--profile-dir="):
-            profile_dir = a.split("=", 1)[1]
+        if a.startswith("--profile-dir"):
+            profile_dir = a.partition("=")[2]
             if not profile_dir:
-                print("--profile-dir requires a non-empty directory",
+                print("--profile-dir requires --profile-dir=<dir> "
+                      "(the space-separated form is not supported)",
                       file=sys.stderr)
                 return 2
         else:
@@ -116,6 +117,14 @@ def main(argv=None) -> int:
     if len(positional) < 2:
         print("expected <input path> <output path>", file=sys.stderr)
         return 2
+
+    import os
+    plat = os.environ.get("AVENIR_PLATFORM")
+    if plat:
+        # pin the backend through the config API: the JAX_PLATFORMS env var
+        # alone is overridden by site TPU plugins (same as tests/conftest.py)
+        import jax
+        jax.config.update("jax_platforms", plat)
 
     import avenir_tpu
     avenir_tpu.enable_x64()

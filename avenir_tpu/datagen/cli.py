@@ -1,0 +1,143 @@
+"""Datagen CLI: the reference's per-tutorial generator scripts as one tool.
+
+The reference drives every runbook from a seeded generator script
+(resource/telecom_churn.py, freq_items.py, xaction_state.rb, hosp_readmit.rb,
+...).  Here the same step is::
+
+    python -m avenir_tpu.datagen <preset> [sizes...] [--seed N] [--out FILE]
+
+Rows print to stdout (or ``--out``) as comma-joined CSV, ready for the job
+CLI.  Presets that need model matrices (state/HMM sequences) carry the
+canonical tutorial parameterizations so runbooks stay one-liners.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Callable, Dict, List
+
+import numpy as np
+
+from . import generators as g
+
+# canonical tutorial parameterizations -------------------------------------
+
+_CHURN_STATES = ["LL", "LH", "HL", "HH"]
+_HMM_STATES = ["s0", "s1", "s2"]
+_HMM_OBS = ["a", "b", "c", "d"]
+_HMM_A = np.array([[.7, .2, .1], [.1, .7, .2], [.2, .1, .7]])
+_HMM_B = np.array([[.7, .1, .1, .1], [.1, .7, .1, .1], [.1, .1, .1, .7]])
+_HMM_PI = np.array([.5, .3, .2])
+
+
+def _churn_state_seqs(n: int, seed: int = 42) -> List[List[str]]:
+    """Loyal chain mixes states; churner chain absorbs into HH (the
+    cust_churn_markov_chain_classifier_tutorial.txt planted signal)."""
+    t_loyal = np.full((4, 4), 0.25)
+    t_churn = np.asarray([[0.1, 0.1, 0.1, 0.7]] * 4)
+    return g.gen_state_sequences(n, _CHURN_STATES,
+                                 {"L": t_loyal, "C": t_churn},
+                                 seq_len=(15, 25), seed=seed)
+
+
+def _hmm_seqs(n: int, seed: int = 42) -> List[List[str]]:
+    return g.gen_hmm_sequences(n, _HMM_STATES, _HMM_OBS, _HMM_A, _HMM_B,
+                               _HMM_PI, seed=seed)
+
+
+def _hmm_obs(n: int, seed: int = 67) -> List[List[str]]:
+    """Observation-only rows (states stripped) for the Viterbi decoder."""
+    rows = _hmm_seqs(n, seed=seed)
+    return [[r[0]] + [p.split(":")[0] for p in r[1:]] for r in rows]
+
+
+def _blobs(n: int, seed: int = 41) -> List[List[str]]:
+    """Two Gaussian blobs, the knn_elearning-style 2-feature fixture."""
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        c = "A" if i % 2 == 0 else "B"
+        cx = 0.0 if c == "A" else 8.0
+        rows.append([f"E{i}", f"{cx + rng.normal():.3f}",
+                     f"{cx + rng.normal():.3f}", c])
+    return rows
+
+
+def _transactions(n_trans: int, n_items: int, seed: int = 42):
+    return g.gen_transactions(n_trans, n_items, planted=((3, 7, 11),),
+                              planted_support=0.5, seed=seed)
+
+
+def _visit_history(n: int, seed: int = 42):
+    return g.gen_visit_history(n, conv_rate=50, label=True, seed=seed)
+
+
+# preset -> (callable, number of positional int sizes)
+PRESETS: Dict[str, tuple] = {
+    "telecom_churn": (g.gen_telecom_churn, 1),
+    "transactions": (_transactions, 2),
+    "churn_state_seqs": (_churn_state_seqs, 1),
+    "hmm_seqs": (_hmm_seqs, 1),
+    "hmm_obs": (_hmm_obs, 1),
+    "elearn": (g.gen_elearn, 1),
+    "retarget": (g.gen_retarget, 1),
+    "hosp_readmit": (g.gen_hosp_readmit, 1),
+    "disease": (g.gen_disease, 1),
+    "usage": (g.gen_usage, 1),
+    "visit_history": (_visit_history, 1),
+    "event_seq": (g.gen_event_seq, 1),
+    "xactions": (g.gen_xactions, 2),
+    "text_classified": (g.gen_text_classified, 1),
+    "numeric_classed": (g.gen_numeric_classed, 1),
+    "blobs": (_blobs, 1),
+}
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    usage = ("usage: python -m avenir_tpu.datagen <preset> <sizes...> "
+             "[--seed N] [--out FILE]\npresets:\n  "
+             + "\n  ".join(sorted(PRESETS)))
+    if not argv or argv[0] in ("-h", "--help"):
+        print(usage, file=sys.stderr)
+        return 2
+    name, rest = argv[0], argv[1:]
+    if name not in PRESETS:
+        print(f"unknown preset: {name}\n{usage}", file=sys.stderr)
+        return 2
+    fn, n_sizes = PRESETS[name]
+    seed = None
+    out = None
+    sizes: List[int] = []
+    i = 0
+    try:
+        while i < len(rest):
+            a = rest[i]
+            if a == "--seed":
+                seed = int(rest[i + 1]); i += 2
+            elif a == "--out":
+                out = rest[i + 1]; i += 2
+            elif a.startswith("--"):
+                raise ValueError(f"unknown option {a}")
+            else:
+                sizes.append(int(a)); i += 1
+    except (IndexError, ValueError) as e:
+        print(f"bad arguments for {name}: {e}\n{usage}", file=sys.stderr)
+        return 2
+    if len(sizes) != n_sizes:
+        print(f"{name} takes {n_sizes} size argument(s), got {len(sizes)}\n"
+              f"{usage}", file=sys.stderr)
+        return 2
+    kwargs = {} if seed is None else {"seed": seed}
+    rows = fn(*sizes, **kwargs)
+    text = "\n".join(",".join(r) for r in rows) + "\n"
+    if out:
+        import os
+        d = os.path.dirname(out)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(out, "w") as fh:
+            fh.write(text)
+    else:
+        sys.stdout.write(text)
+    return 0

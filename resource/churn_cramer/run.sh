@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Churn Cramer-index correlation
+set -euo pipefail
+cd "$(dirname "$0")"
+PY=${PYTHON:-python}
+rm -rf work && mkdir -p work
+
+$PY -m avenir_tpu.datagen telecom_churn 3000 --seed 29 --out work/in/part-00000
+$PY -m avenir_tpu CramerCorrelation -Dconf.path=cramer.properties work/in work/out
+
+echo "src,dst,cramerIndex: work/out/part-r-00000"
+cat work/out/part-r-00000

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Customer-churn Markov-chain classifier
+# (reference runbook: resource/cust_churn_markov_chain_classifier_tutorial.txt)
+set -euo pipefail
+cd "$(dirname "$0")"
+PY=${PYTHON:-python}
+rm -rf work && mkdir -p work/train work/test
+
+$PY -m avenir_tpu.datagen churn_state_seqs 800 --seed 31 --out work/all.csv
+head -n 600 work/all.csv > work/train/part-00000
+tail -n 200 work/all.csv > work/test/part-00000
+
+$PY -m avenir_tpu MarkovStateTransitionModel -Dconf.path=mst.properties work/train work/model
+$PY -m avenir_tpu MarkovModelClassifier      -Dconf.path=mmc.properties work/test  work/pred
+
+echo "per-class transition model: work/model/part-r-00000"
+echo "classified sequences:       work/pred/part-r-00000"
+head -n 3 work/pred/part-r-00000

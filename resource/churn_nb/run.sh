@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Telecom-churn Naive Bayes: train + predict
+# (reference runbook: resource/cust_churn_bayesian_prediction.txt)
+set -euo pipefail
+cd "$(dirname "$0")"
+PY=${PYTHON:-python}
+rm -rf work && mkdir -p work/train work/test
+
+$PY -m avenir_tpu.datagen telecom_churn 3000 --seed 29 --out work/all.csv
+head -n 2400 work/all.csv > work/train/part-00000
+tail -n 600  work/all.csv > work/test/part-00000
+
+$PY -m avenir_tpu BayesianDistribution -Dconf.path=nb.properties work/train work/model
+$PY -m avenir_tpu BayesianPredictor    -Dconf.path=bp.properties work/test  work/pred
+
+echo "model:       work/model/part-r-00000"
+echo "predictions: work/pred/part-r-00000 (…,predictedClass,scaledProb)"
+head -n 3 work/pred/part-r-00000

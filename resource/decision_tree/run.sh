@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Decision-tree builder: the iterative level loop through the CLI
+set -euo pipefail
+cd "$(dirname "$0")"
+PY=${PYTHON:-python}
+rm -rf work && mkdir -p work
+
+$PY -m avenir_tpu.datagen retarget 2000 --seed 31 --out work/lvl0in/part-00000
+
+IN=work/lvl0in
+for lvl in 0 1 2; do
+  OUT=work/lvl$((lvl+1))
+  $PY -m avenir_tpu DecisionTreeBuilder -Dconf.path=dtb.properties "$IN" "$OUT"
+  IN=$OUT
+done
+
+echo "decision paths (JSON, reference DecisionPathList format):"
+$PY -c "import json;d=json.load(open('work/decpath.json'));print(json.dumps(d,indent=1)[:600])"

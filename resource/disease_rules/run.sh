@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Disease risk-factor rule mining (Hellinger split quality)
+set -euo pipefail
+cd "$(dirname "$0")"
+PY=${PYTHON:-python}
+rm -rf work && mkdir -p work
+
+$PY -m avenir_tpu.datagen disease 5000 --seed 19 --out work/in/part-00000
+
+$PY -m avenir_tpu ClassPartitionGenerator -Dconf.path=root.properties work/in work/root
+PARENT_INFO=$(head -n 1 work/root/part-r-00000)
+
+$PY -m avenir_tpu ClassPartitionGenerator -Dconf.path=disease.properties \
+    -Dparent.info=$PARENT_INFO work/in work/gains
+
+echo "attr,splitKey,...,gain: work/gains/part-r-00000"
+head -n 5 work/gains/part-r-00000

@@ -1,0 +1,29 @@
+#!/usr/bin/env python
+"""Sequential-pattern candidates: event sequences -> frequent adjacent pairs
+-> GSP self-join into 3-sequence candidates (reference generator:
+resource/event_seq.rb)."""
+import os
+import shutil
+from collections import Counter
+
+from avenir_tpu.cli import main as job
+from avenir_tpu.core import write_output
+from avenir_tpu.datagen import gen_event_seq
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+os.chdir(HERE)
+shutil.rmtree("work", ignore_errors=True)
+
+rows = gen_event_seq(300, seed=2)
+pair_counts = Counter()
+for r in rows:
+    for a, b in zip(r[1:], r[2:]):
+        pair_counts[(a, b)] += 1
+frequent = [f"{a},{b}" for (a, b), c in pair_counts.items() if c >= 30]
+write_output("work/freq2", frequent)
+
+rc = job(["CandidateGenerationWithSelfJoin", "-Dconf.path=cgs.properties",
+          "work/freq2", "work/cand3"])
+assert rc == 0
+print("3-sequence candidates: work/cand3/part-r-00000")
+print(open("work/cand3/part-r-00000").read()[:300])

@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Frequent-itemset mining: Apriori k=1..3 (trans-id mode) -> item marker ->
+# association rules (reference runbook: resource/freq_items_apriori_tutorial.txt)
+set -euo pipefail
+cd "$(dirname "$0")"
+PY=${PYTHON:-python}
+rm -rf work && mkdir -p work/freq_all
+
+$PY -m avenir_tpu.datagen transactions 400 60 --seed 37 --out work/trans/part-00000
+
+for k in 1 2 3; do
+  EXTRA=""
+  if [ "$k" -gt 1 ]; then EXTRA="-Dfia.item.set.file.path=work/k$((k-1))"; fi
+  # id-carrying pass feeds the next k; id-free variant feeds the rule miner
+  $PY -m avenir_tpu FrequentItemsApriori -Dconf.path=fia.properties \
+      -Dfia.item.set.length=$k $EXTRA work/trans work/k$k
+  $PY -m avenir_tpu FrequentItemsApriori -Dconf.path=fia.properties \
+      -Dfia.item.set.length=$k -Dfia.trans.id.output=false $EXTRA work/trans work/k${k}f
+  cp work/k${k}f/part-r-00000 work/freq_all/part-$k
+done
+
+$PY -m avenir_tpu InfrequentItemMarker  -Dconf.path=iim.properties work/trans    work/marked
+$PY -m avenir_tpu AssociationRuleMiner  -Dconf.path=arm.properties work/freq_all work/rules
+
+echo "frequent 3-itemsets: work/k3f/part-r-00000"
+head -n 3 work/k3f/part-r-00000
+echo "rules: work/rules/part-r-00000"
+head -n 5 work/rules/part-r-00000

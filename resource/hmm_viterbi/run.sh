@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# HMM build -> Viterbi decode
+set -euo pipefail
+cd "$(dirname "$0")"
+PY=${PYTHON:-python}
+rm -rf work && mkdir -p work
+
+$PY -m avenir_tpu.datagen hmm_seqs 300 --seed 23 --out work/train/part-00000
+$PY -m avenir_tpu.datagen hmm_obs   40 --seed 67 --out work/obs/part-00000
+
+$PY -m avenir_tpu HiddenMarkovModelBuilder -Dconf.path=hmm.properties work/train work/hmm
+$PY -m avenir_tpu ViterbiStatePredictor    -Dconf.path=vit.properties work/obs   work/dec
+
+echo "serialized HMM: work/hmm/part-r-00000"
+echo "decoded states: work/dec/part-r-00000"
+head -n 3 work/dec/part-r-00000

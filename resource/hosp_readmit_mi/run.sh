@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Hospital-readmission mutual-information feature ranking
+set -euo pipefail
+cd "$(dirname "$0")"
+PY=${PYTHON:-python}
+rm -rf work && mkdir -p work
+
+$PY -m avenir_tpu.datagen hosp_readmit 6000 --seed 13 --out work/in/part-00000
+$PY -m avenir_tpu MutualInformation -Dconf.path=mi.properties work/in work/out
+
+echo "MI distributions + MIM ranking: work/out/part-r-00000"
+grep -A 10 "mutualInformationScoreAlgorithm" work/out/part-r-00000 | head -n 11

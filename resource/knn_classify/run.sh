@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# kNN classification: distance matmul job -> top-K voting
+# (reference runbook: resource/knn_elearning_tutorial.txt / knn.sh)
+set -euo pipefail
+cd "$(dirname "$0")"
+PY=${PYTHON:-python}
+rm -rf work && mkdir -p work/inp
+
+$PY -m avenir_tpu.datagen blobs 120 --seed 41 --out work/all.csv
+head -n 100 work/all.csv > work/inp/tr-00000
+tail -n 20  work/all.csv > work/inp/te-00000
+
+$PY -m avenir_tpu SameTypeSimilarity -Dconf.path=sim.properties work/inp  work/simi
+$PY -m avenir_tpu NearestNeighbor    -Dconf.path=knn.properties work/simi work/pred
+
+echo "predictions (…,actual,predicted): work/pred/part-r-00000"
+head -n 5 work/pred/part-r-00000

@@ -1,0 +1,30 @@
+#!/usr/bin/env python
+"""Optimal email-marketing dates: raw transactions -> per-customer state
+sequences -> Markov transition model -> next-marketing-date plan
+(reference flow: buy_xaction.rb -> xaction_seq.rb -> Markov -> mark_plan.rb)."""
+import os
+import shutil
+
+from avenir_tpu.cli import main as job
+from avenir_tpu.core import write_output
+from avenir_tpu.datagen import gen_xactions
+from avenir_tpu.models.markov import (MarkovModel, marketing_next_dates,
+                                      xactions_to_state_seqs)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+os.chdir(HERE)
+shutil.rmtree("work", ignore_errors=True)
+
+xrows = gen_xactions(150, 365, 0.06, seed=41)
+seqs = xactions_to_state_seqs(xrows)
+write_output("work/seq", [",".join(r) for r in seqs])
+
+rc = job(["MarkovStateTransitionModel", "-Dconf.path=mst.properties",
+          "work/seq", "work/model"])
+assert rc == 0
+
+model = MarkovModel.load("work/model", class_label_based=False)
+plan = marketing_next_dates(xrows, model)
+write_output("work/plan", plan)
+print("custID,nextMarketingDate: work/plan/part-r-00000")
+print("\n".join(plan[:5]))

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Abandoned-shopping-cart retarget: the reference's two-phase manual tree
+# flow (runbook: resource/abandoned_shopping_cart_retarget_tutorial.txt)
+set -euo pipefail
+cd "$(dirname "$0")"
+PY=${PYTHON:-python}
+rm -rf work && mkdir -p work/campaign/split=root/data
+
+$PY -m avenir_tpu.datagen retarget 4000 --seed 31 \
+    --out "work/campaign/split=root/data/partition.txt"
+
+$PY -m avenir_tpu ClassPartitionGenerator -Dconf.path=root.properties \
+    "work/campaign/split=root/data" work/rootout
+PARENT_INFO=$(head -n 1 work/rootout/part-r-00000)
+echo "parent info content: $PARENT_INFO"
+
+$PY -m avenir_tpu SplitGenerator -Dconf.path=splitgen.properties \
+    -Dparent.info=$PARENT_INFO - -
+echo "candidate gains:"
+head -n 5 "work/campaign/split=root/splits/part-r-00000"
+
+$PY -m avenir_tpu DataPartitioner -Dconf.path=dp.properties - -
+echo "partitioned segments:"
+find "work/campaign/split=root/data" -name partition.txt | sort

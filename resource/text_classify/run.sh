@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Text classification through Naive Bayes text mode
+set -euo pipefail
+cd "$(dirname "$0")"
+PY=${PYTHON:-python}
+rm -rf work && mkdir -p work/train work/test
+
+$PY -m avenir_tpu.datagen text_classified 800 --seed 17 --out work/all.csv
+head -n 600 work/all.csv > work/train/part-00000
+tail -n 200 work/all.csv > work/test/part-00000
+
+$PY -m avenir_tpu BayesianDistribution -Dconf.path=nbtext.properties work/train work/model
+$PY -m avenir_tpu BayesianPredictor    -Dconf.path=bptext.properties work/test  work/pred
+
+echo "token model: work/model/part-r-00000"
+head -n 3 work/pred/part-r-00000

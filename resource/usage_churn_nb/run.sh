@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Account-closure prediction over all-categorical usage levels
+# (reference generator: resource/usage.rb)
+set -euo pipefail
+cd "$(dirname "$0")"
+PY=${PYTHON:-python}
+rm -rf work && mkdir -p work/train work/test
+
+$PY -m avenir_tpu.datagen usage 4000 --seed 9 --out work/all.csv
+head -n 3200 work/all.csv > work/train/part-00000
+tail -n 800  work/all.csv > work/test/part-00000
+
+$PY -m avenir_tpu BayesianDistribution -Dconf.path=nb.properties work/train work/model
+$PY -m avenir_tpu BayesianPredictor    -Dconf.path=bp.properties work/test  work/pred
+head -n 3 work/pred/part-r-00000

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Customer-loyalty trajectory: visit-history PST
+set -euo pipefail
+cd "$(dirname "$0")"
+PY=${PYTHON:-python}
+rm -rf work && mkdir -p work
+
+$PY -m avenir_tpu.datagen visit_history 800 --seed 7 --out work/in/part-00000
+$PY -m avenir_tpu ProbabilisticSuffixTreeGenerator -Dconf.path=pst.properties work/in work/out
+
+echo "n-gram counts (class,gram...,count): work/out/part-r-00000"
+head -n 5 work/out/part-r-00000
