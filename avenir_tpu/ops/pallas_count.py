@@ -1,0 +1,115 @@
+"""Pallas VMEM histogram kernel: the wide-table counting path.
+
+The counting engine has three regimes on TPU (ops.counting):
+
+1. small one-hot expansions — XLA einsum over bf16 one-hots (MXU), fastest
+   when the ``n x F x max_bins`` one-hot fits the 2^28-element gate;
+2. wide tables — the einsum would materialize a multi-GB one-hot in HBM and
+   the scatter-add path serializes on random indices.  THIS kernel covers
+   that regime: each row block's one-hots are built in VMEM and contracted
+   on the MXU (``dot_general`` over the row axis) without ever leaving the
+   chip, accumulating exactly in int32;
+3. everything else — the scatter-add fallback.
+
+A/B on one v5e chip, 2M rows, dispatch-amortized (see BASELINE.md):
+NB shape (7 features x 2 classes x 12 bins): einsum 5.8 ms < pallas 12.7 ms
+(einsum kept); wide shape (32 x 8 x 32, one-hot would be 2^31 elements):
+pallas 24.5 ms vs 595 ms scatter — 24x, so this kernel is the production
+path once the einsum gate closes.
+
+Exactness: per-block partial counts are bf16 one-hot dots accumulated in
+f32 — exact for block sizes below 2^24 (blocks are 4096 rows) — and the
+running table is int32, so there is NO per-shard 2^24 row limit here,
+unlike the einsum path.  Invalid components (mask False, out-of-range
+index) contribute nothing, matching ``count_table``'s drop contract.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_ROW_BLOCK = 4096
+
+# VMEM/code-size caps for the kernel (checked by wide_count_applicable):
+# the [F*C, B] output block and per-feature [R, B] compare must fit VMEM,
+# and the feature loop is unrolled so F is bounded.
+_MAX_FEATURES = 128
+_MAX_BINS = 256
+_MAX_OUT_ELEMS = 1 << 20
+
+
+def wide_count_applicable(n_class: int, n_features: int, max_bins: int,
+                          backend: str | None = None) -> bool:
+    backend = backend or jax.default_backend()
+    return (backend == "tpu"
+            and n_features <= _MAX_FEATURES
+            and max_bins <= _MAX_BINS
+            and n_features * n_class * max_bins <= _MAX_OUT_ELEMS)
+
+
+def _make_kernel(F: int, C: int, B: int):
+    def kernel(x_ref, ym_ref, out_ref):
+        @pl.when(pl.program_id(0) == 0)
+        def _init():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        x = x_ref[:]                                       # [R, F] int32
+        ym = ym_ref[:]                                     # [R, 1] int32
+        cls = jax.lax.broadcasted_iota(jnp.int32, (1, C), 1)
+        bins = jax.lax.broadcasted_iota(jnp.int32, (1, B), 1)
+        w = (ym == cls).astype(jnp.bfloat16)               # [R, C]
+        per_f = []
+        for f in range(F):
+            cmp = (x[:, f:f + 1] == bins).astype(jnp.bfloat16)   # [R, B]
+            per_f.append(jax.lax.dot_general(
+                w, cmp, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))       # [C, B]
+        out_ref[:] = out_ref[:] + jnp.concatenate(
+            per_f, axis=0).astype(jnp.int32)               # [F*C, B]
+    return kernel
+
+
+def wide_feature_class_counts(x, y, n_class: int, max_bins: int, mask=None,
+                              interpret: bool | None = None):
+    """``C[class, feature, bin] += 1`` via the VMEM histogram kernel.
+
+    Same contract as ``ops.counting.feature_class_counts``: ``x`` int [n, F]
+    with -1 (or any out-of-range value) self-masking, ``mask`` dropping whole
+    rows.  ``interpret`` forces the Pallas interpreter (CPU tests).
+    """
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    n, F = x.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    x = x.astype(jnp.int32) if x.dtype.itemsize < 4 else x
+    ym = y if mask is None else jnp.where(jnp.asarray(mask), y, -1)
+    ym = ym[:, None].astype(jnp.int32)
+    pad = (-n) % _ROW_BLOCK
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)), constant_values=-1)
+        ym = jnp.pad(ym, ((0, pad), (0, 0)), constant_values=-1)
+    C, B = int(n_class), int(max_bins)
+    # inside shard_map the output varies over the same mesh axes as the
+    # row-sharded inputs; propagate the input's vma so check_vma passes
+    try:
+        vma = jax.typeof(x).vma
+        out_sds = jax.ShapeDtypeStruct((F * C, B), jnp.int32, vma=vma)
+    except (AttributeError, TypeError):
+        out_sds = jax.ShapeDtypeStruct((F * C, B), jnp.int32)
+    out = pl.pallas_call(
+        _make_kernel(F, C, B),
+        grid=((n + pad) // _ROW_BLOCK,),
+        in_specs=[pl.BlockSpec((_ROW_BLOCK, F), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((_ROW_BLOCK, 1), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((F * C, B), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=out_sds,
+        interpret=interpret,
+    )(x, ym)
+    return out.reshape(F, C, B).transpose(1, 0, 2)
