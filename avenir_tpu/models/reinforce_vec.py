@@ -1,0 +1,251 @@
+"""Vectorized multi-learner engine: a fleet of per-entity learners stepped
+as ONE jitted call.
+
+The scalar library (models.reinforce) mirrors the reference's per-object
+learners (reinforce/ReinforcementLearner.java:35-167 and subclasses), and
+``ReinforcementLearnerGroup`` holds one per entity
+(reinforce/ReinforcementLearnerGroup.java:30-70).  With thousands of
+entities that map is a host Python loop per event — the bottleneck SURVEY
+§7.2 stage 7 commits to removing with "vectorized pure-JAX state + grouped
+vmap selections".  This module keeps the SAME learner math as dense
+``[group, action]`` arrays:
+
+- state: per-arm trial counts, reward (count, sum) running stats
+  (SimpleStat's consumed surface), per-group total trial counts — all JAX
+  arrays advanced inside one ``lax.scan`` per ``next_actions`` call;
+- ``upperConfidenceBoundOne`` is bit-faithful to the scalar learner
+  (deterministic: same scores, same first-max/first-min tie order, same
+  min-trial bootstrap) — the parity test locks it step-for-step;
+- ``randomGreedy`` matches the exploit path exactly; exploration draws come
+  from ``jax.random`` instead of each learner's NumPy generator, so
+  per-entity random streams differ from the scalar library while remaining
+  distributionally identical (same ε schedule, same uniform arm choice);
+- ``softMax`` reproduces the per-group temperature-decay state machine
+  (probabilities recomputed only after a reward arrives, decay divisor
+  ``total - min_trial`` with the raw -1 default — SoftMaxLearner.java:79-109)
+  with ``jax.random.categorical`` sampling.
+
+Rewards are applied in bulk (``set_rewards`` takes index arrays), so a full
+streaming round over G entities is two device dispatches total.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .reinforce import _cfg, _cfg_float, _cfg_int
+
+_SUPPORTED = ("upperConfidenceBoundOne", "randomGreedy", "softMax")
+
+
+class VectorizedLearnerGroup:
+    """Dense [group, action] replacement for a ``ReinforcementLearnerGroup``
+    whose learners all share one type + config."""
+
+    def __init__(self, learner_type: str, group_ids: Sequence[str],
+                 action_ids: Sequence[str], config: Optional[Dict] = None):
+        if learner_type not in _SUPPORTED:
+            raise ValueError(
+                f"unsupported vectorized learner type {learner_type!r}; "
+                f"supported: {', '.join(_SUPPORTED)} (use the scalar "
+                "ReinforcementLearnerGroup for the others)")
+        config = config or {}
+        self.learner_type = learner_type
+        self.group_ids = list(group_ids)
+        self.action_ids = list(action_ids)
+        self._gindex = {g: i for i, g in enumerate(self.group_ids)}
+        self._aindex = {a: i for i, a in enumerate(self.action_ids)}
+        G, A = len(self.group_ids), len(self.action_ids)
+
+        self.min_trial = _cfg_int(config, "min.trial", -1)
+        self.batch_size = _cfg_int(config, "batch.size", 1)
+        seed = _cfg_int(config, "random.seed", None)
+        self._key = jax.random.PRNGKey(0 if seed is None else seed)
+
+        # shared state (all types)
+        self.trials = jnp.zeros((G, A), jnp.int32)       # Action.trial_count
+        self.rcnt = jnp.zeros((G, A), jnp.int32)         # SimpleStat.count
+        self.rsum = jnp.zeros((G, A), jnp.float32)       # SimpleStat.sum
+        self.total = jnp.zeros((G,), jnp.int32)          # total_trial_count
+
+        if learner_type == "upperConfidenceBoundOne":
+            self.reward_scale = _cfg_int(config, "reward.scale", 100)
+        else:
+            self.reward_scale = _cfg_int(config, "reward.scale", 1)
+        if learner_type == "randomGreedy":
+            self.random_selection_prob = _cfg_float(
+                config, "random.selection.prob", 0.5)
+            self.prob_red_algorithm = _cfg(
+                config, "prob.reduction.algorithm", "linear")
+            if self.prob_red_algorithm not in ("none", "linear", "logLinear"):
+                raise ValueError("Invalid probability reduction algorithm")
+            self.prob_reduction_constant = _cfg_float(
+                config, "prob.reduction.constant", 1.0)
+            self.min_prob = _cfg_float(config, "min.prob", -1.0)
+        if learner_type == "softMax":
+            temp0 = _cfg_float(config, "temp.constant", 100.0)
+            self.min_temp_constant = _cfg_float(
+                config, "min.temp.constant", -1.0)
+            self.temp_red_algorithm = _cfg(
+                config, "temp.reduction.algorithm", "linear")
+            if self.temp_red_algorithm not in ("linear", "logLinear"):
+                raise ValueError("Invalid temperature reduction algorithm")
+            self.temp = jnp.full((G,), temp0, jnp.float32)
+            self.probs = jnp.full((G, A), 1.0 / A, jnp.float32)
+            self.rewarded = jnp.zeros((G,), bool)
+
+        self._step_fn = self._build_step()
+
+    # -- per-type step bodies (state advanced inside lax.scan) --------------
+
+    def _build_step(self):
+        A = len(self.action_ids)
+        min_trial = self.min_trial
+        ltype = self.learner_type
+
+        def bootstrap(trials):
+            """Least-tried arm while below min.trial
+            (ReinforcementLearner.java:142-152); first-min tie order."""
+            amin = jnp.argmin(trials, axis=1)
+            take = (min_trial > 0) & (
+                jnp.take_along_axis(trials, amin[:, None], 1)[:, 0]
+                <= min_trial)
+            return amin, take
+
+        def ucb1_step(state, key):
+            trials, rcnt, rsum, total = state
+            total = total + 1
+            avg = jnp.where(rcnt > 0, rsum / jnp.maximum(rcnt, 1), 0.0)
+            score = jnp.where(
+                trials == 0, jnp.inf,
+                avg + jnp.sqrt(2.0 * jnp.log(total.astype(jnp.float32))
+                               [:, None] / jnp.maximum(trials, 1)))
+            sel = jnp.argmax(score, axis=1)
+            amin, take = bootstrap(trials)
+            sel = jnp.where(take, amin, sel)
+            trials = trials.at[jnp.arange(trials.shape[0]), sel].add(1)
+            return (trials, rcnt, rsum, total), sel
+
+        def random_greedy_step(state, key):
+            trials, rcnt, rsum, total = state
+            total = total + 1
+            t = total.astype(jnp.float32)
+            p0 = self.random_selection_prob
+            if self.prob_red_algorithm == "none":
+                cur = jnp.full_like(t, p0)
+            elif self.prob_red_algorithm == "linear":
+                cur = p0 * self.prob_reduction_constant / t
+            else:   # logLinear
+                cur = p0 * self.prob_reduction_constant * jnp.log(t) / t
+            cur = jnp.minimum(cur, p0)
+            if self.min_prob > 0:
+                cur = jnp.maximum(cur, self.min_prob)
+            ku, kr = jax.random.split(key)
+            explore = jax.random.uniform(ku, t.shape) < cur
+            rand_sel = jax.random.randint(kr, t.shape, 0, A)
+            avg = jnp.where(rcnt > 0, rsum / jnp.maximum(rcnt, 1), 0.0)
+            best = jnp.argmax(avg, axis=1)
+            sel = jnp.where(explore, rand_sel, best)
+            amin, take = bootstrap(trials)
+            sel = jnp.where(take, amin, sel)
+            trials = trials.at[jnp.arange(trials.shape[0]), sel].add(1)
+            return (trials, rcnt, rsum, total), sel
+
+        def softmax_step(state, key):
+            trials, rcnt, rsum, total, temp, probs, rewarded = state
+            total = total + 1
+            # a bootstrap step skips the whole sampler path — recompute,
+            # rewarded-latch reset, AND temperature decay all live inside
+            # the scalar learner's `if action is None` branch
+            # (models.reinforce SoftMaxLearner.next_action)
+            amin, take = bootstrap(trials)
+            avg = jnp.where(rcnt > 0, rsum / jnp.maximum(rcnt, 1), 0.0)
+            # recompute the sampler only where a reward arrived since the
+            # last sampler-path step (SoftMaxLearner.java:74-89 latch)
+            shifted = (avg - avg.max(axis=1, keepdims=True)) \
+                / temp[:, None]
+            fresh = jax.nn.softmax(shifted, axis=1)
+            recompute = rewarded & ~take
+            probs = jnp.where(recompute[:, None], fresh, probs)
+            rewarded = rewarded & take
+            sel = jax.random.categorical(key, jnp.log(probs), axis=1)
+            sel = jnp.where(take, amin, sel)
+            # temperature decay (SoftMaxLearner.java:96-109): divisor is
+            # total - min_trial with min_trial's raw -1 default
+            rnd = (total - self.min_trial).astype(jnp.float32)
+            decay_on = (rnd > 1) & ~take
+            if self.temp_red_algorithm == "linear":
+                newt = temp / rnd
+            else:   # logLinear
+                newt = temp * jnp.log(jnp.maximum(rnd, 1.0)) / rnd
+            if self.min_temp_constant > 0:
+                newt = jnp.maximum(newt, self.min_temp_constant)
+            newt = jnp.maximum(newt, 1e-12)   # underflow clamp (scalar lib)
+            temp = jnp.where(decay_on, newt, temp)
+            trials = trials.at[jnp.arange(trials.shape[0]), sel].add(1)
+            return (trials, rcnt, rsum, total, temp, probs, rewarded), sel
+
+        body = {"upperConfidenceBoundOne": ucb1_step,
+                "randomGreedy": random_greedy_step,
+                "softMax": softmax_step}[ltype]
+
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=2)
+        def steps(state, key, n_steps):
+            keys = jax.random.split(key, n_steps)
+            return jax.lax.scan(body, state, keys)
+
+        return steps
+
+    def _state(self):
+        if self.learner_type == "softMax":
+            return (self.trials, self.rcnt, self.rsum, self.total,
+                    self.temp, self.probs, self.rewarded)
+        return (self.trials, self.rcnt, self.rsum, self.total)
+
+    def _set_state(self, state):
+        if self.learner_type == "softMax":
+            (self.trials, self.rcnt, self.rsum, self.total,
+             self.temp, self.probs, self.rewarded) = state
+        else:
+            (self.trials, self.rcnt, self.rsum, self.total) = state
+
+    # -- public surface ------------------------------------------------------
+
+    def step(self, n_steps: Optional[int] = None) -> np.ndarray:
+        """Advance every learner ``n_steps`` times (default ``batch.size``)
+        in one jitted scan; returns selected action indices [n_steps, G]."""
+        n = self.batch_size if n_steps is None else n_steps
+        self._key, sub = jax.random.split(self._key)
+        state, sels = self._step_fn(self._state(), sub, n)
+        self._set_state(state)
+        return np.asarray(sels)
+
+    def next_actions(self) -> List[List[str]]:
+        """``batch.size`` action ids per group: [G][batch] of action_id —
+        the grouped equivalent of ``ReinforcementLearner.next_actions``."""
+        sels = self.step()
+        return [[self.action_ids[a] for a in sels[:, g]]
+                for g in range(len(self.group_ids))]
+
+    def set_rewards(self, group_ids: Sequence[str],
+                    action_ids: Sequence[str],
+                    rewards: Sequence[float]) -> None:
+        """Bulk reward application: one scatter per round."""
+        g = np.asarray([self._gindex[x] for x in group_ids], np.int32)
+        a = np.asarray([self._aindex[x] for x in action_ids], np.int32)
+        r = np.asarray(rewards, np.float32)
+        if self.learner_type == "upperConfidenceBoundOne":
+            # only UCB1 scales its reward stats (reinforce.py set_reward);
+            # randomGreedy/softMax add the raw reward
+            r = r / self.reward_scale
+        self.rsum = self.rsum.at[g, a].add(r)
+        self.rcnt = self.rcnt.at[g, a].add(1)
+        if self.learner_type == "softMax":
+            self.rewarded = self.rewarded.at[g].set(True)

@@ -375,3 +375,142 @@ def test_topology_in_cli_registry():
 
     mod, cls, _ = resolve("ReinforcementLearnerTopology")
     assert (mod, cls) == ("streaming", "ReinforcementLearnerTopology")
+
+
+# ---------------------------------------------------------------------------
+# Vectorized multi-learner engine (models.reinforce_vec)
+# ---------------------------------------------------------------------------
+
+def _scalar_fleet(ltype, n_groups, actions, config):
+    from avenir_tpu.models.reinforce import create_learner
+    return [create_learner(ltype, actions, dict(config))
+            for _ in range(n_groups)]
+
+
+def test_vectorized_ucb1_step_parity_with_scalar_fleet():
+    """UCB1 is deterministic, so the vectorized group must reproduce a fleet
+    of scalar learners step-for-step: same selections (incl. first-max tie
+    order), same min-trial bootstrap, under identical reward streams."""
+    from avenir_tpu.models.reinforce_vec import VectorizedLearnerGroup
+
+    G, actions = 40, ["a0", "a1", "a2", "a3"]
+    config = {"min.trial": "2", "reward.scale": "100"}
+    fleet = _scalar_fleet("upperConfidenceBoundOne", G, actions, config)
+    vec = VectorizedLearnerGroup(
+        "upperConfidenceBoundOne", [f"g{i}" for i in range(G)], actions,
+        config)
+    rng = np.random.default_rng(7)
+    means = rng.uniform(10, 90, (G, len(actions)))
+
+    for step in range(30):
+        sels = vec.step(1)[0]                        # [G]
+        for g, learner in enumerate(fleet):
+            want = learner.next_action().id
+            assert actions[sels[g]] == want, (step, g)
+        # identical rewards to both fleets
+        gids, aids, rs = [], [], []
+        for g in range(G):
+            r = int(means[g, sels[g]] + rng.normal(0, 2))
+            fleet[g].set_reward(actions[sels[g]], r)
+            gids.append(f"g{g}"); aids.append(actions[sels[g]]); rs.append(r)
+        vec.set_rewards(gids, aids, rs)
+
+
+def test_vectorized_random_greedy_exploit_parity_and_convergence():
+    """With explore probability 0 the ε-greedy path is deterministic and
+    must match the scalar learner exactly; with the default schedule the
+    fleet must converge on the best arm."""
+    from avenir_tpu.models.reinforce_vec import VectorizedLearnerGroup
+
+    G, actions = 25, ["x", "y", "z"]
+    config = {"random.selection.prob": "0.0", "min.trial": "1"}
+    fleet = _scalar_fleet("randomGreedy", G, actions, config)
+    vec = VectorizedLearnerGroup("randomGreedy",
+                                 [f"g{i}" for i in range(G)], actions, config)
+    rng = np.random.default_rng(3)
+    for step in range(20):
+        sels = vec.step(1)[0]
+        for g, learner in enumerate(fleet):
+            assert actions[sels[g]] == learner.next_action().id, (step, g)
+        gids, aids, rs = [], [], []
+        for g in range(G):
+            r = 100 if sels[g] == 1 else int(rng.integers(0, 40))
+            fleet[g].set_reward(actions[sels[g]], r)
+            gids.append(f"g{g}"); aids.append(actions[sels[g]]); rs.append(r)
+        vec.set_rewards(gids, aids, rs)
+    # exploit path locked on the planted best arm everywhere
+    assert (vec.step(1)[0] == 1).all()
+
+    # stochastic schedule converges: arm 2 pays the most
+    vec2 = VectorizedLearnerGroup(
+        "randomGreedy", [f"g{i}" for i in range(G)], actions,
+        {"random.selection.prob": "0.8", "min.trial": "1",
+         "random.seed": "5"})
+    rng2 = np.random.default_rng(11)
+    for _ in range(60):
+        sels = vec2.step(1)[0]
+        rs = np.where(sels == 2, 90, 10) + rng2.integers(0, 5, G)
+        vec2.set_rewards([f"g{g}" for g in range(G)],
+                         [actions[a] for a in sels], rs)
+    assert (vec2.step(1)[0] == 2).mean() > 0.8
+
+
+def test_vectorized_softmax_temperature_and_convergence():
+    """The per-group temperature decay must match the scalar learner's
+    state machine (deterministic), and sampling must concentrate on the
+    best arm once the temperature collapses."""
+    from avenir_tpu.models.reinforce import SoftMaxLearner
+    from avenir_tpu.models.reinforce_vec import VectorizedLearnerGroup
+
+    actions = ["a", "b", "c"]
+    G = 30
+    # decay parity with AND without the min-trial bootstrap: bootstrap
+    # steps skip the sampler path, so they must not decay the temperature
+    for extra in ({}, {"min.trial": "1"}):
+        config = {"temp.constant": "50.0", "random.seed": "9", **extra}
+        scalar = SoftMaxLearner().with_actions(actions)
+        scalar.initialize(dict(config))
+        vec = VectorizedLearnerGroup("softMax", [f"g{i}" for i in range(G)],
+                                     actions, config)
+        for step in range(10):
+            scalar.next_action()
+            vec.step(1)
+            np.testing.assert_allclose(float(vec.temp[0]),
+                                       scalar.temp_constant, rtol=1e-5,
+                                       err_msg=f"{extra} step {step}")
+    # planted arm b dominates once every arm has been tried (min.trial
+    # bootstrap) and a temperature floor keeps sampling defined; without
+    # the floor the cumulative decay collapses to argmax within ~6 steps
+    # (the scalar learner has the identical greedy trap)
+    vec3 = VectorizedLearnerGroup(
+        "softMax", [f"g{i}" for i in range(G)], actions,
+        {"temp.constant": "50.0", "min.temp.constant": "2.0",
+         "min.trial": "1", "random.seed": "4"})
+    rng = np.random.default_rng(1)
+    for _ in range(40):
+        sels = vec3.step(1)[0]
+        rs = np.where(sels == 1, 95, 5) + rng.integers(0, 3, G)
+        vec3.set_rewards([f"g{g}" for g in range(G)],
+                         [actions[a] for a in sels], rs)
+    tail = vec3.step(1)[0]
+    assert (tail == 1).mean() > 0.8
+
+
+def test_vectorized_group_rejects_unsupported_type():
+    from avenir_tpu.models.reinforce_vec import VectorizedLearnerGroup
+    with pytest.raises(ValueError, match="unsupported"):
+        VectorizedLearnerGroup("intervalEstimator", ["g"], ["a"], {})
+
+
+def test_vectorized_group_scales_in_one_dispatch():
+    """20k groups x 8 arms advance in one jitted call — the SURVEY §7.2
+    stage-7 scale target that the scalar map cannot reach."""
+    from avenir_tpu.models.reinforce_vec import VectorizedLearnerGroup
+
+    G = 20_000
+    vec = VectorizedLearnerGroup(
+        "upperConfidenceBoundOne", [f"g{i}" for i in range(G)],
+        [f"a{j}" for j in range(8)], {"min.trial": "1"})
+    sels = vec.step(3)
+    assert sels.shape == (3, G)
+    assert (vec.trials.sum() == 3 * G)
