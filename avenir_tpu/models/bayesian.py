@@ -127,6 +127,18 @@ def _host_moments(values: np.ndarray, y: np.ndarray, n_class: int,
     if n_class == 0:
         return {j: np.zeros((3, 0)) for j in cont_cols}
     n = len(y)
+    # the indicator matrix costs O(n * n_class) memory and GEMV flops; for
+    # many-class problems (or a matrix past ~256MB) the one-pass bincount
+    # is the better trade and nothing is pinned
+    if n_class > 16 or n * n_class * 8 > (1 << 28):
+        cnt = np.bincount(y, minlength=n_class)[:n_class]
+        for j in cont_cols:
+            v = np.ascontiguousarray(values[:, j])
+            s = np.bincount(y, weights=v, minlength=n_class)[:n_class]
+            s2 = np.bincount(y, weights=v * v,
+                             minlength=n_class)[:n_class]
+            out[j] = np.stack([cnt, s, s2])
+        return out
     maskb, M, vbuf, v2buf = _moment_scratch(n, n_class)
     cnt = np.empty(n_class, dtype=np.int64)
     for c in range(n_class):

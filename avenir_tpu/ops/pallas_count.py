@@ -100,16 +100,20 @@ def wide_feature_class_counts(x, y, n_class: int, max_bins: int, mask=None,
         out_sds = jax.ShapeDtypeStruct((F * C, B), jnp.int32, vma=vma)
     except (AttributeError, TypeError):
         out_sds = jax.ShapeDtypeStruct((F * C, B), jnp.int32)
-    out = pl.pallas_call(
-        _make_kernel(F, C, B),
-        grid=((n + pad) // _ROW_BLOCK,),
-        in_specs=[pl.BlockSpec((_ROW_BLOCK, F), lambda i: (i, 0),
-                               memory_space=pltpu.VMEM),
-                  pl.BlockSpec((_ROW_BLOCK, 1), lambda i: (i, 0),
-                               memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec((F * C, B), lambda i: (0, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=out_sds,
-        interpret=interpret,
-    )(x, ym)
+    # trace under 32-bit semantics: with the global x64 flag on (the CLI's
+    # enable_x64), literal index-map constants become i64 and Mosaic
+    # rejects the kernel; everything here is int32 by construction
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            _make_kernel(F, C, B),
+            grid=((n + pad) // _ROW_BLOCK,),
+            in_specs=[pl.BlockSpec((_ROW_BLOCK, F), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM),
+                      pl.BlockSpec((_ROW_BLOCK, 1), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((F * C, B), lambda i: (0, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=out_sds,
+            interpret=interpret,
+        )(x, ym)
     return out.reshape(F, C, B).transpose(1, 0, 2)

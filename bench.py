@@ -313,6 +313,47 @@ def bench_tree_level():
             "vs_baseline": round(rows_per_sec_chip / base_rows, 3)}
 
 
+def bench_wide_count():
+    """Wide count table (32 features x 8 classes x 32 bins at 2M rows):
+    the regime where the one-hot expansion (2^31 elements) outgrows HBM and
+    the Pallas VMEM histogram kernel (ops/pallas_count.py) takes over.
+    Baseline: the same table as a single-core NumPy scatter-add."""
+    import jax
+    import jax.numpy as jnp
+
+    from avenir_tpu.ops.counting import feature_class_counts
+
+    n, F, C, B, R = 2_000_000, 32, 8, 32, 10
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, B, (n, F)).astype(np.int32)
+    y = rng.integers(0, C, n).astype(np.int32)
+    xd = jax.device_put(x)
+    yd = jax.device_put(y)
+    np.asarray(xd[0, 0])
+
+    def loop(xa, ya):
+        def body(i, acc):
+            return acc + feature_class_counts(xa, (ya + i) % C, C, B)
+        return jax.lax.fori_loop(0, R, body, jnp.zeros((C, F, B), jnp.int32))
+
+    fn = jax.jit(loop)
+    np.asarray(fn(xd, yd))  # warmup/compile
+    per = best_of(lambda: np.asarray(fn(xd, yd))) / R
+    rows_per_sec = n / per
+
+    def np_run():
+        T = np.zeros((C, F, B), dtype=np.int64)
+        flat = (y[:, None] * F + np.arange(F)[None, :]) * B + x
+        np.add.at(T.reshape(-1), flat.ravel(), 1)
+
+    base_rows = n / best_of(np_run, 2)
+    return {"metric": "wide_count_table_rows_per_sec_per_chip",
+            "value": round(rows_per_sec),
+            "unit": "rows/sec/chip (2M x 32 feat x 8 class x 32 bins, "
+                    "Pallas VMEM kernel, dispatch-amortized)",
+            "vs_baseline": round(rows_per_sec / base_rows, 3)}
+
+
 def main():
     import avenir_tpu
     avenir_tpu.enable_x64()
@@ -389,7 +430,8 @@ def main():
     base_t = numpy_baseline(x, y, values, n_class, max_bins, cont_cols)
     base_rows_per_sec = n / base_t
 
-    extra = [bench_apriori(), bench_knn_distance(), bench_tree_level()]
+    extra = [bench_apriori(), bench_knn_distance(), bench_tree_level(),
+             bench_wide_count()]
 
     print(json.dumps({
         "metric": "telecom_churn_nb_train_rows_per_sec_per_chip",
