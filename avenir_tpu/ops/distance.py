@@ -111,6 +111,109 @@ def _block_dist(qnum, qcat, tnum, tcat, wcat, wsum, algorithm: str,
     return (dist * scale).astype(jnp.int32)
 
 
+def _fold_weights(qnum, tnum, num_weights, cat_weights, algorithm):
+    """Fold attribute weights into the numeric columns (sqrt for the
+    squared-distance expansion) and return (qnum', tnum', wsum)."""
+    wsum = float(num_weights.sum() + cat_weights.sum()) or 1.0
+    wn = np.sqrt(num_weights) if algorithm == "euclidean" else num_weights
+    return ((qnum * wn[None, :]).astype(np.float32),
+            (tnum * wn[None, :]).astype(np.float32), wsum)
+
+
+_ring_cache: dict = {}
+
+
+def pairwise_topk_ring(qnum: np.ndarray, qcat: np.ndarray,
+                       tnum: np.ndarray, tcat: np.ndarray,
+                       num_weights: np.ndarray, cat_weights: np.ndarray,
+                       k: int, algorithm: str = "euclidean",
+                       scale: int = 1000, mesh=None
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-query k nearest training rows with BOTH operands sharded.
+
+    ``pairwise_distances`` replicates the training block on every device
+    (the map-side-join broadcast); past a few million training rows that
+    replication no longer fits.  Here the training matrix is sharded over
+    ``data`` too, and blocks rotate around the ring via ``lax.ppermute``
+    (one neighbor hop per step, the bandwidth-optimal all-to-all of the
+    scaling-book recipe): each device computes its [nq_local, nt/d]
+    distance tile against the resident block while the next block is in
+    flight, folding the tile into a running top-k.  Neither the n^2
+    distance matrix nor the full training matrix ever exists on one chip.
+
+    Returns host ``(dist[nq, k], idx[nq, k])`` with global training-row
+    indices, ascending by distance.  Among equal distances the order
+    reflects ring arrival, not global index order (the broadcast engine's
+    tie order) — callers needing exact tie parity use
+    ``pairwise_distances``.
+    """
+    mesh = mesh or get_mesh()
+    d = mesh.shape["data"]
+    nq, nt = qnum.shape[0], tnum.shape[0]
+    k = min(k, nt)
+    qnum, tnum, wsum = _fold_weights(qnum, tnum, num_weights, cat_weights,
+                                     algorithm)
+    qnum_p, _ = pad_rows(qnum, d)
+    qcat_p, _ = pad_rows(qcat, d)
+    tnum_p, tmask = pad_rows(tnum, d)
+    tcat_p, _ = pad_rows(tcat, d)
+    m = tnum_p.shape[0] // d
+    sentinel = np.int32(np.iinfo(np.int32).max)
+
+    key = (mesh, algorithm, scale, k, wsum, qnum_p.shape, qcat_p.shape,
+           tnum_p.shape, tcat_p.shape)
+    fn = _ring_cache.get(key)
+    if fn is None:
+        def local(qn, qc, tn, tc, tm, wc):
+            r = jax.lax.axis_index("data")
+            perm = [((i + 1) % d, i) for i in range(d)]
+
+            def step(s, carry):
+                tn_b, tc_b, tm_b, vals, idxs = carry
+                owner = (r + s) % d
+                db = _block_dist(qn, qc, tn_b, tc_b, wc, wsum, algorithm,
+                                 scale)
+                db = jnp.where(tm_b[None, :], db, sentinel)
+                gidx = (owner * m
+                        + jnp.arange(m, dtype=jnp.int32))[None, :]
+                cand_v = jnp.concatenate([vals, db], axis=1)
+                cand_i = jnp.concatenate(
+                    [idxs, jnp.broadcast_to(gidx, db.shape)], axis=1)
+                v2, pos = topk_smallest(cand_v, k)
+                i2 = jnp.take_along_axis(cand_i, pos, axis=1)
+
+                # the last tile needs no further rotation — skip the dead
+                # ppermute (1/d of the ring's total traffic); s is uniform
+                # across devices so the cond branches uniformly
+                def rotate(blocks):
+                    return tuple(jax.lax.ppermute(b, "data", perm)
+                                 for b in blocks)
+
+                tn_b, tc_b, tm_b = jax.lax.cond(
+                    s < d - 1, rotate, lambda b: b, (tn_b, tc_b, tm_b))
+                return (tn_b, tc_b, tm_b, v2, i2)
+
+            # derive the carries from the inputs so they are data-varying
+            # from the start (a plain full() is unvarying and trips scan's
+            # vma check); sums work for zero-width operands too
+            zero = (qn.sum() + qc.sum()).astype(jnp.int32) * 0
+            vals0 = jnp.full((qn.shape[0], k), sentinel, jnp.int32) + zero
+            idxs0 = jnp.full((qn.shape[0], k), -1, jnp.int32) + zero
+            out = jax.lax.fori_loop(0, d, step, (tn, tc, tm, vals0, idxs0))
+            return out[3], out[4]
+
+        fn = jax.jit(shard_map(
+            local, mesh=mesh,
+            in_specs=(P("data"), P("data"), P("data"), P("data"), P("data"),
+                      P()),
+            out_specs=(P("data"), P("data"))))
+        _ring_cache[key] = fn
+
+    dist, idx = fn(qnum_p, qcat_p, tnum_p, tcat_p.astype(np.int32),
+                   jnp.asarray(tmask), cat_weights.astype(np.float32))
+    return np.asarray(dist)[:nq], np.asarray(idx)[:nq]
+
+
 def pairwise_distances(qnum: np.ndarray, qcat: np.ndarray,
                        tnum: np.ndarray, tcat: np.ndarray,
                        num_weights: np.ndarray, cat_weights: np.ndarray,
@@ -129,11 +232,9 @@ def pairwise_distances(qnum: np.ndarray, qcat: np.ndarray,
     d = mesh.shape["data"]
     nq = qnum.shape[0]
     nt = tnum.shape[0]
-    wsum = float(num_weights.sum() + cat_weights.sum()) or 1.0
     # fold weights into the numeric columns so the matmul needs no extra pass
-    wn = np.sqrt(num_weights) if algorithm == "euclidean" else num_weights
-    qnum = (qnum * wn[None, :]).astype(np.float32)
-    tnum = (tnum * wn[None, :]).astype(np.float32)
+    qnum, tnum, wsum = _fold_weights(qnum, tnum, num_weights, cat_weights,
+                                     algorithm)
 
     qnum_p, _ = pad_rows(qnum, d)
     qcat_p, _ = pad_rows(qcat, d)

@@ -409,3 +409,73 @@ def test_topk_smallest_approx_mode():
     assert (np.diff(v, axis=1) >= 0).all()
     assert ((i >= 0) & (i < 2048)).all()
     np.testing.assert_allclose(v, np.take_along_axis(d, i, 1))
+
+
+def test_pairwise_topk_ring_matches_broadcast_engine(mesh8):
+    """The ring-rotation engine (both operands sharded, ppermute all-to-all)
+    must return the same neighbor values and indices as the broadcast
+    engine's flat top-k, including when nq and nt don't divide the mesh and
+    padded training rows exist."""
+    from avenir_tpu.ops.distance import pairwise_distances, pairwise_topk_ring
+
+    rng = np.random.default_rng(13)
+    nq, nt, Fn, Fc, k = 53, 101, 5, 2, 7
+    qnum = rng.uniform(0, 10, (nq, Fn)).astype(np.float32)
+    tnum = rng.uniform(0, 10, (nt, Fn)).astype(np.float32)
+    qcat = rng.integers(0, 4, (nq, Fc)).astype(np.int32)
+    tcat = rng.integers(0, 4, (nt, Fc)).astype(np.int32)
+    wn = rng.uniform(0.5, 2.0, Fn)
+    wc = rng.uniform(0.5, 2.0, Fc)
+
+    dist_ref, idx_ref = pairwise_distances(qnum, qcat, tnum, tcat, wn, wc,
+                                           top_k=k, mesh=mesh8)
+    dist, idx = pairwise_topk_ring(qnum, qcat, tnum, tcat, wn, wc, k,
+                                   mesh=mesh8)
+    # the k-smallest VALUE multiset is engine-independent
+    np.testing.assert_array_equal(dist, dist_ref)
+    assert (idx < nt).all() and (idx >= 0).all()
+    # indices must match wherever the value is unique in its row; among
+    # int-scaled ties only the order may differ (documented divergence)
+    full, _ = pairwise_distances(qnum, qcat, tnum, tcat, wn, wc, mesh=mesh8)
+    np.testing.assert_array_equal(np.take_along_axis(full, idx, 1), dist)
+    for r in range(len(dist)):
+        uniq = np.isin(dist_ref[r],
+                       np.flatnonzero(np.bincount(full[r]) == 1))
+        np.testing.assert_array_equal(idx[r][uniq], idx_ref[r][uniq])
+
+
+def test_pairwise_topk_ring_single_device(mesh1):
+    from avenir_tpu.ops.distance import pairwise_distances, pairwise_topk_ring
+
+    rng = np.random.default_rng(5)
+    qnum = rng.uniform(0, 1, (9, 3)).astype(np.float32)
+    tnum = rng.uniform(0, 1, (17, 3)).astype(np.float32)
+    empty_q = np.zeros((9, 0), np.int32)
+    empty_t = np.zeros((17, 0), np.int32)
+    w = np.ones(3)
+    z = np.zeros(0)
+    dref, iref = pairwise_distances(qnum, empty_q, tnum, empty_t, w, z,
+                                    top_k=4, mesh=mesh1)
+    d, i = pairwise_topk_ring(qnum, empty_q, tnum, empty_t, w, z, 4,
+                              mesh=mesh1)
+    np.testing.assert_array_equal(d, dref)
+    np.testing.assert_array_equal(i, iref)
+
+
+def test_pairwise_topk_ring_pure_categorical(mesh8):
+    """Zero numeric columns (categorical-only distance) through the ring."""
+    from avenir_tpu.ops.distance import pairwise_distances, pairwise_topk_ring
+
+    rng = np.random.default_rng(2)
+    nq, nt, Fc, k = 11, 37, 3, 5
+    qnum = np.zeros((nq, 0), np.float32)
+    tnum = np.zeros((nt, 0), np.float32)
+    qcat = rng.integers(0, 3, (nq, Fc)).astype(np.int32)
+    tcat = rng.integers(0, 3, (nt, Fc)).astype(np.int32)
+    w = np.zeros(0)
+    wc = np.ones(Fc)
+    dref, _ = pairwise_distances(qnum, qcat, tnum, tcat, w, wc, top_k=k,
+                                 mesh=mesh8)
+    d, i = pairwise_topk_ring(qnum, qcat, tnum, tcat, w, wc, k, mesh=mesh8)
+    np.testing.assert_array_equal(d, dref)
+    assert ((i >= 0) & (i < nt)).all()
