@@ -88,7 +88,7 @@ class VectorizedLearnerGroup:
                 config, "prob.reduction.constant", 1.0)
             self.min_prob = _cfg_float(config, "min.prob", -1.0)
         if learner_type == "softMax":
-            temp0 = _cfg_float(config, "temp.constant", 100.0)
+            temp0 = self._temp0 = _cfg_float(config, "temp.constant", 100.0)
             self.min_temp_constant = _cfg_float(
                 config, "min.temp.constant", -1.0)
             self.temp_red_algorithm = _cfg(
@@ -99,7 +99,7 @@ class VectorizedLearnerGroup:
             self.probs = jnp.full((G, A), 1.0 / A, jnp.float32)
             self.rewarded = jnp.zeros((G,), bool)
 
-        self._step_fn = self._build_step()
+        self._step_fn, self._masked_fn = self._build_step()
 
     # -- per-type step bodies (state advanced inside lax.scan) --------------
 
@@ -117,24 +117,30 @@ class VectorizedLearnerGroup:
                 <= min_trial)
             return amin, take
 
-        def ucb1_step(state, key):
+        # Each body advances only the groups where ``active`` is True (the
+        # streaming case: an entity's learner steps only when its event
+        # arrives); the full-fleet scan passes active=ones.
+
+        def ucb1_step(state, key, active):
             trials, rcnt, rsum, total = state
-            total = total + 1
+            total = total + active
             avg = jnp.where(rcnt > 0, rsum / jnp.maximum(rcnt, 1), 0.0)
             score = jnp.where(
                 trials == 0, jnp.inf,
-                avg + jnp.sqrt(2.0 * jnp.log(total.astype(jnp.float32))
-                               [:, None] / jnp.maximum(trials, 1)))
+                avg + jnp.sqrt(2.0 * jnp.log(
+                    jnp.maximum(total, 1).astype(jnp.float32))
+                    [:, None] / jnp.maximum(trials, 1)))
             sel = jnp.argmax(score, axis=1)
             amin, take = bootstrap(trials)
             sel = jnp.where(take, amin, sel)
-            trials = trials.at[jnp.arange(trials.shape[0]), sel].add(1)
+            trials = trials.at[jnp.arange(trials.shape[0]), sel].add(
+                active.astype(jnp.int32))
             return (trials, rcnt, rsum, total), sel
 
-        def random_greedy_step(state, key):
+        def random_greedy_step(state, key, active):
             trials, rcnt, rsum, total = state
-            total = total + 1
-            t = total.astype(jnp.float32)
+            total = total + active
+            t = jnp.maximum(total, 1).astype(jnp.float32)
             p0 = self.random_selection_prob
             if self.prob_red_algorithm == "none":
                 cur = jnp.full_like(t, p0)
@@ -153,12 +159,13 @@ class VectorizedLearnerGroup:
             sel = jnp.where(explore, rand_sel, best)
             amin, take = bootstrap(trials)
             sel = jnp.where(take, amin, sel)
-            trials = trials.at[jnp.arange(trials.shape[0]), sel].add(1)
+            trials = trials.at[jnp.arange(trials.shape[0]), sel].add(
+                active.astype(jnp.int32))
             return (trials, rcnt, rsum, total), sel
 
-        def softmax_step(state, key):
+        def softmax_step(state, key, active):
             trials, rcnt, rsum, total, temp, probs, rewarded = state
-            total = total + 1
+            total = total + active
             # a bootstrap step skips the whole sampler path — recompute,
             # rewarded-latch reset, AND temperature decay all live inside
             # the scalar learner's `if action is None` branch
@@ -170,15 +177,15 @@ class VectorizedLearnerGroup:
             shifted = (avg - avg.max(axis=1, keepdims=True)) \
                 / temp[:, None]
             fresh = jax.nn.softmax(shifted, axis=1)
-            recompute = rewarded & ~take
+            recompute = rewarded & ~take & active
             probs = jnp.where(recompute[:, None], fresh, probs)
-            rewarded = rewarded & take
+            rewarded = rewarded & ~recompute
             sel = jax.random.categorical(key, jnp.log(probs), axis=1)
             sel = jnp.where(take, amin, sel)
             # temperature decay (SoftMaxLearner.java:96-109): divisor is
             # total - min_trial with min_trial's raw -1 default
             rnd = (total - self.min_trial).astype(jnp.float32)
-            decay_on = (rnd > 1) & ~take
+            decay_on = (rnd > 1) & ~take & active
             if self.temp_red_algorithm == "linear":
                 newt = temp / rnd
             else:   # logLinear
@@ -187,7 +194,8 @@ class VectorizedLearnerGroup:
                 newt = jnp.maximum(newt, self.min_temp_constant)
             newt = jnp.maximum(newt, 1e-12)   # underflow clamp (scalar lib)
             temp = jnp.where(decay_on, newt, temp)
-            trials = trials.at[jnp.arange(trials.shape[0]), sel].add(1)
+            trials = trials.at[jnp.arange(trials.shape[0]), sel].add(
+                active.astype(jnp.int32))
             return (trials, rcnt, rsum, total, temp, probs, rewarded), sel
 
         body = {"upperConfidenceBoundOne": ucb1_step,
@@ -198,10 +206,17 @@ class VectorizedLearnerGroup:
 
         @partial(jax.jit, static_argnums=2)
         def steps(state, key, n_steps):
+            def scan_body(st, k):
+                ones = jnp.ones(st[0].shape[0], dtype=bool)
+                return body(st, k, ones)
             keys = jax.random.split(key, n_steps)
-            return jax.lax.scan(body, state, keys)
+            return jax.lax.scan(scan_body, state, keys)
 
-        return steps
+        @jax.jit
+        def one_masked(state, key, active):
+            return body(state, key, active)
+
+        return steps, one_masked
 
     def _state(self):
         if self.learner_type == "softMax":
@@ -216,6 +231,33 @@ class VectorizedLearnerGroup:
         else:
             (self.trials, self.rcnt, self.rsum, self.total) = state
 
+    def add_groups(self, new_ids: Sequence[str]) -> None:
+        """Grow the fleet with fresh learners (zeroed state — identical to a
+        newly constructed scalar learner).  Streaming callers batch unknown
+        entities per drained wave so the shape (and jit cache entry) changes
+        at most once per wave, not per event."""
+        fresh = list(dict.fromkeys(
+            g for g in new_ids if g not in self._gindex))
+        if not fresh:
+            return
+        for g in fresh:
+            self._gindex[g] = len(self.group_ids)
+            self.group_ids.append(g)
+        add = len(fresh)
+
+        def pad(a, fill=0):
+            return jnp.concatenate(
+                [a, jnp.full((add,) + a.shape[1:], fill, a.dtype)], axis=0)
+
+        self.trials = pad(self.trials)
+        self.rcnt = pad(self.rcnt)
+        self.rsum = pad(self.rsum)
+        self.total = pad(self.total)
+        if self.learner_type == "softMax":
+            self.temp = pad(self.temp, self._temp0)
+            self.probs = pad(self.probs, 1.0 / len(self.action_ids))
+            self.rewarded = pad(self.rewarded, False)
+
     # -- public surface ------------------------------------------------------
 
     def step(self, n_steps: Optional[int] = None) -> np.ndarray:
@@ -226,6 +268,17 @@ class VectorizedLearnerGroup:
         state, sels = self._step_fn(self._state(), sub, n)
         self._set_state(state)
         return np.asarray(sels)
+
+    def step_masked(self, active: np.ndarray) -> np.ndarray:
+        """Advance ONLY the groups where ``active`` is True (the streaming
+        case: an entity's learner steps when its event arrives).  Returns
+        selected action indices [G]; entries for inactive groups are
+        meaningless and their state is untouched."""
+        self._key, sub = jax.random.split(self._key)
+        state, sel = self._masked_fn(self._state(), sub,
+                                     jnp.asarray(active, bool))
+        self._set_state(state)
+        return np.asarray(sel)
 
     def next_actions(self) -> List[List[str]]:
         """``batch.size`` action ids per group: [G][batch] of action_id —

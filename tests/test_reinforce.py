@@ -514,3 +514,83 @@ def test_vectorized_group_scales_in_one_dispatch():
     sels = vec.step(3)
     assert sels.shape == (3, G)
     assert (vec.trials.sum() == 3 * G)
+
+
+def test_grouped_streaming_loop_parity_and_convergence():
+    """The grouped streaming loop (masked vectorized steps) must match a
+    scalar ReinforcementLearnerGroup driven per event for deterministic
+    UCB1, and converge per-entity with auto-enrollment of unseen entities."""
+    from avenir_tpu.models.reinforce import ReinforcementLearnerGroup
+    from avenir_tpu.models.streaming import (GroupedStreamingLearnerLoop,
+                                             InMemoryTransport)
+
+    actions = ["p1", "p2", "p3"]
+    config = {"reinforcement.learner.type": "upperConfidenceBoundOne",
+              "reinforcement.learner.actions": ",".join(actions),
+              "learner.type": "upperConfidenceBoundOne",
+              "action.list": ",".join(actions),
+              "min.trial": "1", "reward.scale": "1"}
+    transport = InMemoryTransport()
+    loop = GroupedStreamingLearnerLoop(config, transport)
+    scalar = ReinforcementLearnerGroup(config)
+
+    # entity e0/e1 prefer p2; e2 prefers p3 — planted per-entity best
+    best = {"e0": "p2", "e1": "p2", "e2": "p3"}
+    rng = np.random.default_rng(6)
+    schedule = [f"e{i % 3}" for i in range(90)]
+    for step_i, ent in enumerate(schedule):
+        transport.push_event(ent, step_i)
+        loop.step_batch()
+        got = transport.actions[-1]
+        e, act = got.split(",")
+        assert e == ent
+        # scalar group sees the identical event + reward stream
+        if scalar.get_learner(ent) is None:
+            scalar.add_learner(ent)
+        want = scalar.next_actions(ent)[0].id
+        assert act == want, (step_i, ent)
+        r = int(90 if act == best[ent] else 20) + int(rng.integers(0, 5))
+        transport.push_reward(f"{ent},{act}", r)   # entity,action,reward
+        scalar.set_reward(ent, act, r)
+    # converged: the last selection per entity is its planted best
+    last = {}
+    for msg in transport.actions:
+        e, a = msg.split(",")
+        last[e] = a
+    assert last == best
+
+    # waves: duplicate entities in one drained batch step twice
+    t2 = InMemoryTransport()
+    loop2 = GroupedStreamingLearnerLoop(config, t2)
+    for i in range(4):
+        t2.push_event("dup", i)
+    n = loop2.step_batch()
+    assert n == 4
+    assert len(t2.actions) == 4
+    assert int(loop2.group.total[loop2.group._gindex["dup"]]) == 4
+
+
+def test_grouped_loop_batch_size_and_enroll_dedup():
+    """batch.size emits that many actions per event (scalar-loop parity for
+    the eventID,action[,action...] format), and enrolling a brand-new
+    entity several times in one wave creates exactly one state row."""
+    from avenir_tpu.models.reinforce_vec import VectorizedLearnerGroup
+    from avenir_tpu.models.streaming import (GroupedStreamingLearnerLoop,
+                                             InMemoryTransport)
+
+    vec = VectorizedLearnerGroup("upperConfidenceBoundOne", ["a"],
+                                 ["x", "y"], {})
+    vec.add_groups(["new", "new", "new"])
+    assert vec.group_ids == ["a", "new"]
+    assert vec.trials.shape[0] == 2
+
+    config = {"reinforcement.learner.type": "upperConfidenceBoundOne",
+              "reinforcement.learner.actions": "x,y,z",
+              "batch.size": "3"}
+    t = InMemoryTransport()
+    loop = GroupedStreamingLearnerLoop(config, t)
+    t.push_event("e9", 0)
+    loop.step_batch()
+    parts = t.actions[-1].split(",")
+    assert parts[0] == "e9" and len(parts) == 4        # 3 actions
+    assert int(loop.group.total[loop.group._gindex["e9"]]) == 3
