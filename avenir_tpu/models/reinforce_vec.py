@@ -212,11 +212,14 @@ class VectorizedLearnerGroup:
             keys = jax.random.split(key, n_steps)
             return jax.lax.scan(scan_body, state, keys)
 
-        @jax.jit
-        def one_masked(state, key, active):
-            return body(state, key, active)
+        @partial(jax.jit, static_argnums=2)
+        def masked_steps(state, key, n_steps, active):
+            def scan_body(st, k):
+                return body(st, k, active)
+            keys = jax.random.split(key, n_steps)
+            return jax.lax.scan(scan_body, state, keys)
 
-        return steps, one_masked
+        return steps, masked_steps
 
     def _state(self):
         if self.learner_type == "softMax":
@@ -231,11 +234,22 @@ class VectorizedLearnerGroup:
         else:
             (self.trials, self.rcnt, self.rsum, self.total) = state
 
+    @property
+    def capacity(self) -> int:
+        """Row count of the state arrays (>= len(group_ids); the surplus
+        rows are unenrolled capacity so growth doesn't recompile per id)."""
+        return int(self.trials.shape[0])
+
+    def rows_for(self, ids: Sequence[str]) -> List[int]:
+        """State-array row indices for the given group ids."""
+        return [self._gindex[g] for g in ids]
+
     def add_groups(self, new_ids: Sequence[str]) -> None:
         """Grow the fleet with fresh learners (zeroed state — identical to a
-        newly constructed scalar learner).  Streaming callers batch unknown
-        entities per drained wave so the shape (and jit cache entry) changes
-        at most once per wave, not per event."""
+        newly constructed scalar learner).  Capacity grows in powers of two
+        so steady enrollment recompiles the jitted step O(log N) times, not
+        once per wave; unenrolled rows are inert (never active, never
+        emitted)."""
         fresh = list(dict.fromkeys(
             g for g in new_ids if g not in self._gindex))
         if not fresh:
@@ -243,7 +257,13 @@ class VectorizedLearnerGroup:
         for g in fresh:
             self._gindex[g] = len(self.group_ids)
             self.group_ids.append(g)
-        add = len(fresh)
+        need = len(self.group_ids) - self.capacity
+        if need <= 0:
+            return
+        cap = max(8, self.capacity)
+        while cap < len(self.group_ids):
+            cap *= 2
+        add = cap - self.capacity
 
         def pad(a, fill=0):
             return jnp.concatenate(
@@ -269,16 +289,18 @@ class VectorizedLearnerGroup:
         self._set_state(state)
         return np.asarray(sels)
 
-    def step_masked(self, active: np.ndarray) -> np.ndarray:
+    def step_masked(self, active: np.ndarray,
+                    n_steps: int = 1) -> np.ndarray:
         """Advance ONLY the groups where ``active`` is True (the streaming
-        case: an entity's learner steps when its event arrives).  Returns
-        selected action indices [G]; entries for inactive groups are
-        meaningless and their state is untouched."""
+        case: an entity's learner steps when its event arrives), ``n_steps``
+        times inside one jitted scan.  Returns selected action indices
+        [n_steps, capacity]; entries for inactive groups are meaningless and
+        their state is untouched."""
         self._key, sub = jax.random.split(self._key)
-        state, sel = self._masked_fn(self._state(), sub,
-                                     jnp.asarray(active, bool))
+        state, sels = self._masked_fn(self._state(), sub, n_steps,
+                                      jnp.asarray(active, bool))
         self._set_state(state)
-        return np.asarray(sel)
+        return np.asarray(sels)
 
     def next_actions(self) -> List[List[str]]:
         """``batch.size`` action ids per group: [G][batch] of action_id —

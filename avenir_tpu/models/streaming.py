@@ -243,17 +243,26 @@ class GroupedStreamingLearnerLoop:
         learner_type, actions = _parse_learner_spec(config)
         self.group = VectorizedLearnerGroup(learner_type, list(entities),
                                             actions, config)
+        self._actions = set(actions)
         self.transport = transport
         self.event_count = 0
         self.reward_count = 0
+        self.malformed_count = 0
 
     def apply_rewards(self) -> int:
+        """Drain ``entityID,actionID,reward`` messages as one bulk scatter;
+        malformed or unknown-action messages are counted and skipped so one
+        bad queue entry cannot take down the fleet loop."""
         gids, aids, rs = [], [], []
         for msg in self.transport.read_rewards():
-            entity, action_id, reward = msg.split(",")[:3]
-            gids.append(entity)
-            aids.append(action_id)
-            rs.append(int(reward))
+            parts = msg.split(",")
+            if (len(parts) < 3 or parts[1] not in self._actions
+                    or not parts[2].lstrip("-").isdigit()):
+                self.malformed_count += 1
+                continue
+            gids.append(parts[0])
+            aids.append(parts[1])
+            rs.append(int(parts[2]))
         if gids:
             self.group.add_groups(gids)
             self.group.set_rewards(gids, aids, rs)
@@ -282,13 +291,13 @@ class GroupedStreamingLearnerLoop:
             for e in pending:
                 (rest if e in seen else wave).append(e)
                 seen.add(e)
-            active = np.zeros(len(self.group.group_ids), dtype=bool)
-            rows = [self.group._gindex[e] for e in wave]
+            active = np.zeros(self.group.capacity, dtype=bool)
+            rows = self.group.rows_for(wave)
             active[rows] = True
-            # batch.size masked steps per event, matching the scalar loop's
-            # learner.next_actions() / the bolt's eventID,action[,action...]
-            sels = [self.group.step_masked(active)
-                    for _ in range(self.group.batch_size)]
+            # batch.size selections per event in ONE jitted scan, matching
+            # the scalar loop's learner.next_actions() / the bolt's
+            # eventID,action[,action...] format
+            sels = self.group.step_masked(active, self.group.batch_size)
             for e, r in zip(wave, rows):
                 acts = ",".join(self.group.action_ids[s[r]] for s in sels)
                 self.transport.write_action(f"{e},{acts}")

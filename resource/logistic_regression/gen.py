@@ -1,0 +1,14 @@
+#!/usr/bin/env python
+"""Planted-boundary int-feature fixture for the logistic-regression runbook
+(the job parses features with Integer.parseInt parity —
+LogisticRegressionJob.java:190)."""
+import sys
+import numpy as np
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 2000
+rng = np.random.default_rng(11)
+feats = rng.integers(-10, 11, (n, 4))
+y = (feats[:, 0] + 2 * feats[:, 1] - feats[:, 2] > 0).astype(int)
+for i in range(n):
+    print(f"R{i:06d}," + ",".join(str(v) for v in feats[i])
+          + ("," + ("C1" if y[i] else "C0")))

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Logistic regression: the reference's rc-100/101 outer loop over the
+# iterative MR job, coefficient history checkpointed between iterations
+set -euo pipefail
+cd "$(dirname "$0")"
+PY=${PYTHON:-python}
+rm -rf work && mkdir -p work
+
+mkdir -p work/in && $PY gen.py 2000 > work/in/part-00000
+printf '0.0,0.0,0.0,0.0,0.0\n' > work/coeff.txt
+
+converged=0
+for it in $(seq 1 60); do
+  rc=0
+  $PY -m avenir_tpu LogisticRegressionJob -Dconf.path=lr.properties work/in work/out || rc=$?
+  if [ "$rc" -eq 100 ]; then echo "converged after $it iterations"; converged=1; break; fi
+  if [ "$rc" -ne 101 ]; then echo "job failed rc=$rc"; exit "$rc"; fi
+done
+if [ "$converged" -ne 1 ]; then echo "did not converge within the iteration budget"; exit 1; fi
+
+echo "coefficient history (one line per iteration): work/coeff.txt"
+tail -n 2 work/coeff.txt
