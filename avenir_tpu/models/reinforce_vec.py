@@ -254,29 +254,41 @@ class VectorizedLearnerGroup:
             g for g in new_ids if g not in self._gindex))
         if not fresh:
             return
+        first_row = len(self.group_ids)
         for g in fresh:
             self._gindex[g] = len(self.group_ids)
             self.group_ids.append(g)
-        need = len(self.group_ids) - self.capacity
-        if need <= 0:
-            return
-        cap = max(8, self.capacity)
-        while cap < len(self.group_ids):
-            cap *= 2
-        add = cap - self.capacity
+        if len(self.group_ids) > self.capacity:
+            cap = max(8, self.capacity)
+            while cap < len(self.group_ids):
+                cap *= 2
+            add = cap - self.capacity
 
-        def pad(a, fill=0):
-            return jnp.concatenate(
-                [a, jnp.full((add,) + a.shape[1:], fill, a.dtype)], axis=0)
+            def pad(a, fill=0):
+                return jnp.concatenate(
+                    [a, jnp.full((add,) + a.shape[1:], fill, a.dtype)],
+                    axis=0)
 
-        self.trials = pad(self.trials)
-        self.rcnt = pad(self.rcnt)
-        self.rsum = pad(self.rsum)
-        self.total = pad(self.total)
+            self.trials = pad(self.trials)
+            self.rcnt = pad(self.rcnt)
+            self.rsum = pad(self.rsum)
+            self.total = pad(self.total)
+            if self.learner_type == "softMax":
+                self.temp = pad(self.temp, self._temp0)
+                self.probs = pad(self.probs, 1.0 / len(self.action_ids))
+                self.rewarded = pad(self.rewarded, False)
+        # explicitly zero the enrolled rows: surplus capacity rows are
+        # advanced by full-fleet step() calls, so a recycled row must be
+        # reset to honor the fresh-learner contract
+        rows = jnp.arange(first_row, len(self.group_ids))
+        self.trials = self.trials.at[rows].set(0)
+        self.rcnt = self.rcnt.at[rows].set(0)
+        self.rsum = self.rsum.at[rows].set(0.0)
+        self.total = self.total.at[rows].set(0)
         if self.learner_type == "softMax":
-            self.temp = pad(self.temp, self._temp0)
-            self.probs = pad(self.probs, 1.0 / len(self.action_ids))
-            self.rewarded = pad(self.rewarded, False)
+            self.temp = self.temp.at[rows].set(self._temp0)
+            self.probs = self.probs.at[rows].set(1.0 / len(self.action_ids))
+            self.rewarded = self.rewarded.at[rows].set(False)
 
     # -- public surface ------------------------------------------------------
 

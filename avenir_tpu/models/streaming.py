@@ -256,13 +256,17 @@ class GroupedStreamingLearnerLoop:
         gids, aids, rs = [], [], []
         for msg in self.transport.read_rewards():
             parts = msg.split(",")
-            if (len(parts) < 3 or parts[1] not in self._actions
-                    or not parts[2].lstrip("-").isdigit()):
+            try:
+                reward = int(parts[2])
+            except (IndexError, ValueError):
+                self.malformed_count += 1
+                continue
+            if parts[1] not in self._actions:
                 self.malformed_count += 1
                 continue
             gids.append(parts[0])
             aids.append(parts[1])
-            rs.append(int(parts[2]))
+            rs.append(reward)
         if gids:
             self.group.add_groups(gids)
             self.group.set_rewards(gids, aids, rs)

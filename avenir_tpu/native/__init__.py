@@ -33,6 +33,12 @@ _lib_failed = False
 
 # column type codes shared with csv_ingest.c
 SKIP, INT64, FLOAT64, BYTES = 0, 1, 2, 3
+
+# buffers at least this large take the multithreaded encode path
+MT_MIN_BYTES = 4 << 20
+# thread count override (None = min(8, cores)); tests force >1 so the
+# pthread path is exercised even on single-core hosts
+MT_THREADS = None
 BUCKET, FLOATVAL, CAT = 1, 2, 4      # csv_encode column roles
 Y_DEST = -2                          # feat_idx routing a CAT column to ycol
 
@@ -41,7 +47,7 @@ def _compile() -> bool:
     for cc in ("cc", "gcc", "g++"):
         try:
             proc = subprocess.run(
-                [cc, "-O3", "-shared", "-fPIC", "-o", _SO, _SRC],
+                [cc, "-O3", "-pthread", "-shared", "-fPIC", "-o", _SO, _SRC],
                 capture_output=True, timeout=120)
         except (OSError, subprocess.TimeoutExpired):
             continue
@@ -88,6 +94,9 @@ def get_lib():
                 ctypes.POINTER(ctypes.c_int),        # bytes_width
                 ctypes.c_void_p, ctypes.c_void_p,    # uniq_start, uniq_len
                 ctypes.c_void_p, ctypes.c_int]       # n_uniq, max_uniq
+            lib.csv_encode_mt.restype = ctypes.c_int
+            lib.csv_encode_mt.argtypes = (list(lib.csv_encode.argtypes)
+                                          + [ctypes.c_int])  # n_threads
             _lib = lib
         except Exception as e:  # pragma: no cover - environment-dependent
             print(f"avenir_tpu.native: C ingest unavailable ({e}); "
@@ -207,7 +216,12 @@ def encode_schema(path: str, col_specs: Sequence[Tuple[int, int, int]],
     uniq_len = np.zeros_like(uniq_start, dtype=np.int32)
     n_uniq = np.zeros(n_file_cols, dtype=np.int32)
 
-    rc = lib.csv_encode(
+    # multithreaded encode for large buffers; the local-vocab memory is
+    # T * n_cols * max_uniq, so the big-vocab retry stays single-threaded
+    n_threads = 1
+    if len(buf) >= MT_MIN_BYTES and max_uniq <= (1 << 16):
+        n_threads = MT_THREADS or min(8, os.cpu_count() or 1)
+    rc = lib.csv_encode_mt(
         buf, len(buf), bdelim, n_file_cols,
         (ctypes.c_int * n_file_cols)(*col_type),
         (ctypes.c_int * n_file_cols)(*feat_idx),
@@ -217,7 +231,7 @@ def encode_schema(path: str, col_specs: Sequence[Tuple[int, int, int]],
         y.ctypes.data if y is not None else None,
         bytes_out, widths,
         uniq_start.ctypes.data, uniq_len.ctypes.data, n_uniq.ctypes.data,
-        uniq_start.shape[1])
+        uniq_start.shape[1], n_threads)
     if rc == -3 and max_uniq < (1 << 22):   # vocab overflow: one retry, 64x
         return encode_schema(path, col_specs, n_file_cols, n_feat, has_class,
                              id_ordinal, delim, max_uniq=1 << 22)

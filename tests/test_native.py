@@ -129,3 +129,43 @@ def test_parse_csv_columns_roundtrip(tmp_path, have_native):
     np.testing.assert_array_equal(cols[0], [1, -7, 42])
     assert cols[1].tolist() == [b"x", b"yy", b"zzz"]
     np.testing.assert_allclose(cols[2], [2.5, 0.125, -3.0])
+
+
+def test_multithreaded_encode_bit_identical(tmp_path, have_native,
+                                            monkeypatch):
+    """The pthread encode (chunked, thread-local vocabs merged in thread
+    order) must reproduce the serial path bit-for-bit — including
+    first-seen categorical ordinals when values first appear in different
+    chunks — on a buffer large enough for 8 real chunks."""
+    monkeypatch.setattr(native, "MT_MIN_BYTES", 1)
+    monkeypatch.setattr(native, "MT_THREADS", 8)   # real threads, any host
+    rng = np.random.default_rng(17)
+    colors = [f"c{i}" for i in range(23)]
+    n = 5001                      # not divisible by 8; empty line injected
+    rows = []
+    for i in range(n):
+        # stagger first appearances: color c_k debuts around row k*200
+        pool = colors[:max(2, min(len(colors), i // 200 + 2))]
+        rows.append([f"id{i:05d}", pool[rng.integers(len(pool))],
+                     str(int(rng.integers(-100, 100))),
+                     f"{rng.uniform(-5, 5):.4f}",
+                     "Y" if rng.random() < 0.3 else "N"])
+    text = "\n".join(",".join(r) for r in rows[:2500]) + "\n\n" + \
+        "\n".join(",".join(r) for r in rows[2500:]) + "\n"
+    p = tmp_path / "big.csv"
+    p.write_text(text)
+
+    enc_mt = DatasetEncoder(SCHEMA)
+    ds_mt = enc_mt._encode_path_native(str(p), ",")
+    assert ds_mt is not None
+
+    enc_ref = DatasetEncoder(SCHEMA)
+    ds_ref = enc_ref.encode([list(r) for r in rows])
+
+    np.testing.assert_array_equal(ds_mt.x, ds_ref.x)
+    np.testing.assert_array_equal(ds_mt.y, ds_ref.y)
+    np.testing.assert_allclose(ds_mt.values, ds_ref.values)
+    for ordinal in enc_ref.vocabs:
+        assert enc_mt.vocabs[ordinal].values == enc_ref.vocabs[ordinal].values
+    assert enc_mt.class_vocab.values == enc_ref.class_vocab.values
+    assert ds_mt.ids == ds_ref.ids

@@ -615,3 +615,24 @@ def test_grouped_loop_skips_malformed_rewards():
     loop.step_batch()
     assert loop.malformed_count == 3
     assert loop.reward_count == 1
+
+
+def test_recycled_capacity_rows_start_fresh():
+    """Full-fleet step() advances surplus capacity rows; an entity later
+    enrolled into one must still start with zeroed learner state."""
+    from avenir_tpu.models.reinforce_vec import VectorizedLearnerGroup
+
+    vec = VectorizedLearnerGroup("upperConfidenceBoundOne", ["a"],
+                                 ["x", "y"], {})
+    vec.add_groups(["b"])          # capacity grows past 2
+    assert vec.capacity > 2
+    vec.step(3)                    # pollutes surplus rows
+    vec.add_groups(["c"])          # recycles a polluted row
+    r = vec.rows_for(["c"])[0]
+    assert int(vec.trials[r].sum()) == 0
+    assert int(vec.total[r]) == 0
+    # and the fresh learner behaves like one: first picks are untried arms
+    active = np.zeros(vec.capacity, dtype=bool)
+    active[r] = True
+    first = {int(vec.step_masked(active)[0][r]) for _ in range(2)}
+    assert first == {0, 1}         # UCB1 +inf untried arms, both explored
