@@ -30,12 +30,15 @@ interoperate unchanged).
 
 from __future__ import annotations
 
+import re
 import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from .reinforce import Action, ReinforcementLearner, create_learner
+
+_INT_RE = re.compile(r"-?\d+", re.ASCII)
 
 
 class Transport:
@@ -256,14 +259,12 @@ class GroupedStreamingLearnerLoop:
         gids, aids, rs = [], [], []
         for msg in self.transport.read_rewards():
             parts = msg.split(",")
-            try:
-                reward = int(parts[2])
-            except (IndexError, ValueError):
+            # strict integer syntax: int() alone would admit '1_0'/' 10'/+
+            if (len(parts) < 3 or parts[1] not in self._actions
+                    or not _INT_RE.fullmatch(parts[2])):
                 self.malformed_count += 1
                 continue
-            if parts[1] not in self._actions:
-                self.malformed_count += 1
-                continue
+            reward = int(parts[2])
             gids.append(parts[0])
             aids.append(parts[1])
             rs.append(reward)

@@ -214,7 +214,9 @@ def sharded_reduce(local_fn: Callable, *row_arrays,
     (local_fn, mesh, static_args, shapes/dtypes).
     """
     mesh = mesh or get_mesh()
-    d = mesh.shape["data"]
+    # rows shard over EVERY mesh axis (data and model flattened together):
+    # counting is 1-D work, so no device idles whatever the mesh shape
+    d = int(mesh.devices.size)
     padded = []
     mask = None
     for a in row_arrays:
@@ -242,13 +244,15 @@ def _compiled_reduce(local_fn: Callable, mesh, static_args: tuple,
     key = (local_fn, mesh, static_args, ndims)
     fn = _sharded_reduce_cache.get(key)
     if fn is None:
-        in_specs = tuple(P("data", *([None] * (nd - 1))) for nd in ndims)
-        in_specs = in_specs + (P("data"),)
+        axes = tuple(mesh.axis_names)
+        in_specs = tuple(P(axes, *([None] * (nd - 1))) for nd in ndims)
+        in_specs = in_specs + (P(axes),)
 
         def wrapped(*args):
             *shards, m = args
             out = local_fn(*shards, m, *static_args)
-            return jax.tree_util.tree_map(lambda t: jax.lax.psum(t, "data"), out)
+            return jax.tree_util.tree_map(
+                lambda t: jax.lax.psum(t, axes), out)
 
         # out_specs P(): psum makes every shard's output identical (replicated)
         fn = jax.jit(shard_map(wrapped, mesh=mesh, in_specs=in_specs,

@@ -230,6 +230,7 @@ def pairwise_distances(qnum: np.ndarray, qcat: np.ndarray,
     """
     mesh = mesh or get_mesh()
     d = mesh.shape["data"]
+    m_ax = mesh.shape["model"]
     nq = qnum.shape[0]
     nt = tnum.shape[0]
     # fold weights into the numeric columns so the matmul needs no extra pass
@@ -238,27 +239,57 @@ def pairwise_distances(qnum: np.ndarray, qcat: np.ndarray,
 
     qnum_p, _ = pad_rows(qnum, d)
     qcat_p, _ = pad_rows(qcat, d)
+    # training rows shard over the ``model`` axis (2-D sharding: each device
+    # owns a [rows/d, cand/m] tile); with model=1 this is the replicated
+    # broadcast layout
+    tnum_p, tmask = pad_rows(tnum, m_ax)
+    tcat_p, _ = pad_rows(tcat, m_ax)
+    t_local = tnum_p.shape[0] // m_ax
     k = min(top_k, nt) if top_k else None
 
     key = (mesh, algorithm, scale, k, wsum, topk_method, qnum_p.shape,
-           qcat_p.shape, tnum.shape, tcat.shape)
+           qcat_p.shape, tnum_p.shape, tcat_p.shape)
     fn = _pairwise_cache.get(key)
     if fn is None:
-        def local(qn, qc, tn, tc, wc):
-            dist = _block_dist(qn, qc, tn, tc, wc, wsum, algorithm, scale)
-            if k is not None:
-                return topk_smallest(dist, k, topk_method)
-            return dist
+        sentinel = np.int32(np.iinfo(np.int32).max)
 
+        def local(qn, qc, tn, tc, tm, wc):
+            dist = _block_dist(qn, qc, tn, tc, wc, wsum, algorithm, scale)
+            if k is None:
+                return dist
+            if m_ax == 1:
+                return topk_smallest(dist, k, topk_method)
+            # per-shard top-k over the local candidate tile, then merge
+            # across ``model`` (every global top-k element is in its
+            # shard's top-k; gather order = global index order, so the
+            # stable tie order is preserved)
+            k_loc = min(k, t_local)
+            dist = jnp.where(tm[None, :], dist, sentinel)
+            v, i = topk_smallest(dist, k_loc, topk_method)
+            i = i + jax.lax.axis_index("model") * t_local
+            v = jax.lax.all_gather(v, "model", axis=1, tiled=True)
+            i = jax.lax.all_gather(i, "model", axis=1, tiled=True)
+            v2, pos = topk_smallest(v, k, topk_method)
+            i2 = jnp.take_along_axis(i, pos, axis=1)
+            # every model shard computed the identical merge; pmax marks
+            # the result model-invariant for the out_specs check
+            return (jax.lax.pmax(v2, "model"), jax.lax.pmax(i2, "model"))
+
+        t_spec = P("model") if m_ax > 1 else P()
+        if k is not None:
+            out_specs = (P("data"), P("data"))
+        else:
+            out_specs = P("data", "model") if m_ax > 1 else P("data")
         fn = jax.jit(shard_map(
             local, mesh=mesh,
-            in_specs=(P("data"), P("data"), P(), P(), P()),
-            out_specs=(P("data"), P("data")) if k is not None else P("data")))
+            in_specs=(P("data"), P("data"), t_spec, t_spec, t_spec, P()),
+            out_specs=out_specs))
         _pairwise_cache[key] = fn
 
-    args = (qnum_p, qcat_p, tnum.astype(np.float32),
-            tcat.astype(np.int32), cat_weights.astype(np.float32))
+    args = (qnum_p, qcat_p, tnum_p.astype(np.float32),
+            tcat_p.astype(np.int32), tmask,
+            cat_weights.astype(np.float32))
     if k is not None:
         dist, idx = fn(*args)
         return np.asarray(dist)[:nq], np.asarray(idx)[:nq]
-    return np.asarray(fn(*args))[:nq], None
+    return np.asarray(fn(*args))[:nq, :nt], None

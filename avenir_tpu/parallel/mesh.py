@@ -37,11 +37,30 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
 _default_mesh: Optional[Mesh] = None
 
 
+def _mesh_from_env() -> Optional[Mesh]:
+    """Honor ``AVENIR_MESH=<data>x<model>`` (e.g. ``4x2``) so CLI users can
+    pick the 2-D split without code — the mesh-shape knob of the rebuild's
+    execution layer (the reference's analogue was the reducer-count /
+    parallelism properties)."""
+    import os
+    spec = os.environ.get("AVENIR_MESH")
+    if not spec:
+        return None
+    try:
+        data_s, model_s = spec.lower().split("x")
+        return make_mesh(data=int(data_s), model=int(model_s))
+    except (ValueError, TypeError) as e:
+        raise ValueError(
+            f"bad AVENIR_MESH={spec!r}; expected <data>x<model> with "
+            f"data*model == device count ({len(jax.devices())})") from e
+
+
 def get_mesh() -> Mesh:
-    """Process-wide default mesh over all visible devices (data axis only)."""
+    """Process-wide default mesh over all visible devices: ``AVENIR_MESH``
+    shape if set, else all devices on the data axis."""
     global _default_mesh
     if _default_mesh is None or _default_mesh.devices.size != len(jax.devices()):
-        _default_mesh = make_mesh()
+        _default_mesh = _mesh_from_env() or make_mesh()
     return _default_mesh
 
 

@@ -136,3 +136,29 @@ def test_datagen_planted_signal():
     assert mu_churn > mu_keep + 200
     # determinism
     assert gen_telecom_churn(50, seed=3) == gen_telecom_churn(50, seed=3)
+
+
+def test_avenir_mesh_env_shapes_default_mesh(monkeypatch):
+    """AVENIR_MESH=<data>x<model> shapes the process-default mesh (the CLI
+    user's 2-D-parallelism knob); bad specs fail loudly."""
+    import avenir_tpu.parallel.mesh as meshmod
+
+    monkeypatch.setattr(meshmod, "_default_mesh", None)
+    monkeypatch.setenv("AVENIR_MESH", "4x2")
+    m = meshmod.get_mesh()
+    assert dict(m.shape) == {"data": 4, "model": 2}
+
+    monkeypatch.setattr(meshmod, "_default_mesh", None)
+    monkeypatch.setenv("AVENIR_MESH", "3x2")   # 6 != 8 devices
+    with pytest.raises(ValueError):
+        meshmod.get_mesh()
+
+    monkeypatch.setattr(meshmod, "_default_mesh", None)
+    monkeypatch.setenv("AVENIR_MESH", "banana")
+    with pytest.raises(ValueError, match="AVENIR_MESH"):
+        meshmod.get_mesh()
+
+    monkeypatch.setattr(meshmod, "_default_mesh", None)
+    monkeypatch.delenv("AVENIR_MESH")
+    m = meshmod.get_mesh()
+    assert dict(m.shape) == {"data": 8, "model": 1}

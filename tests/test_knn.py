@@ -479,3 +479,36 @@ def test_pairwise_topk_ring_pure_categorical(mesh8):
     d, i = pairwise_topk_ring(qnum, qcat, tnum, tcat, w, wc, k, mesh=mesh8)
     np.testing.assert_array_equal(d, dref)
     assert ((i >= 0) & (i < nt)).all()
+
+
+def test_pairwise_distances_2d_mesh_matches_1d(mesh8):
+    """On a data x model mesh the training rows shard over `model` (true 2-D
+    sharding); results must match the 1-D broadcast layout exactly."""
+    from avenir_tpu.parallel.mesh import make_mesh
+    import jax
+
+    mesh42 = make_mesh(devices=jax.devices()[:8], data=4, model=2)
+    rng = np.random.default_rng(21)
+    nq, nt, Fn, k = 23, 57, 4, 6
+    qnum = rng.uniform(0, 10, (nq, Fn)).astype(np.float32)
+    tnum = rng.uniform(0, 10, (nt, Fn)).astype(np.float32)
+    qcat = rng.integers(0, 3, (nq, 2)).astype(np.int32)
+    tcat = rng.integers(0, 3, (nt, 2)).astype(np.int32)
+    wn = np.ones(Fn)
+    wc = np.ones(2)
+
+    dref, iref = pairwise_distances(qnum, qcat, tnum, tcat, wn, wc,
+                                    top_k=k, mesh=mesh8)
+    d2, i2 = pairwise_distances(qnum, qcat, tnum, tcat, wn, wc,
+                                top_k=k, mesh=mesh42)
+    np.testing.assert_array_equal(d2, dref)
+    full_ref, _ = pairwise_distances(qnum, qcat, tnum, tcat, wn, wc,
+                                     mesh=mesh8)
+    full_2d, _ = pairwise_distances(qnum, qcat, tnum, tcat, wn, wc,
+                                    mesh=mesh42)
+    np.testing.assert_array_equal(full_2d, full_ref)
+    # index parity wherever the row's value is unique
+    for r in range(nq):
+        uniq = np.isin(dref[r],
+                       np.flatnonzero(np.bincount(full_ref[r]) == 1))
+        np.testing.assert_array_equal(i2[r][uniq], iref[r][uniq])
