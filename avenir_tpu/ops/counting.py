@@ -196,6 +196,74 @@ def feature_class_counts(x: jnp.ndarray, y: jnp.ndarray,
 _sharded_reduce_cache: dict = {}
 
 
+_ngram_cache: dict = {}
+
+
+def sharded_ngram_counts(stream, vocab_size: int, w: int,
+                         mesh=None) -> jnp.ndarray:
+    """n-gram counts over ONE long symbol stream sharded across devices —
+    the sequence/context-parallel form of the PST/Markov window counting
+    (ProbabilisticSuffixTreeGenerator.java:140-210 keeps a rolling window
+    per mapper; here the stream itself is the sharded axis).
+
+    Each device holds a contiguous chunk; a halo of ``w - 1`` tokens
+    arrives from the right neighbor via ``lax.ppermute`` so the n-grams
+    that straddle a chunk boundary are counted exactly once (by the chunk
+    they start in); per-shard tables ``psum`` into the replicated result.
+    Tokens < 0 (gaps / padding) invalidate any window containing them —
+    the ``count_table`` drop contract — so concatenated sessions separated
+    by -1 markers never produce cross-session n-grams.
+
+    Returns the dense ``[vocab_size] * w`` count tensor.
+    """
+    mesh = mesh or get_mesh()
+    d = int(mesh.devices.size)
+    axes = tuple(mesh.axis_names)
+    stream = np.asarray(stream, dtype=np.int32)
+    L = stream.shape[0]
+    # chunks must hold at least w tokens so a window spans at most one halo
+    chunk_len = max(-(-max(L, 1) // d), w)
+    padded = np.full(d * chunk_len, -1, dtype=np.int32)
+    padded[:L] = stream
+
+    key = (mesh, vocab_size, w, padded.shape)
+    fn = _ngram_cache.get(key)
+    if fn is None:
+        def shift(v, ax):
+            n_ax = mesh.shape[ax]
+            if n_ax == 1:
+                return v
+            return jax.lax.ppermute(
+                v, ax, [((i + 1) % n_ax, i) for i in range(n_ax)])
+
+        def local(chunk):
+            # halo = the head of the NEXT shard in flattened P(axes) order
+            # (row-major over the axis tuple): shift the innermost axis by
+            # one; shards at an inner-axis edge take the value shifted
+            # along the next-outer axis too, cascading outward
+            h = chunk[:w - 1]
+            halo = shift(h, axes[-1])
+            edge = (jax.lax.axis_index(axes[-1])
+                    == mesh.shape[axes[-1]] - 1)
+            for ax in reversed(axes[:-1]):
+                halo = jnp.where(edge, shift(halo, ax), halo)
+                edge = edge & (jax.lax.axis_index(ax)
+                               == mesh.shape[ax] - 1)
+            # `edge` is now True only on the LAST flattened shard, whose
+            # halo wrapped to the stream head and must not count
+            halo = jnp.where(edge, -1, halo)
+            ext = jnp.concatenate([chunk, halo])
+            Lc = chunk.shape[0]
+            cols = tuple(ext[i:i + Lc] for i in range(w))
+            c = count_table((vocab_size,) * w, cols)
+            return jax.lax.psum(c, axes)
+
+        fn = jax.jit(shard_map(local, mesh=mesh, in_specs=P(axes),
+                               out_specs=P()))
+        _ngram_cache[key] = fn
+    return fn(padded)
+
+
 def sharded_reduce(local_fn: Callable, *row_arrays,
                    mesh=None,
                    static_args: tuple = ()):
@@ -230,10 +298,11 @@ def sharded_reduce(local_fn: Callable, *row_arrays,
 def sharded_reduce_resident(local_fn, *row_arrays, mask, mesh=None,
                             static_args: tuple = ()):
     """``sharded_reduce`` for device-resident inputs: the caller has already
-    padded rows to a multiple of the data-axis size, placed the arrays (e.g.
-    via ``parallel.shard_rows``), and supplies the validity mask.  This is
-    the steady-state training path — data stays in HBM across iterations
-    instead of re-transferring per call."""
+    padded rows to a multiple of the mesh's TOTAL device count (rows shard
+    over every axis), placed the arrays (e.g. via ``parallel.shard_rows``),
+    and supplies the validity mask.  This is the steady-state training
+    path — data stays in HBM across iterations instead of re-transferring
+    per call."""
     mesh = mesh or get_mesh()
     return _compiled_reduce(local_fn, mesh, static_args,
                             tuple(a.ndim for a in row_arrays))(*row_arrays, mask)
