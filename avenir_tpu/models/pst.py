@@ -30,7 +30,8 @@ import numpy as np
 from ..core.config import JobConfig
 from ..core.io import read_lines, split_line, write_output
 from ..core.metrics import Counters
-from ..ops.counting import count_table, sharded_reduce
+from ..ops.counting import (count_table, sharded_ngram_counts,
+                            sharded_reduce)
 
 _DENSE_CAP = 1 << 22  # max dense count-tensor cells before host fallback
 
@@ -103,13 +104,50 @@ class ProbabilisticSuffixTreeGenerator:
         ngram_counts: Dict[Tuple, int] = {}
         root_counts: Dict[Tuple[str, ...], int] = PyCounter()
 
+        inv = list(vocab.keys())
+        inv_pre = list(pre_vocab.keys())
+
+        def extract(c: np.ndarray) -> None:
+            for key in np.argwhere(c > 0):
+                toks_k = tuple(inv[k] for k in key[1:])
+                ngram_counts[(inv_pre[key[0]],) + toks_k] = int(c[tuple(key)])
+
+        # sequential mode: concatenate every row into ONE segmented stream
+        # (-1 separators, per-token fused prefix id) so all sliding windows
+        # of every length come from the sequence-parallel halo-exchange
+        # counter — no host window materialization
+        # (ProbabilisticSuffixTreeGenerator.java:153-173); skipped when even
+        # the w=2 table exceeds the dense cap (every w would fall back)
+        stream = seg_ids = None
+        if sequential and max_len >= 2 and P * V * V <= _DENSE_CAP:
+            toks, sgs = [], []
+            for r_i, body in enumerate(seqs):
+                if len(body) < 2:
+                    continue
+                toks.extend(vocab[t] for t in body)
+                toks.append(-1)
+                sgs.extend([pre_vocab[prefixes[r_i]]] * len(body))
+                sgs.append(-1)
+            stream = np.asarray(toks, dtype=np.int32)
+            seg_ids = np.asarray(sgs, dtype=np.int32)
+
         for w in range(2, max_len + 1):
-            # sequential rows: every sliding window of length w
-            # (ProbabilisticSuffixTreeGenerator.java:153-173);
-            # sessionized rows: ONLY the length-w prefix of each full rolling
-            # window — the reference emits window[0:w] once per event
-            # (:225-241), so sliding inside overlapping windows would
-            # over-count interior n-grams
+            sizes = (P,) + (V,) * w
+            if (stream is not None and stream.size
+                    and int(np.prod(sizes)) <= _DENSE_CAP):
+                c = np.asarray(sharded_ngram_counts(
+                    stream, V, w, seg=seg_ids, n_seg=P, mesh=mesh))
+                extract(c)
+                for p_i in range(P):
+                    n_win = int(c[p_i].sum())
+                    if n_win:
+                        root_counts[inv_pre[p_i]] += n_win
+                continue
+            # sessionized rows emit ONLY the length-w prefix of each full
+            # rolling window — the reference emits window[0:w] once per
+            # event (:225-241), so sliding inside overlapping windows would
+            # over-count interior n-grams; also the host fallback for
+            # over-cap dense tables
             rows, pcs = [], []
             for r_i, body in enumerate(seqs):
                 if len(body) < 2:
@@ -126,20 +164,12 @@ class ProbabilisticSuffixTreeGenerator:
                 continue
             windows = np.asarray(rows, dtype=np.int32)
             part_cls = np.asarray(pcs, dtype=np.int32)
-            sizes = (P,) + (V,) * w
             if int(np.prod(sizes)) <= _DENSE_CAP:
                 c = np.asarray(sharded_reduce(
                     _pst_local, windows, part_cls, mesh=mesh,
                     static_args=(sizes,)))
-                nz = np.argwhere(c > 0)
-                inv = list(vocab.keys())
-                inv_pre = list(pre_vocab.keys())
-                for key in nz:
-                    toks = tuple(inv[k] for k in key[1:])
-                    ngram_counts[(inv_pre[key[0]],) + toks] = int(c[tuple(key)])
+                extract(c)
             else:
-                inv = list(vocab)
-                inv_pre = list(pre_vocab.keys())
                 host = PyCounter()
                 for row, pc in zip(rows, pcs):
                     host[(inv_pre[pc],) + tuple(inv[k] for k in row)] += 1

@@ -200,6 +200,7 @@ _ngram_cache: dict = {}
 
 
 def sharded_ngram_counts(stream, vocab_size: int, w: int,
+                         seg=None, n_seg: int = 1,
                          mesh=None) -> jnp.ndarray:
     """n-gram counts over ONE long symbol stream sharded across devices —
     the sequence/context-parallel form of the PST/Markov window counting
@@ -207,14 +208,20 @@ def sharded_ngram_counts(stream, vocab_size: int, w: int,
     per mapper; here the stream itself is the sharded axis).
 
     Each device holds a contiguous chunk; a halo of ``w - 1`` tokens
-    arrives from the right neighbor via ``lax.ppermute`` so the n-grams
-    that straddle a chunk boundary are counted exactly once (by the chunk
-    they start in); per-shard tables ``psum`` into the replicated result.
-    Tokens < 0 (gaps / padding) invalidate any window containing them —
-    the ``count_table`` drop contract — so concatenated sessions separated
-    by -1 markers never produce cross-session n-grams.
+    arrives from the next shard in flattened axis order via
+    ``lax.ppermute`` so the n-grams that straddle a chunk boundary are
+    counted exactly once (by the chunk they start in); per-shard tables
+    ``psum`` into the replicated result.  Tokens < 0 (gaps / padding)
+    invalidate any window containing them — the ``count_table`` drop
+    contract — so concatenated sessions separated by -1 markers never
+    produce cross-session n-grams.
 
-    Returns the dense ``[vocab_size] * w`` count tensor.
+    With ``seg`` (an int32 per-token segment id, e.g. the PST's fused
+    partition/class id), windows additionally require every token to share
+    one segment, and the result gains a leading ``[n_seg]`` axis.
+
+    Returns the dense ``[vocab_size] * w`` count tensor (or
+    ``[n_seg] + [vocab_size] * w``).
     """
     mesh = mesh or get_mesh()
     d = int(mesh.devices.size)
@@ -225,8 +232,15 @@ def sharded_ngram_counts(stream, vocab_size: int, w: int,
     chunk_len = max(-(-max(L, 1) // d), w)
     padded = np.full(d * chunk_len, -1, dtype=np.int32)
     padded[:L] = stream
+    segged = seg is not None
+    if segged:
+        seg = np.asarray(seg, dtype=np.int32)
+        seg_p = np.full(d * chunk_len, -1, dtype=np.int32)
+        seg_p[:L] = seg
+    else:
+        seg_p = np.zeros(0, dtype=np.int32)
 
-    key = (mesh, vocab_size, w, padded.shape)
+    key = (mesh, vocab_size, w, segged, n_seg, padded.shape)
     fn = _ngram_cache.get(key)
     if fn is None:
         def shift(v, ax):
@@ -236,12 +250,11 @@ def sharded_ngram_counts(stream, vocab_size: int, w: int,
             return jax.lax.ppermute(
                 v, ax, [((i + 1) % n_ax, i) for i in range(n_ax)])
 
-        def local(chunk):
+        def fetch_halo(h):
             # halo = the head of the NEXT shard in flattened P(axes) order
             # (row-major over the axis tuple): shift the innermost axis by
             # one; shards at an inner-axis edge take the value shifted
             # along the next-outer axis too, cascading outward
-            h = chunk[:w - 1]
             halo = shift(h, axes[-1])
             edge = (jax.lax.axis_index(axes[-1])
                     == mesh.shape[axes[-1]] - 1)
@@ -251,17 +264,35 @@ def sharded_ngram_counts(stream, vocab_size: int, w: int,
                                == mesh.shape[ax] - 1)
             # `edge` is now True only on the LAST flattened shard, whose
             # halo wrapped to the stream head and must not count
-            halo = jnp.where(edge, -1, halo)
+            return jnp.where(edge, -1, halo)
+
+        def window_cols(chunk, halo):
             ext = jnp.concatenate([chunk, halo])
             Lc = chunk.shape[0]
-            cols = tuple(ext[i:i + Lc] for i in range(w))
-            c = count_table((vocab_size,) * w, cols)
+            return tuple(ext[i:i + Lc] for i in range(w))
+
+        def local(chunk, sg):
+            if segged:
+                # one halo exchange carries tokens AND segment ids
+                both = fetch_halo(jnp.stack([chunk[:w - 1], sg[:w - 1]]))
+                cols = window_cols(chunk, both[0])
+                scols = window_cols(sg, both[1])
+                same = jnp.ones_like(scols[0], dtype=bool)
+                for sc in scols[1:]:
+                    same &= (sc == scols[0])
+                c = count_table((n_seg,) + (vocab_size,) * w,
+                                (scols[0],) + cols, mask=same)
+            else:
+                cols = window_cols(chunk, fetch_halo(chunk[:w - 1]))
+                c = count_table((vocab_size,) * w, cols)
             return jax.lax.psum(c, axes)
 
-        fn = jax.jit(shard_map(local, mesh=mesh, in_specs=P(axes),
+        fn = jax.jit(shard_map(local, mesh=mesh,
+                               in_specs=(P(axes), P(axes) if segged
+                                         else P()),
                                out_specs=P()))
         _ngram_cache[key] = fn
-    return fn(padded)
+    return fn(padded, seg_p)
 
 
 def sharded_reduce(local_fn: Callable, *row_arrays,

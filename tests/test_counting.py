@@ -153,3 +153,37 @@ def test_sharded_ngram_counts_oracle(mesh8, mesh1):
             if (win >= 0).all():
                 want[tuple(win)] += 1
         np.testing.assert_array_equal(got42, want, err_msg=f"w={w} mesh42")
+
+
+def test_sharded_ngram_counts_segmented(mesh8):
+    """Segment ids add a leading table axis; windows crossing segments (or
+    separators) never count — the PST's per-(partition,class) form."""
+    from avenir_tpu.ops.counting import sharded_ngram_counts
+
+    rng = np.random.default_rng(4)
+    V, S = 4, 3
+    stream, seg = [], []
+    for _ in range(50):
+        s = int(rng.integers(0, S))
+        body = rng.integers(0, V, int(rng.integers(2, 9)))
+        stream.extend(int(t) for t in body)
+        seg.extend([s] * len(body))
+        stream.append(-1)
+        seg.append(-1)
+    stream = np.asarray(stream, np.int32)
+    seg = np.asarray(seg, np.int32)
+    import jax
+    from avenir_tpu.parallel.mesh import make_mesh
+    mesh42 = make_mesh(devices=jax.devices()[:8], data=4, model=2)
+    for mesh in (mesh8, mesh42):
+        for w in (2, 3):
+            got = np.asarray(sharded_ngram_counts(stream, V, w, seg=seg,
+                                                  n_seg=S, mesh=mesh))
+            want = np.zeros((S,) + (V,) * w, dtype=np.int64)
+            for i in range(len(stream) - w + 1):
+                win = stream[i:i + w]
+                sw = seg[i:i + w]
+                if (win >= 0).all() and (sw == sw[0]).all():
+                    want[(sw[0],) + tuple(win)] += 1
+            np.testing.assert_array_equal(got, want,
+                                          err_msg=f"w={w} {mesh.shape}")
