@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Class balancing: undersample the majority class, then a bagging bootstrap
+set -euo pipefail
+cd "$(dirname "$0")"
+PY=${PYTHON:-python}
+rm -rf work && mkdir -p work
+
+$PY -m avenir_tpu.datagen telecom_churn 4000 --seed 29 --out work/in/part-00000
+
+$PY -m avenir_tpu UnderSamplingBalancer -Dconf.path=balance.properties work/in work/balanced
+$PY -m avenir_tpu BaggingSampler        -Dconf.path=bagging.properties work/balanced work/bagged
+
+echo "class counts before/after balancing:"
+awk -F, '{c[$8]++} END {for (k in c) print "  in  "k": "c[k]}' work/in/part-00000
+awk -F, '{c[$8]++} END {for (k in c) print "  out "k": "c[k]}' work/balanced/part-r-00000
+wc -l work/bagged/part-r-00000

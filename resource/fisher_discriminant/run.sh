@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Fisher discriminant: per-attribute decision boundary on the churn data
+set -euo pipefail
+cd "$(dirname "$0")"
+PY=${PYTHON:-python}
+rm -rf work && mkdir -p work
+
+$PY -m avenir_tpu.datagen telecom_churn 3000 --seed 29 --out work/in/part-00000
+$PY -m avenir_tpu FisherDiscriminant -Dconf.path=fisher.properties work/in work/out
+
+echo "attr, boundary, log-odds: work/out/part-r-00000"
+cat work/out/part-r-00000
