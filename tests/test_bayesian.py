@@ -165,3 +165,44 @@ def test_train_1dev_equals_8dev(churn_setup, mesh8, mesh1):
     l1 = open(os.path.join(out1, "part-r-00000")).read()
     l8 = open(os.path.join(out8, "part-r-00000")).read()
     assert l1 == l8
+
+
+def test_f32_scoring_mode_near_parity(tmp_path, mesh8):
+    """bp.score.precision=float32 (the log-space fast path) must agree with
+    the f64 path within +-1 on the int-scaled probabilities and produce the
+    same predictions on clear-margin data."""
+    from avenir_tpu.datagen import gen_telecom_churn
+
+    rows = gen_telecom_churn(600, seed=5)
+    train, test = rows[:450], rows[450:]
+    schema_path = tmp_path / "schema.json"
+    schema_path.write_text(json.dumps(SCHEMA))
+    write_output(str(tmp_path / "train"), [",".join(r) for r in train])
+    write_output(str(tmp_path / "test"), [",".join(r) for r in test])
+    BayesianDistribution(JobConfig({
+        "feature.schema.file.path": str(schema_path)})).run(
+        str(tmp_path / "train"), str(tmp_path / "model"))
+
+    outs = {}
+    for prec in ("float64", "float32"):
+        BayesianPredictor(JobConfig({
+            "feature.schema.file.path": str(schema_path),
+            "bayesian.model.file.path": str(tmp_path / "model"),
+            "bp.score.precision": prec})).run(
+            str(tmp_path / "test"), str(tmp_path / f"pred_{prec}"))
+        outs[prec] = [l.split(",") for l in open(
+            tmp_path / f"pred_{prec}" / "part-r-00000").read().splitlines()]
+
+    agree = 0
+    for a, b in zip(outs["float64"], outs["float32"]):
+        # ...,predictedClass,scaledProb
+        assert abs(int(a[-1]) - int(b[-1])) <= 1
+        agree += a[-2] == b[-2]
+    assert agree / len(outs["float64"]) > 0.97
+
+    with pytest.raises(ValueError, match="bp.score.precision"):
+        BayesianPredictor(JobConfig({
+            "feature.schema.file.path": str(schema_path),
+            "bayesian.model.file.path": str(tmp_path / "model"),
+            "bp.score.precision": "half"})).run(
+            str(tmp_path / "test"), str(tmp_path / "bad"))
