@@ -1,0 +1,11 @@
+#!/usr/bin/env python
+"""Sparse background events with a planted dense qualifying burst."""
+import numpy as np
+rng = np.random.default_rng(3)
+t = 0
+for i in range(60):
+    t += int(rng.integers(8, 14))
+    print(f"e{i},{int(rng.integers(5, 40))},{t}")     # non-qualifying
+for i in range(8):
+    t += int(rng.integers(1, 3))
+    print(f"b{i},{int(rng.integers(60, 95))},{t}")    # qualifying burst

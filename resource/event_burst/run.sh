@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Event-burst detection (positional clustering over a time window)
+set -euo pipefail
+cd "$(dirname "$0")"
+PY=${PYTHON:-python}
+rm -rf work && mkdir -p work/in
+
+$PY gen.py > work/in/part-00000
+$PY -m avenir_tpu SequencePositionalCluster -Dconf.path=cluster.properties work/in work/out
+
+echo "burst events (locality score above threshold):"
+cat work/out/part-r-00000

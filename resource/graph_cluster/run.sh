@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Graph clustering: pairwise distances -> greedy edge-weighted clusters
+set -euo pipefail
+cd "$(dirname "$0")"
+PY=${PYTHON:-python}
+rm -rf work && mkdir -p work/inp
+
+$PY -m avenir_tpu.datagen blobs 40 --seed 41 --out work/inp/all-00000
+
+$PY -m avenir_tpu SameTypeSimilarity      -Dconf.path=sim.properties     work/inp work/dist
+$PY -m avenir_tpu AgglomerativeGraphical  -Dconf.path=cluster.properties work/inp work/clusters
+
+echo "clusters (id,members...,avgWeight):"
+head -4 work/clusters/part-r-00000

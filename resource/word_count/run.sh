@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Word count over the planted-sentiment text fixture
+set -euo pipefail
+cd "$(dirname "$0")"
+PY=${PYTHON:-python}
+rm -rf work && mkdir -p work/in
+
+$PY -m avenir_tpu.datagen text_classified 500 --seed 17 --out work/all.csv
+cut -d, -f1 work/all.csv > work/in/part-00000   # text only, labels dropped
+$PY -m avenir_tpu WordCounter -Dconf.path=wc.properties work/in work/out
+
+echo "top words:"
+sort -t, -k2 -rn work/out/part-r-00000 | head -5

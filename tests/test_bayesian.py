@@ -206,3 +206,37 @@ def test_f32_scoring_mode_near_parity(tmp_path, mesh8):
             "bayesian.model.file.path": str(tmp_path / "model"),
             "bp.score.precision": "half"})).run(
             str(tmp_path / "test"), str(tmp_path / "bad"))
+
+
+def test_f32_scoring_unseen_bin_yields_zero(mesh8):
+    """A categorical bin unseen in training (zero posterior probability)
+    must score probability 0 on the f32 path exactly as the f64 product
+    does — the log-space clamp must not cancel it away."""
+    import jax.numpy as jnp
+    from avenir_tpu.models.bayesian import BayesianPredictor
+
+    n, F, C, B = 8, 3, 2, 4
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, B - 1, (n, F)).astype(np.int32)
+    x[0, 1] = B - 1                      # unseen bin for row 0
+    values = rng.uniform(0, 10, (n, F))
+    post = rng.uniform(0.1, 1.0, (C, F, B))
+    post[:, 1, B - 1] = 0.0              # never observed at train time
+    prior = rng.uniform(0.1, 1.0, (F, B))
+    prior[1, B - 1] = 0.0
+    gauss_post = np.stack([rng.uniform(5, 9, (C, F)),
+                           rng.uniform(1, 2, (C, F))], -1)
+    gauss_prior = np.stack([rng.uniform(5, 9, F),
+                            rng.uniform(1, 2, F)], -1)
+    class_prior = np.asarray([0.5, 0.5])
+    is_cont = np.zeros(F, bool)
+    args = tuple(map(jnp.asarray, (x, values, post, prior, gauss_post,
+                                   gauss_prior, class_prior, is_cont)))
+    p64, _, fp64 = BayesianPredictor._score_batch(*args)
+    p32, _, fp32 = BayesianPredictor._score_batch_f32(*args)
+    assert (np.asarray(p64)[0] == 0).all()
+    assert (np.asarray(p32)[0] == 0).all()
+    assert (np.asarray(fp32)[0] == 0).all()
+    # other rows stay within the ±1 contract
+    np.testing.assert_allclose(np.asarray(p32)[1:], np.asarray(p64)[1:],
+                               atol=1)
