@@ -570,12 +570,17 @@ class BayesianPredictor:
         # matching zero prior factor in log space
         post_zero = jnp.any((~is_cont)[None, None, :] & (post_pick <= 0),
                             axis=2)                               # [n, C]
+        prior_zero = jnp.any((~is_cont)[None, :] & (prior_pick <= 0),
+                             axis=1)                              # [n]
         probs = jnp.where(post_zero, 0, probs)
         # the auxiliary feature probabilities exponentiate in the widest
         # available dtype — tail products below ~1e-38 would flush to 0
-        # in f32, and these two outputs are emitted verbatim
+        # in f32 — and true-zero factors emit exact 0.0 like the f64
+        # products (both outputs are written verbatim in prob-only mode)
         wide = jnp.float64 if jax.config.jax_enable_x64 else f32
-        return (probs, jnp.exp(lfeat_prior.astype(wide)),
+        return (probs,
+                jnp.where(prior_zero, 0.0,
+                          jnp.exp(lfeat_prior.astype(wide))),
                 jnp.where(post_zero, 0.0,
                           jnp.exp(lfeat_post.astype(wide))))
 

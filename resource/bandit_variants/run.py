@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""The other three batch bandits over the price fixture: UCB1
+(AuerDeterministic), Boltzmann (SoftMaxBandit), and random-first-greedy
+(RandomFirstGreedyBandit) — same externally-scored round loop as the
+price_optimize runbook (resource/price_optimize_tutorial.txt:29-63)."""
+import os
+import shutil
+import numpy as np
+
+from avenir_tpu.cli import main as job
+from avenir_tpu.core import write_output
+from avenir_tpu.datagen import gen_price_rounds
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+os.chdir(HERE)
+
+n_prod, n_price, rounds = 10, 4, 30
+_, mean_profit, _ = gen_price_rounds(n_prod, n_price, seed=7)
+best = mean_profit.argmax(axis=1)
+
+for algo, extra in (
+        ("AuerDeterministic", []),
+        ("SoftMaxBandit", ["-Dtemp.constant=0.1"]),
+        ("RandomFirstGreedyBandit", [])):
+    shutil.rmtree("work", ignore_errors=True)
+    os.makedirs("work")
+    batch_line = "1,2" if algo == "RandomFirstGreedyBandit" else "1"
+    open("work/batch.txt", "w").write(
+        "\n".join(f"prod{p},{batch_line}" for p in range(n_prod)) + "\n")
+    rng = np.random.default_rng(0)
+    state = {(p, k): [0, 0] for p in range(n_prod) for k in range(n_price)}
+    for rnd in range(1, rounds + 1):
+        write_output("work/in", [f"prod{p},price{k},{c},{r}"
+                                 for (p, k), (c, r) in state.items()])
+        rc = job([algo, "-Dconf.path=grb.properties",
+                  f"-Dcurrent.round.num={rnd}", f"-Drandom.seed={rnd}"]
+                 + extra + ["work/in", "work/out"])
+        assert rc == 0
+        for line in open("work/out/part-r-00000"):
+            g, item = line.strip().split(",")
+            p, k = int(g[4:]), int(item[5:])
+            reward = int((1000 if k == best[p] else 400) + rng.normal(0, 50))
+            c, r = state[(p, k)]
+            state[(p, k)] = [c + 1, (c * r + reward) // (c + 1)]
+    hits = sum(1 for line in open("work/out/part-r-00000")
+               for g, item in [line.strip().split(",")]
+               if int(item[5:]) == best[int(g[4:])])
+    print(f"{algo}: final round selects the true best price for "
+          f"{hits}/{n_prod} products")

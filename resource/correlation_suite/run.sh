@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Correlation suite: numerical correlation, heterogeneity reduction, and
+# class-conditioned attribute moment stats over the churn fixture
+set -euo pipefail
+cd "$(dirname "$0")"
+PY=${PYTHON:-python}
+rm -rf work && mkdir -p work
+
+$PY -m avenir_tpu.datagen telecom_churn 3000 --seed 29 --out work/in/part-00000
+
+$PY -m avenir_tpu NumericalCorrelation              -Dconf.path=numerical.properties work/in work/num
+$PY -m avenir_tpu HeterogeneityReductionCorrelation -Dconf.path=hetero.properties    work/in work/het
+$PY -m avenir_tpu NumericalAttrStats                -Dconf.path=stats.properties     work/in work/stats
+
+echo "numerical correlations (a,b,r):"; cat work/num/part-r-00000
+echo "heterogeneity reduction:"; cat work/het/part-r-00000
+echo "per-class attr stats:"; head -3 work/stats/part-r-00000

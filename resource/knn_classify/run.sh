@@ -15,3 +15,16 @@ $PY -m avenir_tpu NearestNeighbor    -Dconf.path=knn.properties work/simi work/p
 
 echo "predictions (…,actual,predicted): work/pred/part-r-00000"
 head -n 5 work/pred/part-r-00000
+
+# class-conditional weighting leg (resource/knn.sh joinFeatureDistr):
+# NB feature posteriors on the training block join the distance rows
+mkdir -p work/train work/pprob
+cp work/inp/tr-00000 work/train/part-00000   # same split as the distance job
+$PY -m avenir_tpu BayesianDistribution   -Dconf.path=nb.properties     work/train work/nbmodel
+$PY -m avenir_tpu BayesianPredictor      -Dconf.path=nbprob.properties work/train work/probs
+cp work/probs/part-r-00000 work/pprob/prDistr-r-00000
+$PY -m avenir_tpu FeatureCondProbJoiner  -Dconf.path=join.properties   work/simi,work/pprob work/join
+$PY -m avenir_tpu NearestNeighbor        -Dconf.path=knnw.properties   work/join work/predw
+
+echo "class-conditionally weighted predictions: work/predw/part-r-00000"
+head -n 3 work/predw/part-r-00000

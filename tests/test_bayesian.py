@@ -232,11 +232,14 @@ def test_f32_scoring_unseen_bin_yields_zero(mesh8):
     is_cont = np.zeros(F, bool)
     args = tuple(map(jnp.asarray, (x, values, post, prior, gauss_post,
                                    gauss_prior, class_prior, is_cont)))
-    p64, _, fp64 = BayesianPredictor._score_batch(*args)
-    p32, _, fp32 = BayesianPredictor._score_batch_f32(*args)
+    p64, pr64, fp64 = BayesianPredictor._score_batch(*args)
+    p32, pr32, fp32 = BayesianPredictor._score_batch_f32(*args)
     assert (np.asarray(p64)[0] == 0).all()
     assert (np.asarray(p32)[0] == 0).all()
     assert (np.asarray(fp32)[0] == 0).all()
+    # prob-only outputs: true-zero prior factors emit exact 0.0 too
+    assert np.asarray(pr64)[0] == 0.0
+    assert np.asarray(pr32)[0] == 0.0
     # other rows stay within the ±1 contract
     np.testing.assert_allclose(np.asarray(p32)[1:], np.asarray(p64)[1:],
                                atol=1)
