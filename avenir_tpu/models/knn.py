@@ -117,6 +117,14 @@ class SameTypeSimilarity:
         include_class = self.config.get_boolean("include.class.attributes",
                                                 True)
         top_k = self.config.get_int("output.top.matches", None)
+        # 'exact' (default) reproduces the secondary-sort ordering
+        # bit-for-bit; 'approx' opts into lax.approx_min_k (~5x on huge
+        # candidate sets, recall ~0.98); validated here so a typo fails
+        # loudly even on dense-output runs where no selection happens
+        topk_method = self.config.get("topk.method", "exact")
+        if topk_method not in ("exact", "approx"):
+            raise ValueError(f"unknown top-k method {topk_method!r}; "
+                             "use 'exact' or 'approx'")
 
         train_recs: List[List[str]] = []
         test_recs: List[List[str]] = []
@@ -149,7 +157,8 @@ class SameTypeSimilarity:
         effective_k = (top_k + 1 if top_k and not inter_set else top_k)
         dist, idx = pairwise_distances(
             qnum, qcat, tnum, tcat, num_w, cat_w, algorithm=algorithm,
-            scale=scale, top_k=effective_k, mesh=mesh)
+            scale=scale, top_k=effective_k, mesh=mesh,
+            topk_method=topk_method)
 
         lines: List[str] = []
         for qi in range(len(test_recs)):

@@ -512,3 +512,33 @@ def test_pairwise_distances_2d_mesh_matches_1d(mesh8):
         uniq = np.isin(dref[r],
                        np.flatnonzero(np.bincount(full_ref[r]) == 1))
         np.testing.assert_array_equal(i2[r][uniq], iref[r][uniq])
+
+
+def test_same_type_similarity_topk_method_config(tmp_path, mesh8):
+    """topk.method=approx opts the distance job into approx_min_k; invalid
+    values fail loudly."""
+    train = _make_points(30, seed=5)
+    test = _make_points(4, seed=6)
+    os.makedirs(tmp_path / "inp")
+    (tmp_path / "inp" / "tr.txt").write_text(
+        "\n".join(",".join(r) for r in train) + "\n")
+    (tmp_path / "inp" / "te.txt").write_text(
+        "\n".join(",".join(r) for r in test) + "\n")
+    cfg = JobConfig({
+        "feature.schema.file.path": _write_schema(tmp_path),
+        "output.top.matches": "5",
+        "topk.method": "approx",
+    })
+    SameTypeSimilarity(cfg).run(str(tmp_path / "inp"),
+                                str(tmp_path / "simi"), mesh=mesh8)
+    lines = open(tmp_path / "simi" / "part-r-00000").read().splitlines()
+    assert len(lines) == 4 * 5
+
+    bad = JobConfig({
+        "feature.schema.file.path": _write_schema(tmp_path),
+        "output.top.matches": "5",
+        "topk.method": "sorta",
+    })
+    with pytest.raises(ValueError, match="top-k method"):
+        SameTypeSimilarity(bad).run(str(tmp_path / "inp"),
+                                    str(tmp_path / "simi2"), mesh=mesh8)
