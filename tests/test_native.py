@@ -169,3 +169,65 @@ def test_multithreaded_encode_bit_identical(tmp_path, have_native,
         assert enc_mt.vocabs[ordinal].values == enc_ref.vocabs[ordinal].values
     assert enc_mt.class_vocab.values == enc_ref.class_vocab.values
     assert ds_mt.ids == ds_ref.ids
+
+
+def test_fuzz_native_encode_parity(tmp_path, have_native, monkeypatch):
+    """Randomized CSV shapes (CRLF, empty lines, negative ints, float
+    formats, unseen-category churn) must either encode bit-identically to
+    the NumPy path or fall back (return None) — never diverge silently.
+    (Multi-part directories are covered by test_native_crlf_and_part_dirs.)
+    """
+    monkeypatch.setattr(native, "MT_MIN_BYTES", 1)
+    monkeypatch.setattr(native, "MT_THREADS", 4)
+    rng = np.random.default_rng(123)
+    for trial in range(15):
+        n = int(rng.integers(1, 120))
+        colors = [f"v{i}" for i in range(int(rng.integers(1, 9)))]
+        rows = []
+        for i in range(n):
+            rows.append([
+                f"id{i}",
+                colors[int(rng.integers(len(colors)))],
+                str(int(rng.integers(-100, 100))),
+                (f"{rng.uniform(-5, 5):.{int(rng.integers(0, 7))}f}"
+                 if rng.random() < 0.8 else
+                 f"{rng.uniform(-5, 5):.2e}"),
+                "Y" if rng.random() < 0.5 else "N",
+            ])
+        eol = "\r\n" if trial % 3 == 0 else "\n"
+        text = eol.join(",".join(r) for r in rows) + eol
+        if trial % 4 == 0 and eol == "\n":
+            # blank lines sprinkled in (skipped by both paths); with CRLF
+            # a blank would be a bare-\r line, which is correctly ragged
+            text = text.replace(eol, eol + eol, 2)
+        p = tmp_path / f"fuzz{trial}.csv"
+        p.write_text(text)
+
+        enc_n = DatasetEncoder(SCHEMA)
+        ds_n = enc_n._encode_path_native(str(p), ",")
+        enc_p = DatasetEncoder(SCHEMA)
+        ds_p = enc_p.encode([list(r) for r in rows])
+        assert ds_n is not None, f"trial {trial}: unexpected fallback"
+        np.testing.assert_array_equal(ds_n.x, ds_p.x, err_msg=f"t{trial}")
+        np.testing.assert_array_equal(ds_n.y, ds_p.y, err_msg=f"t{trial}")
+        np.testing.assert_allclose(ds_n.values, ds_p.values,
+                                   err_msg=f"t{trial}")
+        for o in enc_p.vocabs:
+            assert enc_n.vocabs[o].values == enc_p.vocabs[o].values, trial
+
+    # ragged rows and junk numerics must fall back, not crash or mis-parse
+    # (a uniformly-wider file is VALID — trailing columns are ignored by
+    # ordinal, exactly like the reference's mappers and the NumPy path)
+    for bad in ("a,red,1,1.0\n", "a,red,xx,1.0,N\n", "a,red,1,zz,N\n",
+                "a,red,1,1.0,N,extra\nb,red,1,1.0,N\n"):
+        p = tmp_path / "bad.csv"
+        p.write_text(bad)
+        assert DatasetEncoder(SCHEMA)._encode_path_native(str(p), ",") is None
+    wide = tmp_path / "wide.csv"
+    wide.write_text("a,red,1,1.0,N,extra\nb,green,2,2.0,Y,extra\n")
+    ds_w = DatasetEncoder(SCHEMA)._encode_path_native(str(wide), ",")
+    ds_ref = DatasetEncoder(SCHEMA).encode(
+        [["a", "red", "1", "1.0", "N", "extra"],
+         ["b", "green", "2", "2.0", "Y", "extra"]])
+    np.testing.assert_array_equal(ds_w.x, ds_ref.x)
+    np.testing.assert_array_equal(ds_w.y, ds_ref.y)
