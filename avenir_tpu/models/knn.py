@@ -117,14 +117,18 @@ class SameTypeSimilarity:
         include_class = self.config.get_boolean("include.class.attributes",
                                                 True)
         top_k = self.config.get_int("output.top.matches", None)
-        # 'exact' (default) reproduces the secondary-sort ordering
-        # bit-for-bit; 'approx' opts into lax.approx_min_k (~5x on huge
-        # candidate sets, recall ~0.98); validated here so a typo fails
-        # loudly even on dense-output runs where no selection happens
+        # 'exact' (default) reproduces the secondary-sort ordering and
+        # auto-selects the fused Pallas engine on TPU, where the two
+        # exact engines may differ by +/-1 int unit on ~1e-3 of rows
+        # (MXU rounding at the int-scale boundary; see
+        # ops.distance.pairwise_distances); 'fused'/'sorted' force one
+        # engine, 'approx' opts into lax.approx_min_k (recall ~0.98);
+        # validated here so a typo fails loudly even on dense-output
+        # runs where no selection runs
         topk_method = self.config.get("topk.method", "exact")
-        if topk_method not in ("exact", "approx"):
+        if topk_method not in ("exact", "fused", "sorted", "approx"):
             raise ValueError(f"unknown top-k method {topk_method!r}; "
-                             "use 'exact' or 'approx'")
+                             "use 'exact', 'fused', 'sorted' or 'approx'")
 
         train_recs: List[List[str]] = []
         test_recs: List[List[str]] = []

@@ -227,15 +227,60 @@ def pairwise_distances(qnum: np.ndarray, qcat: np.ndarray,
     ``(dist[nq, k], index[nq, k])`` nearest training rows (ascending) — the
     TPU re-expression of the reference's secondary-sort top-K
     (NearestNeighbor.java:80-81 -> lax.top_k, SURVEY §2.2).
+
+    ``topk_method``: ``'exact'`` (default) auto-selects the fused Pallas
+    engine (ops.pallas_topk — MXU tiles + binned running minima, never
+    materializing the [nq, nt] block; exact incl. lowest-index tie order,
+    with a sound overflow check falling back per-row to the sort path)
+    when applicable, else the sort-based selection.  ``'fused'`` /
+    ``'sorted'`` force one engine; ``'approx'`` opts into
+    ``lax.approx_min_k``.  On TPU the two exact engines may differ by
+    ±1 int unit on a ~1e-3 fraction of rows (MXU one-pass rounding of
+    the cross-term lands on different sides of the int-scale boundary);
+    on CPU both are bit-identical to the NumPy oracle.
     """
     mesh = mesh or get_mesh()
     d = mesh.shape["data"]
     m_ax = mesh.shape["model"]
     nq = qnum.shape[0]
     nt = tnum.shape[0]
+    qnum0, qcat0 = qnum, qcat
     # fold weights into the numeric columns so the matmul needs no extra pass
     qnum, tnum, wsum = _fold_weights(qnum, tnum, num_weights, cat_weights,
                                      algorithm)
+
+    k0 = min(top_k, nt) if top_k else None
+    if k0 is not None and m_ax == 1 and topk_method in ("exact", "fused"):
+        from .pallas_topk import (fused_pairwise_topk, fused_topk_applicable,
+                                  fused_topk_supported)
+        n_num, n_cat = qnum.shape[1], qcat.shape[1]
+        if topk_method == "fused" and not fused_topk_supported(
+                algorithm, k0, nt, n_num, n_cat, scale):
+            raise ValueError("fused top-k not supported for this shape; "
+                             "use topk_method='exact'")
+        if topk_method == "fused" or fused_topk_applicable(
+                algorithm, k0, nq, nt, n_num, n_cat, scale):
+            vals, idxs, suspect = fused_pairwise_topk(
+                qnum, qcat, tnum, tcat, cat_weights, wsum, scale, k0,
+                mesh=mesh)
+            bad = np.flatnonzero(suspect)
+            if bad.size:
+                vals = np.array(vals)
+                idxs = np.array(idxs)
+                # bin-overflow rows: exact re-resolve via the sort-based
+                # engine (the fused kernel's soundness check guarantees
+                # every possibly-affected row is in `bad`)
+                vb, ib = pairwise_distances(
+                    qnum0[bad], qcat0[bad], tnum, tcat, num_weights,
+                    cat_weights, algorithm=algorithm, scale=scale,
+                    top_k=k0, mesh=mesh, topk_method="sorted")
+                vals[bad], idxs[bad] = vb, ib
+            return vals, idxs
+    if topk_method == "fused":
+        raise ValueError("topk_method='fused' requires top_k on a "
+                         "1-D (model=1) mesh")
+    if topk_method == "sorted":
+        topk_method = "exact"
 
     qnum_p, _ = pad_rows(qnum, d)
     qcat_p, _ = pad_rows(qcat, d)

@@ -1,0 +1,299 @@
+"""Fused Pallas distance + exact top-k: the compute-bound kNN engine.
+
+The broadcast engine in ``ops.distance`` materializes the full
+``[nq, nt]`` int32 distance block in HBM (~1 GB at 16k x 16k) and then
+runs a sort-based ``lax.top_k`` over it; measured on one v5e chip the
+sort alone costs 40-80 ms while the distance matmul takes 1.8 ms -- the
+engine ran at 1.2% of bf16 peak, entirely selection-bound (BENCH_r02).
+This module replaces that path for the euclidean case with a single
+Pallas kernel that never leaves VMEM (the TPU re-expression of
+sifarish ``SameTypeSimilarity`` + the reference's secondary-sort top-K,
+NearestNeighbor.java:80-81, resource/knn.sh:46-59):
+
+1. **Fused tile pass** (grid over [QB query x TB candidate] tiles): the
+   cross-term runs on the MXU, the |a-b|^2 expansion + sqrt + int scale
+   on the VPU, and each tile folds straight into a per-row *binned
+   running-minima* structure in VMEM scratch -- ``L`` bins per query row
+   (bin = candidate index mod L), each bin keeping its ``R`` smallest
+   (value, index) pairs in sorted registers.  Strict ``<`` insertion
+   keeps the earliest-seen element at equal value, and tiles arrive in
+   ascending global index order, so ties preserve lowest-index-first
+   order exactly.  The VPU register update overlaps the next tile's MXU
+   pass, so selection is nearly free; the [nq, nt] block never exists.
+2. **Narrow exact top-k**: the ``R*L`` candidates per row are packed as
+   ``(value << idx_bits) | index`` into one int32 so a single-operand
+   ``lax.top_k`` yields ascending (value, index) lexicographic order --
+   bit-identical tie semantics to ``topk_smallest``.
+3. **Soundness check (free)**: a true top-k element can only be lost if
+   more than ``R`` of the true top-k share one bin -- in that case every
+   register of that bin holds a value <= theta (the selected k-th
+   value).  So ``any(bottom_register < theta or (== theta and its index
+   <= max selected tie index))`` flags *every* possible loss.  Expected
+   flag rate is data-independent ~ L*(k/L)^(R+1)/(R+1)! per row (~1e-3
+   at k=16, L=128, R=4) plus rows whose theta tie-group is dense;
+   flagged rows are re-run through the sort-based engine by the caller,
+   so results are exact on ALL inputs -- adversarial index layouts only
+   cost speed, never correctness.
+
+Measured (v5e, 16384 x 16384 x 256 f32, k=16, dispatch-amortized):
+kernel 3.4 ms + packed top-k ~1.5 ms ~= 12-15% of bf16 peak vs 1.2%
+for the sort-based engine, with 0 flagged rows on the bench workload.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import get_mesh, pad_rows
+
+_QB = 256          # query rows per tile
+_TB = 512          # candidate rows per tile
+_L = 128           # bins per query row (candidate index mod L)
+_R = 4             # registers (running smallest) per bin
+_MAX_K = 64
+_MAX_F = 1024
+_MAX_CAT = 16
+_MAX_NT = 1 << 18  # idx fits 18 bits -> value budget 2^13 > any sane scale
+
+_SENT = np.int32(np.iinfo(np.int32).max)
+
+_fused_cache: dict = {}
+
+
+def fused_topk_supported(algorithm: str, k: int, nt: int,
+                         n_num: int, n_cat: int, scale: int) -> bool:
+    """Hard constraints of the fused engine: euclidean (the MXU
+    expansion), shapes inside the kernel's VMEM budget, and a packing
+    budget that keeps the (value, index) pair inside one int32."""
+    idx_bits = max(int(np.ceil(np.log2(max(nt, 2)))), 1)
+    val_budget = 1 << (31 - idx_bits)
+    return (algorithm == "euclidean"
+            and 0 < k <= _MAX_K
+            and nt <= _MAX_NT
+            and n_num + n_cat > 0
+            and n_num <= _MAX_F
+            and n_cat <= _MAX_CAT
+            and scale * 8 <= val_budget)
+
+
+def fused_topk_applicable(algorithm: str, k: int, nq: int, nt: int,
+                          n_num: int, n_cat: int, scale: int,
+                          backend: Optional[str] = None) -> bool:
+    """Auto-selection gate: hard constraints plus the heuristics that
+    make the fused path the win (a TPU backend and a candidate axis wide
+    enough that sort-based selection is the bottleneck)."""
+    backend = backend or jax.default_backend()
+    return (backend == "tpu"
+            and nt >= 4 * _TB
+            and fused_topk_supported(algorithm, k, nt, n_num, n_cat,
+                                     scale))
+
+
+def _make_kernel(F: int, Ccat: int, cat_w: tuple, wsum: float, scale: int,
+                 nt_true: int, nj: int):
+    """Tile kernel: distance block on MXU/VPU + binned register insert."""
+
+    def kernel(*refs):
+        # inputs are packed [qn, tn]? [qc, tc]? depending on F/Ccat so
+        # Mosaic never sees an unused dummy block
+        pos = 0
+        qn_ref = tn_ref = qc_ref = tc_ref = None
+        if F:
+            qn_ref, tn_ref = refs[0], refs[1]
+            pos = 2
+        if Ccat:
+            qc_ref, tc_ref = refs[pos], refs[pos + 1]
+            pos += 2
+        valout_ref, idxout_ref, binv, bini = refs[pos:pos + 4]
+        j = pl.program_id(1)
+
+        @pl.when(j == 0)
+        def _init():
+            binv[:] = jnp.full_like(binv, _SENT)
+            bini[:] = jnp.full_like(bini, -1)
+
+        # arithmetic mirrors _block_dist exactly (numeric part + one
+        # summed categorical part, then a true divide by wsum) so the
+        # two exact engines agree bit-for-bit under identical backends
+        parts = None
+        if F:
+            qt = qn_ref[:]                          # [QB, F]
+            tt = tn_ref[:]                          # [TB, F]
+            cross = jax.lax.dot_general(
+                qt, tt, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [QB, TB]
+            q2 = jnp.sum(qt * qt, axis=1, keepdims=True)
+            t2 = jnp.sum(tt * tt, axis=1, keepdims=True).T
+            parts = jnp.maximum(q2 + t2 - 2.0 * cross, 0.0)
+        cat_acc = None
+        for c in range(Ccat):
+            mism = (qc_ref[:, c:c + 1] != tc_ref[:, c:c + 1].T)
+            term = mism.astype(jnp.float32) * cat_w[c]
+            cat_acc = term if cat_acc is None else cat_acc + term
+        if cat_acc is not None:
+            parts = cat_acc if parts is None else parts + cat_acc
+        d = jnp.sqrt(parts / wsum)
+        di = (d * scale).astype(jnp.int32)           # [QB, TB]
+
+        base = j * _TB
+        for s in range(_TB // _L):
+            g = jnp.broadcast_to(
+                base + s * _L
+                + jax.lax.broadcasted_iota(jnp.int32, (1, _L), 1),
+                (di.shape[0], _L))
+            v = jnp.where(g < nt_true,
+                          di[:, s * _L:(s + 1) * _L], _SENT)
+            regs_v = [binv[:, r * _L:(r + 1) * _L] for r in range(_R)]
+            regs_i = [bini[:, r * _L:(r + 1) * _L] for r in range(_R)]
+            lt = [v < rv for rv in regs_v]
+            # sorted-insert: strict < keeps the earlier (lower-index)
+            # element on equal values; tiles arrive in index order
+            for r in range(_R - 1, 0, -1):
+                binv[:, r * _L:(r + 1) * _L] = jnp.where(
+                    lt[r - 1], regs_v[r - 1], jnp.where(lt[r], v, regs_v[r]))
+                bini[:, r * _L:(r + 1) * _L] = jnp.where(
+                    lt[r - 1], regs_i[r - 1], jnp.where(lt[r], g, regs_i[r]))
+            binv[:, 0:_L] = jnp.where(lt[0], v, regs_v[0])
+            bini[:, 0:_L] = jnp.where(lt[0], g, regs_i[0])
+
+        @pl.when(j == nj - 1)
+        def _out():
+            valout_ref[:] = binv[:]
+            idxout_ref[:] = bini[:]
+
+    return kernel
+
+
+def _build_fused(mesh, nq_pad: int, nt_pad: int, F: int, Ccat: int,
+                 cat_w: tuple, wsum: float, scale: int, k: int,
+                 nt_true: int, interpret: bool):
+    d_ax = mesh.shape["data"]
+    nq_loc = nq_pad // d_ax
+    ni, nj = nq_loc // _QB, nt_pad // _TB
+    idx_bits = max(int(np.ceil(np.log2(max(nt_pad, 2)))), 1)
+    val_max = np.int32(1 << (31 - idx_bits))
+    idx_mask = np.int32((1 << idx_bits) - 1)
+    kernel = _make_kernel(F, Ccat, cat_w, wsum, scale, nt_true, nj)
+
+    def local(qn, qc, tn, tc):
+        out_sds = [jax.ShapeDtypeStruct((nq_loc, _R * _L), jnp.int32)] * 2
+        in_specs, args = [], []
+        if F:
+            in_specs += [pl.BlockSpec((_QB, F), lambda i, j: (i, 0),
+                                      memory_space=pltpu.VMEM),
+                         pl.BlockSpec((_TB, F), lambda i, j: (j, 0),
+                                      memory_space=pltpu.VMEM)]
+            args += [qn, tn]
+        if Ccat:
+            in_specs += [pl.BlockSpec((_QB, Ccat), lambda i, j: (i, 0),
+                                      memory_space=pltpu.VMEM),
+                         pl.BlockSpec((_TB, Ccat), lambda i, j: (j, 0),
+                                      memory_space=pltpu.VMEM)]
+            args += [qc, tc]
+        with jax.enable_x64(False):
+            vals, idxs = pl.pallas_call(
+                kernel,
+                grid=(ni, nj),
+                in_specs=in_specs,
+                out_specs=[
+                    pl.BlockSpec((_QB, _R * _L), lambda i, j: (i, 0),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((_QB, _R * _L), lambda i, j: (i, 0),
+                                 memory_space=pltpu.VMEM),
+                ],
+                out_shape=out_sds,
+                scratch_shapes=[pltpu.VMEM((_QB, _R * _L), jnp.int32),
+                                pltpu.VMEM((_QB, _R * _L), jnp.int32)],
+                interpret=interpret,
+            )(*args)
+
+            # stage 2: pack (value, index) into one int32 so a single
+            # top_k gives ascending lexicographic (value, index) order
+            packed = jnp.where((idxs >= 0) & (vals < val_max),
+                               (vals << idx_bits) | idxs, _SENT)
+            neg, _ = jax.lax.top_k(-packed, k)
+            sel = -neg                                   # [nq_loc, k]
+            sel_v = jnp.where(sel == _SENT, _SENT, sel >> idx_bits)
+            sel_i = jnp.where(sel == _SENT, -1, sel & idx_mask)
+
+            # soundness check: a lost top-k element forces some bin's
+            # bottom register <= theta (see module docstring)
+            theta = sel_v[:, k - 1:k]
+            tie_sel = jnp.where(sel_v == theta, sel_i, -1)
+            imax = jnp.max(tie_sel, axis=1, keepdims=True)
+            bot_v = vals[:, (_R - 1) * _L:]
+            bot_i = idxs[:, (_R - 1) * _L:]
+            lost = (bot_v < theta) | ((bot_v == theta) & (bot_i <= imax)
+                                      & (bot_i >= 0))
+            suspect = (jnp.any(lost, axis=1)
+                       | (sel_v[:, k - 1] == _SENT))
+            return sel_v, sel_i, suspect
+
+    # check_vma off: the interpret-mode Pallas body mixes shard-varying
+    # tile data with unvarying iota/scratch and trips the static vma
+    # checker; there are no collectives here and out_specs are explicit
+    return jax.jit(shard_map(
+        local, mesh=mesh,
+        in_specs=(P("data"), P("data"), P(), P()),
+        out_specs=(P("data"), P("data"), P("data")),
+        check_vma=False))
+
+
+def fused_pairwise_topk(qnum: np.ndarray, qcat: np.ndarray,
+                        tnum: np.ndarray, tcat: np.ndarray,
+                        cat_weights: np.ndarray, wsum: float,
+                        scale: int, k: int, mesh=None,
+                        interpret: Optional[bool] = None
+                        ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Exact per-query k smallest (value, index) via the fused kernel.
+
+    Inputs follow ``ops.distance`` conventions: numeric columns already
+    weight-folded (sqrt(w) pre-multiplied), categorical int32 codes with
+    per-column ``cat_weights``.  Returns host arrays
+    ``(dist[nq, k], idx[nq, k], suspect[nq])``; rows with ``suspect``
+    True MUST be re-resolved by the caller through the sort-based
+    engine (``ops.distance`` does this) -- they are the rare
+    bin-overflow cases the soundness check flags.
+    """
+    mesh = mesh or get_mesh()
+    d_ax = mesh.shape["data"]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    nq, nt = qnum.shape[0], tnum.shape[0]
+    F, Ccat = qnum.shape[1], qcat.shape[1]
+
+    qnum_p, _ = pad_rows(qnum.astype(np.float32), d_ax * _QB)
+    qcat_p, _ = pad_rows(qcat.astype(np.int32), d_ax * _QB)
+    tnum_p, _ = pad_rows(tnum.astype(np.float32), _TB)
+    # pad categorical codes with -2: != any query code (missing is -1),
+    # but candidate padding is masked by global index in-kernel anyway
+    tcat_p, _ = pad_rows(tcat.astype(np.int32), _TB, fill=-2)
+    if F == 0:
+        qnum_p = np.zeros((qnum_p.shape[0], 1), np.float32)
+        tnum_p = np.zeros((tnum_p.shape[0], 1), np.float32)
+    if Ccat == 0:
+        qcat_p = np.zeros((qcat_p.shape[0], 1), np.int32)
+        tcat_p = np.zeros((tcat_p.shape[0], 1), np.int32)
+
+    key = (mesh, qnum_p.shape, qcat_p.shape, tnum_p.shape, tcat_p.shape,
+           F, Ccat, tuple(np.asarray(cat_weights, np.float32)),
+           float(wsum), int(scale), int(k), nt, interpret)
+    fn = _fused_cache.get(key)
+    if fn is None:
+        fn = _build_fused(mesh, qnum_p.shape[0], tnum_p.shape[0], F, Ccat,
+                          tuple(float(w) for w in
+                                np.asarray(cat_weights, np.float32)),
+                          float(wsum), int(scale), int(k), nt, interpret)
+        _fused_cache[key] = fn
+
+    vals, idxs, suspect = fn(qnum_p, qcat_p, tnum_p, tcat_p)
+    return (np.asarray(vals)[:nq], np.asarray(idxs)[:nq],
+            np.asarray(suspect)[:nq])

@@ -1,0 +1,109 @@
+"""Fused Pallas distance+top-k engine (ops.pallas_topk): exactness vs the
+sort-based engine in interpret mode, tie order, the bin-overflow soundness
+check + fallback, and the selection gates.
+
+The fused engine replaces the HBM-materialized [nq, nt] block + sort
+selection (the 1.2% MFU path flagged in VERDICT r2) with a VMEM-tiled
+MXU pass and a binned running-minima reduce; these tests pin its contract
+to the sort-based engine bit-for-bit on the CPU mesh (interpret mode is
+plain XLA arithmetic, so results are deterministic and oracle-exact).
+"""
+
+import numpy as np
+import pytest
+
+from avenir_tpu.ops import pallas_topk
+from avenir_tpu.ops.distance import pairwise_distances
+
+
+def _rand(nq, nt, F, C, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.uniform(0, 1, (nq, F)).astype(np.float32),
+            rng.integers(0, 4, (nq, C)).astype(np.int32),
+            rng.uniform(0, 1, (nt, F)).astype(np.float32),
+            rng.integers(0, 4, (nt, C)).astype(np.int32),
+            rng.uniform(0.5, 2.0, F),
+            rng.uniform(0.5, 2.0, C))
+
+
+def _both(mesh, *args, **kw):
+    vr, ir = pairwise_distances(*args, mesh=mesh, topk_method="sorted", **kw)
+    vf, if_ = pairwise_distances(*args, mesh=mesh, topk_method="fused", **kw)
+    np.testing.assert_array_equal(vr, vf)
+    np.testing.assert_array_equal(ir, if_)
+    return vr, ir
+
+
+def test_fused_matches_sorted_mixed_mesh8(mesh8):
+    qn, qc, tn, tc, nw, cw = _rand(333, 1111, 7, 3)
+    _both(mesh8, qn, qc, tn, tc, nw, cw, top_k=9)
+
+
+def test_fused_matches_sorted_single_device(mesh1):
+    qn, qc, tn, tc, nw, cw = _rand(64, 700, 5, 2, seed=3)
+    _both(mesh1, qn, qc, tn, tc, nw, cw, top_k=5)
+
+
+def test_fused_tie_order_lowest_index_first(mesh8):
+    # duplicated training rows -> large equal-distance groups; the packed
+    # (value << bits | index) selection must keep lowest-index-first order
+    qn, qc, tn, tc, nw, cw = _rand(50, 200, 4, 2, seed=1)
+    tn2, tc2 = np.repeat(tn, 6, axis=0), np.repeat(tc, 6, axis=0)
+    v, i = _both(mesh8, qn, qc, tn2, tc2, nw, cw, top_k=8)
+    assert (np.diff(v, axis=1) >= 0).all()
+
+
+def test_fused_pure_categorical(mesh8):
+    _, qc, _, tc, _, cw = _rand(64, 2048, 0, 4, seed=2)
+    e = np.zeros((64, 0), np.float32)
+    et = np.zeros((2048, 0), np.float32)
+    _both(mesh8, e, qc, et, tc, np.zeros(0), cw, top_k=5)
+
+
+def test_fused_adversarial_overflow_falls_back(mesh1):
+    """>R true-top-k elements in one bin (stride-L nearest neighbors):
+    the soundness check must flag every row and the public API must
+    still return the exact sorted-engine answer via the fallback."""
+    L = pallas_topk._L
+    nt = 4096
+    tn = np.ones((nt, 2), np.float32)
+    tn[np.arange(0, nt, L)[:12]] = 0.0      # 12 > R=4 land in bin 0
+    qn = np.zeros((16, 2), np.float32)
+    ecat = np.zeros((16, 0), np.int32)
+    ecat_t = np.zeros((nt, 0), np.int32)
+    w2, cw0 = np.ones(2), np.zeros(0)
+    _both(mesh1, qn, ecat, tn, ecat_t, w2, cw0, top_k=8)
+    _, _, suspect = pallas_topk.fused_pairwise_topk(
+        qn, ecat, tn, ecat_t, cw0, 2.0, 1000, 8, mesh=mesh1)
+    assert suspect.all()
+
+
+def test_fused_benign_data_no_fallback(mesh1):
+    """On spread-out data the soundness check should almost never fire
+    (the fast path must actually be the fast path)."""
+    qn, qc, tn, tc, nw, cw = _rand(128, 4096, 6, 0, seed=4)
+    from avenir_tpu.ops.distance import _fold_weights
+    qf, tf, wsum = _fold_weights(qn, tn, nw, cw, "euclidean")
+    _, _, suspect = pallas_topk.fused_pairwise_topk(
+        qf, qc, tf, tc, cw, wsum, 1000, 8, mesh=mesh1)
+    assert suspect.sum() <= 2
+
+
+def test_fused_gates():
+    sup = pallas_topk.fused_topk_supported
+    assert sup("euclidean", 16, 16384, 8, 2, 1000)
+    assert not sup("manhattan", 16, 16384, 8, 2, 1000)
+    assert not sup("euclidean", 128, 16384, 8, 2, 1000)     # k > max
+    assert not sup("euclidean", 16, 1 << 20, 8, 2, 1000)    # nt too big
+    assert not sup("euclidean", 16, 16384, 0, 0, 1000)      # no columns
+    assert not sup("euclidean", 16, 1 << 18, 8, 2, 10_000)  # packing budget
+    # auto gate requires a TPU backend
+    assert not pallas_topk.fused_topk_applicable(
+        "euclidean", 16, 1024, 16384, 8, 2, 1000, backend="cpu")
+
+
+def test_fused_forced_unsupported_raises(mesh1):
+    qn, qc, tn, tc, nw, cw = _rand(16, 128, 3, 0, seed=5)
+    with pytest.raises(ValueError):
+        pairwise_distances(qn, qc, tn, tc, nw, cw, top_k=4, mesh=mesh1,
+                           algorithm="manhattan", topk_method="fused")
