@@ -48,6 +48,12 @@ JOBS: Dict[str, tuple] = {
     "org.avenir.explore.UnderSamplingBalancer": ("sampler", "UnderSamplingBalancer", ""),
     "org.avenir.discriminant.FisherDiscriminant": ("discriminant", "FisherDiscriminant", ""),
     "org.chombo.mr.NumericalAttrStats": ("discriminant", "NumericalAttrStats", ""),
+    # external chombo legs invoked between avenir jobs in reference
+    # runbooks (fit.sh:30-41, cust_churn_markov_chain tutorial:26-37,
+    # price_optimize_tutorial.txt:41-62)
+    "org.chombo.mr.TemporalFilter": ("chombo", "TemporalFilter", "tef"),
+    "org.chombo.mr.Projection": ("chombo", "Projection", ""),
+    "org.chombo.mr.RunningAggregator": ("chombo", "RunningAggregator", ""),
     "org.avenir.explore.ClassPartitionGenerator": ("tree", "ClassPartitionGenerator", ""),
     "org.avenir.tree.SplitGenerator": ("tree", "SplitGenerator", ""),
     "org.avenir.tree.DecisionTreeBuilder": ("tree", "DecisionTreeBuilder", "dtb"),

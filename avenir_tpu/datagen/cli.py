@@ -68,6 +68,14 @@ def _transactions(n_trans: int, n_items: int, seed: int = 42):
                               planted_support=0.5, seed=seed)
 
 
+def _timed_transactions(n_trans: int, n_items: int, seed: int = 42):
+    """Transactions with an epoch timestamp at field 1 — the raw format
+    the fit.sh pipeline feeds through org.chombo.mr.TemporalFilter."""
+    return g.gen_transactions(n_trans, n_items, planted=((3, 7, 11),),
+                              planted_support=0.5, with_time=True,
+                              seed=seed)
+
+
 def _visit_history(n: int, seed: int = 42):
     return g.gen_visit_history(n, conv_rate=50, label=True, seed=seed)
 
@@ -76,6 +84,7 @@ def _visit_history(n: int, seed: int = 42):
 PRESETS: Dict[str, tuple] = {
     "telecom_churn": (g.gen_telecom_churn, 1),
     "transactions": (_transactions, 2),
+    "timed_transactions": (_timed_transactions, 2),
     "churn_state_seqs": (_churn_state_seqs, 1),
     "hmm_seqs": (_hmm_seqs, 1),
     "hmm_obs": (_hmm_obs, 1),

@@ -81,10 +81,15 @@ def gen_transactions(n_trans: int, n_items: int,
                      planted: Sequence[Sequence[int]] = ((3, 7, 11),),
                      planted_support: float = 0.2,
                      items_per_trans: Tuple[int, int] = (4, 10),
+                     with_time: bool = False,
+                     time_range: Tuple[int, int] = (1446336000, 1447545600),
                      seed: int = 42) -> List[List[str]]:
     """Market-basket transactions with planted frequent itemsets per
     resource/freq_items.py / freq_items_apriori_tutorial.txt:19-24.
-    Row = transId, itemId, itemId, ...  (items as string ids)."""
+    Row = transId, itemId, itemId, ...  (items as string ids); with
+    ``with_time`` an epoch-second timestamp is inserted at field 1 —
+    the raw format fit.sh feeds through org.chombo.mr.TemporalFilter
+    (tef.time.stamp.field.ordinal=1, resource/fit.properties:10)."""
     rng = np.random.default_rng(seed)
     rows = []
     for t in range(n_trans):
@@ -93,7 +98,10 @@ def gen_transactions(n_trans: int, n_items: int,
         for pset in planted:
             if rng.random() < planted_support:
                 items.update(pset)
-        rows.append([f"T{t:06d}"] + [f"I{i:05d}" for i in sorted(items)])
+        row = [f"T{t:06d}"] + [f"I{i:05d}" for i in sorted(items)]
+        if with_time:
+            row.insert(1, str(int(rng.integers(*time_range))))
+        rows.append(row)
     return rows
 
 

@@ -223,18 +223,55 @@ def xactions_to_state_seqs(rows) -> List[List[str]]:
     return out
 
 
+def projected_to_histories(rows) -> Dict[str, list]:
+    """Parse compact chombo-Projection output rows
+    ``custID,date1,amt1,date2,amt2,...`` (projection.field=2,3 +
+    format.compact=true per resource/buyhist.properties:6-11, already
+    time-ordered by the projection) into per-customer (date, amount)
+    histories — the same shape ``_group_xactions`` builds from raw rows."""
+    import datetime
+
+    return {items[0]: [(datetime.date.fromisoformat(items[i]),
+                        int(items[i + 1]))
+                       for i in range(1, len(items) - 1, 2)]
+            for items in rows}
+
+
+def projected_to_state_seqs(rows) -> List[List[str]]:
+    """resource/xaction_seq.rb equivalent for the chombo Projection leg
+    (cust_churn_markov_chain tutorial:26-45): compact projected rows ->
+    one ``custID,state,state,...`` row per customer with >= 2
+    transactions."""
+    out = []
+    for cid, hist in projected_to_histories(rows).items():
+        seq = [_pair_state(*hist[i - 1], *hist[i])
+               for i in range(1, len(hist))]
+        if seq:
+            out.append([cid] + seq)
+    return out
+
+
 def marketing_next_dates(rows, model: "MarkovModel") -> List[str]:
+    """resource/mark_plan.rb:39-92 equivalent over raw transaction rows."""
+    return marketing_next_dates_from_histories(_group_xactions(rows), model)
+
+
+def marketing_next_dates_from_histories(histories: Dict[str, list],
+                                        model: "MarkovModel") -> List[str]:
     """resource/mark_plan.rb:39-92 equivalent: per customer, map the last
     observed transaction state through the trained (non-class) transition
     matrix, take the most likely next state, and schedule the next
     marketing contact 15/45/90 days after the last transaction depending on
-    the predicted gap letter.  Emits ``custID,ISO-date`` lines."""
+    the predicted gap letter.  Emits ``custID,ISO-date`` lines.  Histories
+    are per-customer time-ordered (date, amount) lists — from
+    ``_group_xactions`` (raw rows) or ``projected_to_histories``
+    (Projection-job output)."""
     import datetime
 
     trans = model.trans
     assert trans is not None, "marketing plan needs a non-class-based model"
     out = []
-    for cid, hist in _group_xactions(rows).items():
+    for cid, hist in histories.items():
         if len(hist) < 2:
             continue
         last_state = _pair_state(*hist[-2], *hist[-1])

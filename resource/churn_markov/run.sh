@@ -1,12 +1,28 @@
 #!/usr/bin/env bash
 # Customer-churn Markov-chain classifier
-# (reference runbook: resource/cust_churn_markov_chain_classifier_tutorial.txt)
+# (reference runbook: resource/cust_churn_markov_chain_classifier_tutorial.txt;
+# the tutorial's org.chombo.mr.Projection legs (:26-37, :79-90) order raw
+# per-event records into per-customer sequences before training/classifying)
 set -euo pipefail
 cd "$(dirname "$0")"
 PY=${PYTHON:-python}
 rm -rf work && mkdir -p work/train work/test
 
 $PY -m avenir_tpu.datagen churn_state_seqs 800 --seed 31 --out work/all.csv
+
+# Projection leg: the tutorial's raw input is one event per row in no
+# particular order; explode the sequences to (cust, label, eventIdx,
+# state) rows, shuffle, and let the Projection job reassemble them —
+# its compact group-and-order output must reproduce the sequences
+mkdir -p work/events
+awk -F, '{for (i = 3; i <= NF; i++) print $1","$2","(i-3)","$i}' work/all.csv \
+  | sort -R --random-source=<(yes 2024) > work/events/part-00000
+$PY -m avenir_tpu Projection -Dconf.path=projection.properties work/events work/seqs
+sort work/seqs/part-r-00000 > work/seqs_sorted.csv
+sort work/all.csv > work/all_sorted.csv
+cmp work/seqs_sorted.csv work/all_sorted.csv \
+  && echo "projection round-trip: reassembled sequences match the source"
+
 head -n 600 work/all.csv > work/train/part-00000
 tail -n 200 work/all.csv > work/test/part-00000
 

@@ -1,21 +1,32 @@
 #!/usr/bin/env bash
-# Frequent-itemset mining: Apriori k=1..3 (trans-id mode) -> item marker ->
-# association rules (reference runbook: resource/freq_items_apriori_tutorial.txt)
+# Frequent-itemset mining: temporal filter -> Apriori k=1..3 (trans-id
+# mode) -> item marker -> association rules (reference runbook:
+# resource/fit.sh + freq_items_apriori_tutorial.txt; the tempFilter leg
+# is org.chombo.mr.TemporalFilter, fit.sh:30-41)
 set -euo pipefail
 cd "$(dirname "$0")"
 PY=${PYTHON:-python}
 rm -rf work && mkdir -p work/freq_all
 
-$PY -m avenir_tpu.datagen transactions 400 60 --seed 37 --out work/trans/part-00000
+# raw format: transId, epochSeconds, items...  (fit.properties:9-10)
+$PY -m avenir_tpu.datagen timed_transactions 500 60 --seed 37 --out work/raw/part-00000
+
+# the reference's exact filter window (fit.properties:12) against the
+# generator's 2015-11-01..15 span keeps the 11-06..11-10 slice
+$PY -m avenir_tpu TemporalFilter -Dconf.path=tef.properties work/raw work/trans
+N_TRANS=$(wc -l < work/trans/part-r-00000)
+echo "temporal filter kept $N_TRANS/500 transactions"
 
 for k in 1 2 3; do
   EXTRA=""
   if [ "$k" -gt 1 ]; then EXTRA="-Dfia.item.set.file.path=work/k$((k-1))"; fi
   # id-carrying pass feeds the next k; id-free variant feeds the rule miner
   $PY -m avenir_tpu FrequentItemsApriori -Dconf.path=fia.properties \
-      -Dfia.item.set.length=$k $EXTRA work/trans work/k$k
+      -Dfia.item.set.length=$k -Dfia.total.tans.count=$N_TRANS \
+      $EXTRA work/trans work/k$k
   $PY -m avenir_tpu FrequentItemsApriori -Dconf.path=fia.properties \
-      -Dfia.item.set.length=$k -Dfia.trans.id.output=false $EXTRA work/trans work/k${k}f
+      -Dfia.item.set.length=$k -Dfia.trans.id.output=false \
+      -Dfia.total.tans.count=$N_TRANS $EXTRA work/trans work/k${k}f
   cp work/k${k}f/part-r-00000 work/freq_all/part-$k
 done
 

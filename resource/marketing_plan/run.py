@@ -1,22 +1,40 @@
 #!/usr/bin/env python
-"""Optimal email-marketing dates: raw transactions -> per-customer state
-sequences -> Markov transition model -> next-marketing-date plan
-(reference flow: buy_xaction.rb -> xaction_seq.rb -> Markov -> mark_plan.rb)."""
+"""Optimal email-marketing dates: raw transactions -> chombo Projection
+(group by customer, order by time) -> per-customer state sequences ->
+Markov transition model -> next-marketing-date plan
+(reference flow: buy_xaction.rb -> org.chombo.mr.Projection ->
+xaction_seq.rb -> Markov -> mark_plan.rb; Projection leg per
+cust_churn_markov_chain_classifier_tutorial.txt:26-37)."""
 import os
 import shutil
 
+import numpy as np
+
 from avenir_tpu.cli import main as job
 from avenir_tpu.core import write_output
+from avenir_tpu.core.io import read_lines
 from avenir_tpu.datagen import gen_xactions
-from avenir_tpu.models.markov import (MarkovModel, marketing_next_dates,
-                                      xactions_to_state_seqs)
+from avenir_tpu.models.markov import (MarkovModel,
+                                      marketing_next_dates_from_histories,
+                                      projected_to_histories,
+                                      projected_to_state_seqs)
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 os.chdir(HERE)
 shutil.rmtree("work", ignore_errors=True)
 
+# raw transactions arrive unordered (the reason the reference runs the
+# Projection MR at all) — shuffle to prove the ordering leg is load-bearing
 xrows = gen_xactions(150, 365, 0.06, seed=41)
-seqs = xactions_to_state_seqs(xrows)
+perm = np.random.default_rng(7).permutation(len(xrows))
+write_output("work/raw", [",".join(xrows[i]) for i in perm])
+
+rc = job(["Projection", "-Dconf.path=projection.properties",
+          "work/raw", "work/seq_compact"])
+assert rc == 0
+
+projected = [line.split(",") for line in read_lines("work/seq_compact")]
+seqs = projected_to_state_seqs(projected)
 write_output("work/seq", [",".join(r) for r in seqs])
 
 rc = job(["MarkovStateTransitionModel", "-Dconf.path=mst.properties",
@@ -24,7 +42,8 @@ rc = job(["MarkovStateTransitionModel", "-Dconf.path=mst.properties",
 assert rc == 0
 
 model = MarkovModel.load("work/model", class_label_based=False)
-plan = marketing_next_dates(xrows, model)
+plan = marketing_next_dates_from_histories(
+    projected_to_histories(projected), model)
 write_output("work/plan", plan)
 print("custID,nextMarketingDate: work/plan/part-r-00000")
 print("\n".join(plan[:5]))

@@ -1,7 +1,9 @@
 #!/usr/bin/env python
 """Price optimization by bandit rounds with an external reward simulator —
 the reference's manually-driven loop (resource/price_optimize_tutorial.txt:
-29-63: run bandit -> score selections -> re-aggregate -> bump round)."""
+29-63): run bandit -> score selections -> re-aggregate with the chombo
+RunningAggregator MR (:41-62) -> copy its output back to the bandit input
+and bump the round."""
 import os
 import shutil
 import numpy as np
@@ -23,21 +25,31 @@ os.makedirs("work")
 open("work/batch.txt", "w").write(
     "\n".join(f"prod{p},1" for p in range(n_prod)) + "\n")
 
-state = {(p, k): [0, 0] for p in range(n_prod) for k in range(n_price)}
+# round 0 state: every (product, price) untried — the bandit input format
+write_output("work/in", [f"prod{p},price{k},0,0"
+                         for p in range(n_prod) for k in range(n_price)])
 for rnd in range(1, rounds + 1):
-    write_output("work/in", [f"prod{p},price{k},{c},{r}"
-                             for (p, k), (c, r) in state.items()])
     rc = job(["GreedyRandomBandit", "-Dconf.path=grb.properties",
               f"-Dcurrent.round.num={rnd}", f"-Drandom.seed={rnd}",
               "work/in", "work/out"])
     assert rc == 0
     # external scoring: the simulator pays a clear best/rest margin
+    # (the tutorial's `price_opt.py return` leg writing inc_returnN.txt)
+    inc = []
     for line in open("work/out/part-r-00000"):
         g, item = line.strip().split(",")
         p, k = int(g[4:]), int(item[5:])
         reward = int((1000 if k == best[p] else 400) + rng.normal(0, 50))
-        c, r = state[(p, k)]
-        state[(p, k)] = [c + 1, (c * r + reward) // (c + 1)]
+        inc.append(f"{g},{item},{reward}")
+    open(f"work/in/inc_return{rnd}.txt", "w").write("\n".join(inc) + "\n")
+    # re-aggregate: state + incremental files -> updated state, then the
+    # tutorial's "copy output to input, increment round" step
+    rc = job(["RunningAggregator", "-Dconf.path=ruag.properties",
+              "work/in", "work/agg"])
+    assert rc == 0
+    shutil.rmtree("work/in")
+    os.makedirs("work/in")
+    shutil.copy("work/agg/part-r-00000", "work/in/part-00000")
 
 hits = sum(1 for line in open("work/out/part-r-00000")
            for g, item in [line.strip().split(",")]

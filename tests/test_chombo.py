@@ -1,0 +1,113 @@
+"""External chombo MR legs (models/chombo.py): TemporalFilter, Projection,
+RunningAggregator — semantics reconstructed from their reference call sites
+(fit.sh:30-41, cust_churn_markov_chain tutorial:26-37,
+price_optimize_tutorial.txt:41-62)."""
+
+import os
+
+import pytest
+
+from avenir_tpu.core.config import JobConfig
+from avenir_tpu.models.chombo import (Projection, RunningAggregator,
+                                      TemporalFilter)
+
+
+def _write(path, lines):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+
+
+def _read(path):
+    return open(os.path.join(path, "part-r-00000")).read().splitlines()
+
+
+def test_temporal_filter_any_time_range(tmp_path):
+    rows = [f"T{i},{1000 + 100 * i},I1,I2" for i in range(10)]
+    _write(str(tmp_path / "in" / "part-00000"), rows)
+    cfg = JobConfig({"tef.time.stamp.field.ordinal": "1",
+                     "tef.time.range": "1200:1500",
+                     "tef.seasonal.cycle.type": "anyTimeRange"}, "tef")
+    c = TemporalFilter(cfg).run(str(tmp_path / "in"), str(tmp_path / "out"))
+    assert _read(str(tmp_path / "out")) == rows[2:6]   # 1200..1500 inclusive
+    assert c.get("Basic", "Records emitted") == 4
+
+
+def test_temporal_filter_mili_shift_and_multi_range(tmp_path):
+    rows = ["a,1000000,x", "b,2000000,x", "c,3000000,x"]
+    _write(str(tmp_path / "in" / "part-00000"), rows)
+    cfg = JobConfig({"tef.time.stamp.field.ordinal": "1",
+                     # millis -> seconds, then +1h shift
+                     "tef.time.stamp.in.mili": "true",
+                     "tef.time.zone.shift.hours": "1",
+                     "tef.time.range": "4500:4700,6500:6700"}, "tef")
+    out = TemporalFilter(cfg).run(str(tmp_path / "in"),
+                                  str(tmp_path / "out"))
+    assert _read(str(tmp_path / "out")) == ["a,1000000,x", "c,3000000,x"]
+    assert out.get("Basic", "Records read") == 3
+
+
+def test_temporal_filter_rejects_other_cycle_types(tmp_path):
+    _write(str(tmp_path / "in" / "part-00000"), ["a,1,x"])
+    cfg = JobConfig({"tef.time.stamp.field.ordinal": "1",
+                     "tef.time.range": "0:2",
+                     "tef.seasonal.cycle.type": "hourOfDay"}, "tef")
+    with pytest.raises(ValueError):
+        TemporalFilter(cfg).run(str(tmp_path / "in"), str(tmp_path / "out"))
+
+
+def test_projection_grouping_ordering_compact(tmp_path):
+    # buyhist.properties:6-11 shape: group by cust, order by date,
+    # project (date, amount) onto one line per customer
+    rows = ["c1,x3,2013-02-01,30",
+            "c2,x1,2013-01-05,70",
+            "c1,x2,2013-01-15,50",
+            "c1,x1,2013-01-01,40"]
+    _write(str(tmp_path / "in" / "part-00000"), rows)
+    cfg = JobConfig({"projection.operation": "groupingOrdering",
+                     "key.field": "0", "orderBy.field": "2",
+                     "projection.field": "2,3", "format.compact": "true"})
+    Projection(cfg).run(str(tmp_path / "in"), str(tmp_path / "out"))
+    got = set(_read(str(tmp_path / "out")))
+    assert got == {
+        "c1,2013-01-01,40,2013-01-15,50,2013-02-01,30",
+        "c2,2013-01-05,70"}
+
+
+def test_projection_per_record_numeric_order_and_stability(tmp_path):
+    rows = ["g,a,2,first", "g,b,10,second", "g,c,2,third"]
+    _write(str(tmp_path / "in" / "part-00000"), rows)
+    cfg = JobConfig({"projection.operation": "groupingOrdering",
+                     "key.field": "0", "orderBy.field": "2",
+                     "projection.field": "3"})
+    Projection(cfg).run(str(tmp_path / "in"), str(tmp_path / "out"))
+    # numeric order (2 < 10), ties stable in input order
+    assert _read(str(tmp_path / "out")) == ["g,first", "g,third", "g,second"]
+
+
+def test_projection_plain_project(tmp_path):
+    _write(str(tmp_path / "in" / "part-00000"), ["a,b,c", "d,e,f"])
+    cfg = JobConfig({"projection.operation": "project",
+                     "projection.field": "2,0"})
+    Projection(cfg).run(str(tmp_path / "in"), str(tmp_path / "out"))
+    assert _read(str(tmp_path / "out")) == ["c,a", "f,d"]
+
+
+def test_running_aggregator_matches_library_math(tmp_path):
+    from avenir_tpu.models.bandit import aggregate_rewards
+
+    prev = ["p0,k0,2,100", "p0,k1,0,0"]
+    inc1 = ["p0,k0,40", "p0,k1,300"]
+    inc2 = ["p0,k0,70"]
+    _write(str(tmp_path / "in" / "part-00000"), prev)
+    _write(str(tmp_path / "in" / "inc_return1.txt"), inc1)
+    _write(str(tmp_path / "in" / "inc_return2.txt"), inc2)
+    cfg = JobConfig({"quantity.attr": "2", "incremental.file.prefix": "inc"})
+    c = RunningAggregator(cfg).run(str(tmp_path / "in"),
+                                   str(tmp_path / "out"))
+    assert c.get("Basic", "Incremental records") == 3
+    assert set(_read(str(tmp_path / "out"))) == set(
+        aggregate_rewards(inc1 + inc2, prev))
+    # integer running average, Java long-division parity:
+    # (2*100+40)//3 = 80, then (3*80+70)//4 = 77
+    assert "p0,k0,4,77" in _read(str(tmp_path / "out"))

@@ -28,6 +28,27 @@ def _sub_env():
     return env
 
 
+def test_reference_runbook_classes_all_resolve():
+    """Every driver class any reference runbook/tutorial invokes —
+    including the external chombo/sifarish legs — must resolve in the
+    CLI registry, so a reference fit.sh / tutorial workflow can be
+    reproduced verbatim (VERDICT r2 items 2; SURVEY §2.0)."""
+    from avenir_tpu.cli import resolve
+
+    ref = "/root/reference/resource"
+    if not os.path.isdir(ref):
+        pytest.skip("reference checkout not present")
+    pat = re.compile(r"org\.(?:avenir|chombo|sifarish)\.[A-Za-z0-9_.]+")
+    classes = set()
+    for fname in os.listdir(ref):
+        if fname.endswith(".sh") or "tutorial" in fname:
+            classes.update(pat.findall(
+                open(os.path.join(ref, fname), errors="replace").read()))
+    assert len(classes) >= 18
+    for cls in sorted(classes):
+        resolve(cls)  # raises SystemExit on a missing registry entry
+
+
 def test_resource_surface_complete():
     from avenir_tpu.core.config import parse_properties
     from avenir_tpu.core.schema import FeatureSchema
