@@ -26,7 +26,7 @@ import os
 from typing import List
 
 from ..core.config import JobConfig
-from ..core.io import read_lines, split_line, write_output
+from ..core.io import _input_files, read_lines, split_line, write_output
 from ..core.metrics import Counters
 
 
@@ -189,11 +189,7 @@ class RunningAggregator:
 
         prev: List[str] = []
         incr: List[str] = []
-        files = ([os.path.join(in_path, f) for f in sorted(os.listdir(in_path))]
-                 if os.path.isdir(in_path) else [in_path])
-        for path in files:
-            if not os.path.isfile(path):
-                continue
+        for path in _input_files(in_path):
             incremental = os.path.basename(path).startswith(prefix)
             for line in read_lines(path):
                 items = split_line(line, delim_regex)

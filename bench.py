@@ -358,11 +358,16 @@ def bench_wide_count():
     """Wide count table (32 features x 8 classes x 32 bins at 2M rows):
     the regime where the one-hot expansion (2^31 elements) outgrows HBM and
     the Pallas VMEM histogram kernel (ops/pallas_count.py) takes over.
+    Before timing, the Pallas table is asserted bit-equal on-chip against
+    the scatter-add path (the exactness contract, ops/pallas_count.py:20-26)
+    so a Mosaic regression cannot ship wrong counts at 24x speed.
     Baseline: the same table as a single-core NumPy scatter-add."""
     import jax
     import jax.numpy as jnp
 
-    from avenir_tpu.ops.counting import feature_class_counts
+    from avenir_tpu.ops.counting import count_table, feature_class_counts
+    from avenir_tpu.ops.pallas_count import (wide_count_applicable,
+                                             wide_feature_class_counts)
 
     n, F, C, B, R = 2_000_000, 32, 8, 32, 10
     rng = np.random.default_rng(0)
@@ -371,6 +376,16 @@ def bench_wide_count():
     xd = jax.device_put(x)
     yd = jax.device_put(y)
     np.asarray(xd[0, 0])
+
+    # on-chip A/B: Pallas VMEM kernel vs the scatter oracle, bit-exact
+    if wide_count_applicable(C, F, B):
+        na = 200_000            # scatter at full n is the 595 ms path
+        got = np.asarray(wide_feature_class_counts(xd[:na], yd[:na], C, B))
+        col = jnp.broadcast_to(jnp.arange(F, dtype=jnp.int32)[None, :],
+                               (na, F))
+        ycol = jnp.broadcast_to(yd[:na, None], (na, F))
+        want = np.asarray(count_table((C, F, B), (ycol, col, xd[:na])))
+        assert (got == want).all(), "Pallas count kernel drifted on-chip"
 
     def loop(xa, ya):
         def body(i, acc):
@@ -477,7 +492,9 @@ def bench_nb_score():
         feat_post = post_f.prod(axis=2)
         ratio = (feat_post * class_prior[None, :]
                  / np.maximum(feat_prior[:, None], 1e-300))
-        (ratio * 100).astype(np.int32)
+        # Java (int) cast parity: NaN -> 0, out-of-range saturates
+        from avenir_tpu.models.bayesian import _java_int32_np
+        _java_int32_np(ratio * 100)
 
     base_rows = n / best_of(np_run, 2)
     return {"metric": "nb_score_rows_per_sec_per_chip",
@@ -487,6 +504,59 @@ def bench_nb_score():
             "vs_baseline": round(rows_per_sec / base_rows, 3),
             "f32_logspace_value": round(rows_per_sec_f32),
             "f32_vs_baseline": round(rows_per_sec_f32 / base_rows, 3)}
+
+
+def bench_streaming_rl():
+    """Streaming RL fleet throughput: events/sec through the grouped
+    streaming loop (InMemory transport + VectorizedLearnerGroup masked
+    device steps) — the rebuild of the Storm bolt + per-entity learner
+    group path (ReinforcementLearnerBolt.java:92-125,
+    ReinforcementLearnerGroup.java:30-70).  Each wave drains rewards,
+    enrolls/steps every touched entity's UCB1 learner in one jitted
+    masked step, and writes eventID,action lines — the full per-event
+    wire protocol, not just the kernel."""
+    from avenir_tpu.models.streaming import (GroupedStreamingLearnerLoop,
+                                             InMemoryTransport)
+
+    actions = ["p1", "p2", "p3"]
+    config = {"reinforcement.learner.type": "upperConfidenceBoundOne",
+              "reinforcement.learner.actions": ",".join(actions),
+              "learner.type": "upperConfidenceBoundOne",
+              "action.list": ",".join(actions),
+              "min.trial": "1", "reward.scale": "1"}
+    n_entities, waves, wave_size = 4096, 6, 4096
+    rng = np.random.default_rng(0)
+
+    ents_all = [f"e{i}" for i in range(n_entities)]
+    transport = InMemoryTransport()
+    # pre-enroll the fleet once: capacity (the compiled shape) stays
+    # fixed and the jitted masked step compiles a single time, as a
+    # long-running bolt's does once its entity set stabilizes
+    loop = GroupedStreamingLearnerLoop(config, transport,
+                                       entities=ents_all)
+
+    def drive():
+        total = 0
+        for w in range(waves):
+            ents = rng.integers(0, n_entities, wave_size)
+            for i, e in enumerate(ents):
+                transport.push_event(f"e{e}", w)
+                if i % 2 == 0:
+                    transport.push_reward(
+                        f"e{e},{actions[int(rng.integers(3))]}", 50)
+            total += loop.run(max_events=wave_size, idle_timeout=0.0,
+                              batch=wave_size)
+        assert total == waves * wave_size
+        return total
+
+    drive()  # warmup: compile the masked step
+    events = waves * wave_size
+    per = best_of(drive, 2)
+    return {"metric": "streaming_rl_events_per_sec",
+            "value": round(events / per),
+            "unit": "events/sec (grouped fleet loop, InMemory transport, "
+                    "4096 entities, incl. wire protocol)",
+            "vs_baseline": None}
 
 
 def main():
@@ -566,7 +636,7 @@ def main():
     base_rows_per_sec = n / base_t
 
     extra = [bench_apriori(), bench_knn_distance(), bench_tree_level(),
-             bench_wide_count(), bench_nb_score()]
+             bench_wide_count(), bench_nb_score(), bench_streaming_rl()]
 
     print(json.dumps({
         "metric": "telecom_churn_nb_train_rows_per_sec_per_chip",

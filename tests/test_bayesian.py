@@ -243,3 +243,59 @@ def test_f32_scoring_unseen_bin_yields_zero(mesh8):
     # other rows stay within the ±1 contract
     np.testing.assert_allclose(np.asarray(p32)[1:], np.asarray(p64)[1:],
                                atol=1)
+
+
+def test_java_int_cast_extremes(mesh8):
+    """Numeric-extreme cast parity (BayesianPredictor.java:416, JLS
+    §5.1.3): ratios past 2^31 saturate at Integer.MAX_VALUE, NaN ratios
+    (inf/inf from overflowing Gaussian densities) map to 0, zero class
+    priors score 0 — against a Java-semantics host oracle."""
+    import jax.numpy as jnp
+    from avenir_tpu.models.bayesian import (BayesianPredictor, _java_int32,
+                                            _java_int32_np)
+
+    # direct cast-twin checks incl. negatives and both infinities
+    raw = np.asarray([np.nan, np.inf, -np.inf, 3.7, -3.7, 1e300, -1e300,
+                      2**31, -2**31 - 1e6, 2147483646.9])
+    want = np.asarray([0, 2**31 - 1, -2**31, 3, -3, 2**31 - 1, -2**31,
+                       2**31 - 1, -2**31, 2147483646], np.int32)
+    np.testing.assert_array_equal(_java_int32_np(raw), want)
+    np.testing.assert_array_equal(np.asarray(_java_int32(jnp.asarray(raw))),
+                                  want)
+
+    # end-to-end through the scorer: tiny feat_prior -> ratio overflow;
+    # microscopic Gaussian stds -> inf densities -> inf/inf = NaN
+    n, F, C, B = 4, 6, 2, 4
+    rng = np.random.default_rng(3)
+    x = rng.integers(0, B, (n, F)).astype(np.int32)
+    values = rng.uniform(0, 10, (n, F))
+    post = np.full((C, F, B), 0.9)
+    prior = np.full((F, B), 1e-60)       # evidence underflow -> huge ratio
+    gauss_post = np.stack([np.full((C, F), 5.0), np.full((C, F), 1.0)], -1)
+    gauss_prior = np.stack([np.full(F, 5.0), np.full(F, 1.0)], -1)
+    class_prior = np.asarray([0.5, 0.0])  # zero prior -> defined 0 score
+    is_cont = np.zeros(F, bool)
+    args = tuple(map(jnp.asarray, (x, values, post, prior, gauss_post,
+                                   gauss_prior, class_prior, is_cont)))
+    probs, _, _ = BayesianPredictor._score_batch(*args)
+    probs = np.asarray(probs)
+    assert (probs[:, 0] == 2**31 - 1).all()   # saturated, not garbage
+    assert (probs[:, 1] == 0).all()           # zero prior stays zero
+
+    # inf/inf evidence: enough collapsing-std continuous columns that
+    # the clamped densities (1/(1e-9*sqrt(2pi)) each) overflow f64 in
+    # both the posterior and the evidence product -> ratio NaN
+    F2 = 40
+    x2 = np.zeros((n, F2), np.int32)
+    is_cont2 = np.ones(F2, bool)
+    gp = np.stack([np.full((C, F2), 5.0), np.full((C, F2), 1e-300)], -1)
+    gpr = np.stack([np.full(F2, 5.0), np.full(F2, 1e-300)], -1)
+    vals2 = np.full((n, F2), 5.0)         # z = 0 -> density 1/(std*sqrt2pi)
+    args2 = tuple(map(jnp.asarray, (x2, vals2,
+                                    np.full((C, F2, 4), 0.9),
+                                    np.full((F2, 4), 0.9), gp, gpr,
+                                    np.asarray([0.5, 0.5]), is_cont2)))
+    probs2, fp2, fpost2 = BayesianPredictor._score_batch(*args2)
+    assert np.isinf(np.asarray(fp2)).all() and np.isinf(
+        np.asarray(fpost2)).all()             # the ratio really was inf/inf
+    assert (np.asarray(probs2) == 0).all()    # NaN -> 0, Java parity
