@@ -30,6 +30,11 @@ import numpy as np
 from .schema import FeatureField, FeatureSchema
 
 
+class ChunkedEncodeUnsupported(Exception):
+    """The chunked native ingest cannot serve this schema/input; callers
+    fall back to the one-shot ``encode_path``."""
+
+
 class Vocab:
     """Stable string->index mapping for one categorical column."""
 
@@ -247,12 +252,9 @@ class DatasetEncoder:
             rows=kept,
         )
 
-    def _encode_path_native(self, path: str,
-                            delim: str) -> Optional[EncodedDataset]:
-        """C-kernel ingest: one native pass parses, bucket-bins, and
-        categorical-hash-encodes every schema column straight into the final
-        int32/float64 matrices — no Python string objects, no U-dtype
-        matrix.  Returns None when the fast path does not apply."""
+    def _native_specs(self, path: str, delim: str):
+        """(specs, n_cols, id_ord) for the C encode, or None when the
+        native fast path does not apply to this schema/file."""
         from . import io as _io
         from .. import native
 
@@ -267,9 +269,8 @@ class DatasetEncoder:
             return None
         n_cols = first.count(delim) + 1
 
-        ffields = self.feature_fields
         specs = []
-        for j, f in enumerate(ffields):
+        for j, f in enumerate(self.feature_fields):
             if f.is_categorical():
                 specs.append((f.ordinal, native.CAT, j, 0))
             elif f.is_bucket_width_defined():
@@ -282,16 +283,14 @@ class DatasetEncoder:
         if self.id_field is not None and self.id_field.ordinal >= n_cols:
             return None     # fall back so the schema misfit errors loudly
         id_ord = self.id_field.ordinal if self.id_field is not None else -1
+        return specs, n_cols, id_ord
 
-        res = native.encode_schema(path, specs, n_cols, len(ffields),
-                                   self.class_field is not None,
-                                   id_ordinal=id_ord, delim=delim)
-        if res is None:
-            return None
+    def _remap_native(self, res):
+        """Remap C first-seen codes -> stable vocab ids (declared
+        cardinality first, then first-seen appended — same order vocab.add
+        produces); returns (n, x, values, y, ids)."""
         n, x, values, y, ids, cat_uniques = res
-
-        # remap C first-seen codes -> stable vocab ids (declared cardinality
-        # first, then first-seen appended — same order vocab.add produces)
+        ffields = self.feature_fields
         for j, f in enumerate(ffields):
             if f.is_categorical():
                 x[:, j] = self._cat_lut(self.vocabs[f.ordinal],
@@ -303,8 +302,76 @@ class DatasetEncoder:
                               cat_uniques[self.class_field.ordinal])[y]
         else:
             y = np.full(n, -1, dtype=np.int32)
+        return n, x, values, y, ids
+
+    def _encode_path_native(self, path: str,
+                            delim: str) -> Optional[EncodedDataset]:
+        """C-kernel ingest: one native pass parses, bucket-bins, and
+        categorical-hash-encodes every schema column straight into the final
+        int32/float64 matrices — no Python string objects, no U-dtype
+        matrix.  Returns None when the fast path does not apply."""
+        from .. import native
+
+        sp = self._native_specs(path, delim)
+        if sp is None:
+            return None
+        specs, n_cols, id_ord = sp
+        res = native.encode_schema(path, specs, n_cols,
+                                   len(self.feature_fields),
+                                   self.class_field is not None,
+                                   id_ordinal=id_ord, delim=delim)
+        if res is None:
+            return None
+        n, x, values, y, ids = self._remap_native(res)
         return self._assemble(x, values, y,
                               ids if ids is not None else [], [])
+
+    def encode_path_chunks(self, path: str, delim: str = ",",
+                           chunk_bytes: int = 48 << 20):
+        """Generator over C-encoded chunks of the input, split at line
+        boundaries: yields ``(x, values, y, n_rows)`` per chunk with the
+        SAME shared vocabularies as ``encode_path`` (codes are globally
+        stable across chunks), so callers can pipeline
+        encode -> device-transfer -> count with double buffering instead
+        of one serial pass (the streaming-record-reader role of Hadoop
+        input splits).  Raises ``ChunkedEncodeUnsupported`` when the
+        native path does not apply — callers fall back to
+        ``encode_path``.  No per-chunk bin shifting happens here: callers
+        own the declared-extent/negative-bin guards (see
+        models.bayesian's streamed trainer)."""
+        from .. import native
+
+        sp = self._native_specs(path, delim)
+        if sp is None:
+            raise ChunkedEncodeUnsupported("native encode unavailable")
+        specs, n_cols, _ = sp
+        id_ord = -1          # the training path never reads row ids;
+        #                      skipping them drops the id-bytes copy pass
+        buf = native._read_buffer(path)
+        pos = 0
+        while pos < len(buf):
+            end = min(pos + chunk_bytes, len(buf))
+            if end < len(buf):
+                nl = buf.find(b"\n", end)
+                end = len(buf) if nl < 0 else nl + 1
+            chunk = buf[pos:end]
+            # the newline count equals the parser's row count only when no
+            # blank lines exist (csv_scan/csv_parse skip them); blanks are
+            # rare (multi-file joins), so they just take the scan pass
+            n_hint = None
+            if b"\n\n" not in chunk and not chunk.startswith(b"\n"):
+                n_hint = chunk.count(b"\n")
+                if not chunk.endswith(b"\n"):
+                    n_hint += 1
+            res = native.encode_schema_buffer(
+                chunk, specs, n_cols, len(self.feature_fields),
+                self.class_field is not None, id_ordinal=id_ord,
+                delim=delim, n_rows_hint=n_hint)
+            if res is None:
+                raise ChunkedEncodeUnsupported("native encode failed")
+            n, x, values, y, _ = self._remap_native(res)
+            yield x, values, y, n
+            pos = end
 
     @staticmethod
     def _cat_lut(vocab: Vocab, uniques) -> np.ndarray:

@@ -284,7 +284,13 @@ class GroupedStreamingLearnerLoop:
             msg = self.transport.next_event()
             if msg is None:
                 break
-            entities.append(msg.split(",")[0])
+            # validate symmetrically with apply_rewards: a malformed or
+            # empty event must not auto-enroll a bogus entity (e.g. "")
+            ent = msg.split(",")[0]
+            if not ent:
+                self.malformed_count += 1
+                continue
+            entities.append(ent)
         if not entities:
             return 0
         self.group.add_groups(entities)

@@ -180,6 +180,22 @@ def encode_schema(path: str, col_specs: Sequence[Tuple[int, int, int]],
     if lib is None or len(delim) != 1:
         return None
     buf = _read_buffer(path)
+    return encode_schema_buffer(buf, col_specs, n_file_cols, n_feat,
+                                has_class, id_ordinal, delim, max_uniq)
+
+
+def encode_schema_buffer(buf: bytes, col_specs, n_file_cols: int,
+                         n_feat: int, has_class: bool, id_ordinal: int = -1,
+                         delim: str = ",", max_uniq: int = 1 << 16,
+                         n_rows_hint: Optional[int] = None):
+    """``encode_schema`` over an in-memory buffer — the chunked-ingest
+    entry point (the caller splits a file at line boundaries and encodes
+    each chunk while earlier chunks are counting on device).
+    ``n_rows_hint`` (an exact line count) skips the csv_scan sizing pass;
+    it is only honored when no bytes (id) column needs width metering."""
+    lib = get_lib()
+    if lib is None or len(delim) != 1:
+        return None
     bdelim = ctypes.c_char(delim.encode())
 
     col_type = [SKIP] * n_file_cols
@@ -196,7 +212,10 @@ def encode_schema(path: str, col_specs: Sequence[Tuple[int, int, int]],
             bucket_w[ordinal] = extra
 
     widths = (ctypes.c_int * n_file_cols)(*([0] * n_file_cols))
-    n_rows = lib.csv_scan(buf, len(buf), bdelim, n_file_cols, widths)
+    if n_rows_hint is not None and id_ordinal < 0:
+        n_rows = n_rows_hint        # widths only meter bytes (id) columns
+    else:
+        n_rows = lib.csv_scan(buf, len(buf), bdelim, n_file_cols, widths)
     if n_rows < 0:
         return None
 
@@ -217,10 +236,16 @@ def encode_schema(path: str, col_specs: Sequence[Tuple[int, int, int]],
     n_uniq = np.zeros(n_file_cols, dtype=np.int32)
 
     # multithreaded encode for large buffers; the local-vocab memory is
-    # T * n_cols * max_uniq, so the big-vocab retry stays single-threaded
+    # T * n_cat * max_uniq * 16B, so the big-vocab retry stays
+    # single-threaded and the thread count scales down with the
+    # categorical column count to cap transient scratch at ~128 MB
+    # (a many-categorical schema would otherwise allocate hundreds of MB)
     n_threads = 1
     if len(buf) >= MT_MIN_BYTES and max_uniq <= (1 << 16):
         n_threads = MT_THREADS or min(8, os.cpu_count() or 1)
+        scratch_budget = 128 << 20
+        per_thread = max(len(cat_ordinals), 1) * max_uniq * 16
+        n_threads = max(min(n_threads, scratch_budget // per_thread), 1)
     rc = lib.csv_encode_mt(
         buf, len(buf), bdelim, n_file_cols,
         (ctypes.c_int * n_file_cols)(*col_type),
@@ -233,8 +258,9 @@ def encode_schema(path: str, col_specs: Sequence[Tuple[int, int, int]],
         uniq_start.ctypes.data, uniq_len.ctypes.data, n_uniq.ctypes.data,
         uniq_start.shape[1], n_threads)
     if rc == -3 and max_uniq < (1 << 22):   # vocab overflow: one retry, 64x
-        return encode_schema(path, col_specs, n_file_cols, n_feat, has_class,
-                             id_ordinal, delim, max_uniq=1 << 22)
+        return encode_schema_buffer(buf, col_specs, n_file_cols, n_feat,
+                                    has_class, id_ordinal, delim,
+                                    max_uniq=1 << 22)
     if rc != 0:
         return None
 

@@ -174,7 +174,11 @@ static int cat_code(CatTable *t, const char *buf, const char *p, int flen) {
  *   bytes_out[col]: fixed-width byte strings (type 3), width bytes_width[col]
  *   uniq_start/uniq_len[col * max_uniq + k]: k-th first-seen unique of a
  *     categorical column (byte range into buf); n_uniq[col] = count
- * Returns 0, or -2 unparseable numeric / -3 max_uniq exceeded / -4 oom.
+ * Returns 0, or -2 unparseable numeric / -3 max_uniq exceeded / -4 oom /
+ * -5 ragged line (column count != n_cols).  The ragged check runs here,
+ * not only in csv_scan, because callers supplying a pre-counted row hint
+ * skip the scan pass -- without it a short line would silently leave
+ * zero/garbage cells and an extra field would index past the spec arrays.
  */
 static int encode_range(const char *buf, long long start, long long len,
                         char delim, int n_cols,
@@ -196,6 +200,7 @@ static int encode_range(const char *buf, long long start, long long len,
                 if (end > fstart && buf[end - 1] == '\r'
                     && (i == len || buf[i] == '\n'))
                     end--;
+                if (col >= n_cols) { rc = -5; break; }
                 int t = col_type[col];
                 if (t == 1) {
                     long long v;
@@ -237,6 +242,7 @@ static int encode_range(const char *buf, long long start, long long len,
                 i++;
             }
         }
+        if (!rc && col != n_cols) rc = -5;
         row++;
     }
     return rc;

@@ -57,8 +57,12 @@ def topk_smallest(dist, k: int, method: str = "exact"):
     """
     nt = dist.shape[-1]
     if method == "approx":
-        v, i = jax.lax.approx_min_k(dist.astype(jnp.float32), k)
-        return v.astype(dist.dtype), i
+        # selection runs on an f32 cast (the TPU ANN kernel's operand
+        # type); values above 2^24 would come back quantized, so the
+        # exact distances are re-gathered at the returned indices —
+        # recall stays approximate, values do not
+        _, i = jax.lax.approx_min_k(dist.astype(jnp.float32), k)
+        return jnp.take_along_axis(dist, i, axis=-1), i
     if method != "exact":
         raise ValueError(f"unknown top-k method {method!r}; "
                          "use 'exact' or 'approx'")

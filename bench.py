@@ -2,13 +2,16 @@
 
 1. telecom-churn Naive Bayes training throughput (rows/sec/chip) — the
    primary metric on the JSON line.
-2. Apriori k=1..3 frequent-itemset pipeline wall-clock at tutorial scale
-   (2,000 transactions x 50k items, freq_items_apriori_tutorial.txt:19-24) —
-   reported in ``extra_metrics`` on the same line.
-3. kNN distance engine achieved GFLOP/s (+ MFU where the chip's bf16 peak
-   is known) — the O(n^2) MXU kernel behind knn/cluster.
+2. Apriori k=1..3 frequent-itemset pipeline at 1000x tutorial scale
+   (2M transactions x 50k items, heavy-head popularity; base shape from
+   freq_items_apriori_tutorial.txt:19-24) — wall-clock + trans/sec/chip
+   in ``extra_metrics`` on the same line.
+3. kNN distance engine achieved GFLOP/s + MFU vs the chip's bf16 peak —
+   the fused Pallas O(n^2) kernel behind knn/cluster.
 4. Decision-tree level pass rows/sec/chip — the per-level
    C[path, predicate, class] histogram that replaces one whole MR job.
+5. Wide-count Pallas kernel, NB batch scoring, and streaming-RL fleet
+   throughput round out the kernel evidence.
 
 The reference publishes no numbers (BASELINE.md), so each baseline is a
 measured single-core NumPy implementation of the identical computation — a
@@ -64,13 +67,17 @@ def numpy_baseline(x, y, values, n_class, max_bins, cont_cols, reps=3):
 
 
 def bench_apriori():
-    """Second north star: Apriori support-count pipeline wall-clock, warm
-    (steady-state: compiled kernels + cached encode).  Runs the tutorial
-    workload scaled 100x in transactions (200k x 50k items) — at the 2k
-    tutorial scale the counting fits in microseconds of FLOPs and any
-    implementation is file-IO-bound; at 100x the support matmul dominates
-    and the comparison is meaningful.  Baseline: the same counting in
-    single-core NumPy."""
+    """Second north star: Apriori k=1..3 at 1000x the tutorial's
+    transaction count (2M x 50k items, freq_items_apriori_tutorial.txt:
+    19-24) with a heavy-head item popularity (300-item frequent pool)
+    so ~320 items clear the support threshold and the k=2/k=3 candidate
+    support passes are real MXU work (~0.5 TFLOP of incidence matmul)
+    instead of the dispatch-bound sliver the 0.1-threshold tutorial
+    collapses to.  The incidence matrix stays device-resident across the
+    k passes (models/association._inc_device_cache).  Reports warm
+    pipeline wall-clock and transactions/sec/chip; baseline is the
+    identical algorithm in single-core NumPy starting from the same
+    cached encode (parse excluded on BOTH sides)."""
     import shutil
     import tempfile
 
@@ -81,22 +88,55 @@ def bench_apriori():
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+_APRIORI_THRESHOLD = 0.005
+
+
+def _gen_apriori_workload(tmp, n_trans, n_items, pool, planted):
+    """Vectorized workload writer: 5 draws from the popular pool + 2 from
+    the tail per transaction, planted triples added at support 0.02."""
+    import os
+
+    rng = np.random.default_rng(5)
+    vocab = np.asarray([f"I{i:05d}" for i in range(n_items)])
+    pool_ids = rng.integers(0, pool, (n_trans, 5))
+    tail_ids = rng.integers(pool, n_items, (n_trans, 2))
+    ids = np.concatenate([pool_ids, tail_ids], axis=1)
+    # planted support 0.02: well above the threshold but low enough
+    # that planted x pool cross pairs die at k=2 (0.02*0.0165*2M*2
+    # < the 10k count bound), keeping candidate growth realistic
+    flags = rng.random((n_trans, len(planted))) < 0.02
+    strs = vocab[ids]
+    planted_strs = [vocab[list(p)] for p in planted]
+    lines = []
+    for t in range(n_trans):
+        row = [f"T{t:07d}"] + list(strs[t])
+        for p, f in zip(planted_strs, flags[t]):
+            if f:
+                row.extend(p)
+        lines.append(",".join(row))
+    path = os.path.join(tmp, "trans")
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "part-00000"), "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    return path
+
+
 def _bench_apriori_in(tmp):
     import os
 
-    from avenir_tpu.core import JobConfig, write_output
-    from avenir_tpu.datagen import gen_transactions
+    from avenir_tpu.core import JobConfig
+    from avenir_tpu.models import association
     from avenir_tpu.models.association import FrequentItemsApriori
+    from avenir_tpu.parallel.mesh import make_mesh
 
-    n_trans, n_items = 200000, 50000
+    n_trans, n_items, pool = 2_000_000, 50_000, 300
     planted = ((3, 7, 11), (101, 202, 303), (1001, 2002, 3003))
-    rows = gen_transactions(n_trans, n_items, planted=planted,
-                            planted_support=0.25, seed=5)
-    write_output(os.path.join(tmp, "trans"), [",".join(r) for r in rows])
+    in_path = _gen_apriori_workload(tmp, n_trans, n_items, pool, planted)
     base = {"fia.skip.field.count": "1", "fia.tans.id.ord": "0",
-            "fia.support.threshold": "0.1",
+            "fia.support.threshold": str(_APRIORI_THRESHOLD),
             "fia.total.tans.count": str(n_trans),
             "fia.emit.trans.id": "false"}
+    n_chips = make_mesh().devices.size
 
     def run_pipeline():
         for k in (1, 2, 3):
@@ -105,9 +145,9 @@ def _bench_apriori_in(tmp):
             if k > 1:
                 props["fia.item.set.file.path"] = os.path.join(tmp, f"k{k-1}")
             FrequentItemsApriori(JobConfig(props)).run(
-                os.path.join(tmp, "trans"), os.path.join(tmp, f"k{k}"))
+                in_path, os.path.join(tmp, f"k{k}"))
 
-    run_pipeline()  # warmup: compile + encode cache
+    run_pipeline()  # warmup: compile + encode cache + device incidence
     best = best_of(run_pipeline)
 
     # planted-signal check: all 3 triples recovered
@@ -117,37 +157,38 @@ def _bench_apriori_in(tmp):
         want = tuple(sorted(f"I{i:05d}" for i in pset))
         assert want in found, f"planted {want} not recovered"
 
-    base_t = _apriori_numpy_baseline(rows, n_trans)
+    # warm NumPy baseline over the SAME cached encode (no parsing)
+    enc = next(iter(association._encode_cache.values()))
+    base_t = _apriori_numpy_baseline(enc, n_trans)
     return {"metric": "apriori_k123_pipeline_wall_clock",
             "value": round(best, 4),
-            "unit": "sec (warm, tutorial scale x100 transactions)",
-            "vs_baseline": round(base_t / best, 3)}
+            "unit": "sec (warm, tutorial scale x1000: 2M trans x 50k "
+                    "items, ~320 frequent items)",
+            "vs_baseline": round(base_t / best, 3),
+            "trans_per_sec_per_chip": round(3 * n_trans / best / n_chips)}
 
 
-def _apriori_numpy_baseline(rows, n_trans, threshold=0.1, reps=3):
-    """Single-core NumPy k=1..3: occurrence bincount + dense incidence
-    matmuls over the frequent-pruned vocabulary (same algorithm, no device,
-    no sharding)."""
+def _apriori_numpy_baseline(enc, n_trans, threshold=_APRIORI_THRESHOLD,
+                            reps=2):
+    """Single-core NumPy k=1..3 over the pre-parsed token arrays: the
+    identical pruning + incidence matmuls + thresholds, no device."""
     def run():
-        tokens = [it for r in rows for it in r[1:]]
-        lengths = [len(r) - 1 for r in rows]
-        rrows = np.repeat(np.arange(len(rows)), lengths)
-        vocab, ids = np.unique(np.asarray(tokens, dtype=object).astype(str),
-                               return_inverse=True)
-        occ = np.bincount(ids, minlength=len(vocab))
-        keep = occ * 3 > threshold * n_trans
-        col_of = np.full(len(vocab), -1)
+        occ = enc.occ_counts
+        V = len(enc.vocab)
+        # k=2 pruning bound (count mode, multiplicity <= 2)
+        keep = occ * 2 > threshold * n_trans
+        col_of = np.full(V, -1)
         col_of[np.nonzero(keep)[0]] = np.arange(int(keep.sum()))
-        sel = col_of[ids] >= 0
-        inc = np.zeros((len(rows), int(keep.sum())), dtype=np.float32)
-        inc[rrows[sel], col_of[ids[sel]]] = 1.0
+        sel = col_of[enc.dids] >= 0
+        inc = np.zeros((enc.nt, int(keep.sum())), dtype=np.float32)
+        inc[enc.drows[sel], col_of[enc.dids[sel]]] = 1.0
         frequent1 = np.nonzero(occ > threshold * n_trans)[0]
-        s1 = col_of[frequent1].reshape(-1, 1)
-        co2 = inc[:, s1[:, 0]].T @ inc
+        s1 = col_of[frequent1]
+        co2 = inc[:, s1].T @ inc
         # k=3 from frequent pairs, deduped to unordered (i<j) like the real
         # pipeline's (k-1)-itemset file (no self-pairs, no both orders)
-        pi, pj = np.nonzero(co2 > threshold * n_trans)
-        rowcol = s1[pi, 0]
+        pi, pj = np.nonzero(co2 * 2 > threshold * n_trans)
+        rowcol = s1[pi]
         m = pj > rowcol
         v3 = inc[:, rowcol[m]] * inc[:, pj[m]]
         v3.T @ inc
