@@ -339,8 +339,14 @@ class DatasetEncoder:
         ``encode_path``.  No per-chunk bin shifting happens here: callers
         own the declared-extent/negative-bin guards (see
         models.bayesian's streamed trainer)."""
+        from .io import is_plain_delim
         from .. import native
 
+        # the C path splits on a literal byte; a regex-metachar delimiter
+        # must keep the serial path's regex semantics (encode_path gates
+        # on the same predicate)
+        if not is_plain_delim(delim):
+            raise ChunkedEncodeUnsupported("regex delimiter")
         sp = self._native_specs(path, delim)
         if sp is None:
             raise ChunkedEncodeUnsupported("native encode unavailable")

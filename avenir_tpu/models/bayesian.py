@@ -226,8 +226,10 @@ class BayesianDistribution:
         from ..core.binning import ChunkedEncodeUnsupported
 
         enc = DatasetEncoder(self.schema)
+        chunk_bytes = self.config.get_int("ingest.chunk.bytes", 48 << 20)
         try:
-            gen = enc.encode_path_chunks(in_path, delim_in)
+            gen = enc.encode_path_chunks(in_path, delim_in,
+                                         chunk_bytes=chunk_bytes)
             first = next(gen, None)
             if first is None:
                 return None
@@ -245,8 +247,13 @@ class BayesianDistribution:
                         for f in ffields]
             obs0 = [int(x0[:, j].max()) + 1 if len(x0) else 0
                     for j in binned]
+            # declared categorical cardinalities are pre-seeded into the
+            # vocab, so the emit loop walks len(vocab) bins even when the
+            # data uses fewer — the count tensor must cover them
+            cat_card = [len(enc.vocabs[f.ordinal])
+                        for f in ffields if f.is_categorical()]
             bins_cap = max([1] + [declared[j] for j in bucket_cols]
-                           + obs0) + 4
+                           + obs0 + cat_card) + 4
             # no class headroom: the class vocabulary is complete after
             # chunk 0 in practice (declared in the schema, or every class
             # present early); a late new class fails the cap guard and

@@ -1,0 +1,177 @@
+"""Chunked double-buffered NB ingest (models/bayesian._train_streamed +
+core/binning.encode_path_chunks): byte-parity with the serial encode across
+chunk boundaries, and every cap-guard fallback path.
+
+The streamed trainer overlaps the C encode of chunk c+1 with chunk c's
+async device count; its contract is that output is IDENTICAL to the serial
+``encode_path`` path, with any input it cannot cap-bound falling back to
+that path automatically."""
+
+import json
+
+import numpy as np
+import pytest
+
+from avenir_tpu import native
+from avenir_tpu.core import DatasetEncoder, FeatureSchema, JobConfig
+from avenir_tpu.core.metrics import Counters
+from avenir_tpu.models.bayesian import BayesianDistribution
+
+SCHEMA_POS = FeatureSchema.from_json(json.dumps({"fields": [
+    {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+    {"name": "color", "ordinal": 1, "dataType": "categorical",
+     "feature": True, "cardinality": ["red", "green"]},
+    {"name": "amount", "ordinal": 2, "dataType": "int", "feature": True,
+     "min": 0, "max": 100, "bucketWidth": 7},
+    {"name": "score", "ordinal": 3, "dataType": "double", "feature": True},
+    {"name": "label", "ordinal": 4, "dataType": "categorical",
+     "cardinality": ["N", "Y"]},
+]}))
+
+SCHEMA_NEG = FeatureSchema.from_json(json.dumps({"fields": [
+    {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+    {"name": "amount", "ordinal": 1, "dataType": "int", "feature": True,
+     "min": -100, "max": 100, "bucketWidth": 7},
+    {"name": "label", "ordinal": 2, "dataType": "categorical",
+     "cardinality": ["N", "Y"]},
+]}))
+
+
+@pytest.fixture
+def have_native():
+    if native.get_lib() is None:
+        pytest.skip("C toolchain unavailable")
+
+
+def _rows(n=800, seed=3, amt_lo=0, cls=("N", "Y", "Y", "N")):
+    rng = np.random.default_rng(seed)
+    colors = ["blue", "red", "grey", "green", "teal"]
+    return [[f"id{i:04d}", colors[rng.integers(len(colors))],
+             str(int(rng.integers(amt_lo, 100))),
+             f"{rng.uniform(-5, 5):.4f}",
+             cls[int(rng.integers(len(cls)))]]
+            for i in range(n)]
+
+
+def _job(schema_path, chunk_bytes=2048):
+    return BayesianDistribution(JobConfig({
+        "feature.schema.file.path": schema_path,
+        "ingest.chunk.bytes": str(chunk_bytes)}))
+
+
+def _serial_lines(schema, path):
+    job = BayesianDistribution.__new__(BayesianDistribution)
+    enc = DatasetEncoder(schema)
+    ds = enc.encode_path(path)
+    job.config = JobConfig({})
+    return job.train_lines(ds, ",", Counters())
+
+
+def _write_schema(tmp_path, schema_obj, rows, eol="\n"):
+    sp = tmp_path / "schema.json"
+    sp.write_text(json.dumps({"fields": [
+        {k: v for k, v in f.__dict__.items() if v is not None}
+        for f in schema_obj.fields]}))
+    ip = tmp_path / "in"
+    ip.mkdir(exist_ok=True)
+    (ip / "part-00000").write_text(
+        eol.join(",".join(r) for r in rows) + eol)
+    return str(sp), str(ip)
+
+
+def test_streamed_multichunk_matches_serial(tmp_path, have_native, mesh8):
+    rows = _rows(800)
+    sp, ip = _write_schema(tmp_path, SCHEMA_POS, rows)
+    job = _job(sp, chunk_bytes=2048)          # ~60 chunks
+    streamed = job._train_streamed(ip, ",", ",", Counters())
+    assert streamed is not None
+    assert streamed == _serial_lines(SCHEMA_POS, ip)
+
+
+def test_streamed_chunk_boundary_invariance(tmp_path, have_native, mesh8):
+    rows = _rows(300, seed=9)
+    sp, ip = _write_schema(tmp_path, SCHEMA_POS, rows)
+    outs = []
+    for cb in (1 << 9, 1 << 12, 1 << 26):     # many / few / one chunk
+        outs.append(_job(sp, cb)._train_streamed(ip, ",", ",", Counters()))
+    assert outs[0] is not None
+    assert outs[0] == outs[1] == outs[2]
+
+
+def test_streamed_negative_bins_fall_back(tmp_path, have_native, mesh8):
+    rows = [[f"id{i}", str(v), "Y"] for i, v in enumerate((-70, -7, 0, 35))]
+    sp, ip = _write_schema(tmp_path, SCHEMA_NEG, rows)
+    job = _job(sp)
+    assert job._train_streamed(ip, ",", ",", Counters()) is None
+    # the public run() still trains correctly through the serial path
+    job.run(ip, str(tmp_path / "out"))
+    got = (tmp_path / "out" / "part-r-00000").read_text().splitlines()
+    assert got == _serial_lines(SCHEMA_NEG, ip)
+
+
+def test_streamed_late_class_falls_back_identically(tmp_path, have_native,
+                                                    mesh8):
+    # class "Z" (undeclared) appears only in the final chunk: the cap
+    # guard must fall back, and run() must equal the serial output
+    rows = _rows(300, seed=5)
+    rows[-1][4] = "Z"
+    sp, ip = _write_schema(tmp_path, SCHEMA_POS, rows)
+    job = _job(sp, chunk_bytes=1 << 10)
+    assert job._train_streamed(ip, ",", ",", Counters()) is None
+    job.run(ip, str(tmp_path / "out"))
+    got = (tmp_path / "out" / "part-r-00000").read_text().splitlines()
+    assert got == _serial_lines(SCHEMA_POS, ip)
+
+
+def test_streamed_blank_lines_and_crlf(tmp_path, have_native, mesh8):
+    # blank lines force the per-chunk scan pass (the row-count hint only
+    # serves clean buffers); CRLF exercises the C parser's strip
+    rows = _rows(120, seed=11)
+    sp, ip = _write_schema(tmp_path, SCHEMA_POS, rows, eol="\r\n")
+    text = (tmp_path / "in" / "part-00000").read_text()
+    (tmp_path / "in" / "part-00000").write_text(
+        text.replace("\r\n", "\r\n\n", 7))    # sprinkle blank lines
+    streamed = _job(sp, 1 << 10)._train_streamed(ip, ",", ",", Counters())
+    assert streamed is not None
+    assert streamed == _serial_lines(SCHEMA_POS, ip)
+
+
+def test_streamed_ragged_line_fails_like_serial(tmp_path, have_native,
+                                                mesh8):
+    rows = _rows(50, seed=2)
+    sp, ip = _write_schema(tmp_path, SCHEMA_POS, rows)
+    with open(tmp_path / "in" / "part-00000", "a") as fh:
+        fh.write("short,row\n")
+    job = _job(sp, 1 << 10)
+    with pytest.raises(Exception):
+        job.run(ip, str(tmp_path / "out"))
+
+
+def test_streamed_declared_cardinality_wider_than_data(tmp_path,
+                                                       have_native, mesh8):
+    # schema declares 8 colors but the data uses 2: the count tensor must
+    # still cover every declared bin the emit loop walks
+    wide = FeatureSchema.from_json(json.dumps({"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "color", "ordinal": 1, "dataType": "categorical",
+         "feature": True,
+         "cardinality": ["c%d" % i for i in range(8)]},
+        {"name": "label", "ordinal": 2, "dataType": "categorical",
+         "cardinality": ["N", "Y"]},
+    ]}))
+    rows = [[f"id{i}", "c%d" % (i % 2), "NY"[i % 2]] for i in range(40)]
+    sp, ip = _write_schema(tmp_path, wide, rows)
+    streamed = _job(sp, 1 << 8)._train_streamed(ip, ",", ",", Counters())
+    assert streamed is not None
+    assert streamed == _serial_lines(wide, ip)
+
+
+def test_streamed_regex_delimiter_falls_back(tmp_path, have_native, mesh8):
+    # '|' is a regex metachar: the C literal-byte split must not engage;
+    # the serial path's regex semantics win via the fallback
+    rows = _rows(30, seed=4)
+    sp, ip = _write_schema(tmp_path, SCHEMA_POS, rows)
+    text = (tmp_path / "in" / "part-00000").read_text().replace(",", "|")
+    (tmp_path / "in" / "part-00000").write_text(text)
+    job = _job(sp, 1 << 9)
+    assert job._train_streamed(ip, "|", ",", Counters()) is None
