@@ -347,6 +347,9 @@ class DatasetEncoder:
         # on the same predicate)
         if not is_plain_delim(delim):
             raise ChunkedEncodeUnsupported("regex delimiter")
+        # a non-positive chunk size would loop forever on empty chunks
+        # (>= 1 always advances pos: the slice extends to the next newline)
+        chunk_bytes = max(int(chunk_bytes), 1)
         sp = self._native_specs(path, delim)
         if sp is None:
             raise ChunkedEncodeUnsupported("native encode unavailable")
