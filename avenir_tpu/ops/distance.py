@@ -248,22 +248,23 @@ def pairwise_distances(qnum: np.ndarray, qcat: np.ndarray,
     m_ax = mesh.shape["model"]
     nq = qnum.shape[0]
     nt = tnum.shape[0]
-    qnum0, qcat0 = qnum, qcat
+    qnum0, qcat0, tnum0 = qnum, qcat, tnum
     # fold weights into the numeric columns so the matmul needs no extra pass
     qnum, tnum, wsum = _fold_weights(qnum, tnum, num_weights, cat_weights,
                                      algorithm)
 
     k0 = min(top_k, nt) if top_k else None
-    if k0 is not None and m_ax == 1 and topk_method in ("exact", "fused"):
+    if (k0 is not None and topk_method in ("exact", "fused")
+            and (m_ax == 1 or qnum.shape[1] > 0)):
         from .pallas_topk import (fused_pairwise_topk, fused_topk_applicable,
                                   fused_topk_supported)
         n_num, n_cat = qnum.shape[1], qcat.shape[1]
         if topk_method == "fused" and not fused_topk_supported(
-                algorithm, k0, nt, n_num, n_cat, scale):
+                algorithm, k0, nt, n_num, n_cat, scale, m_ax=m_ax):
             raise ValueError("fused top-k not supported for this shape; "
                              "use topk_method='exact'")
         if topk_method == "fused" or fused_topk_applicable(
-                algorithm, k0, nq, nt, n_num, n_cat, scale):
+                algorithm, k0, nq, nt, n_num, n_cat, scale, m_ax=m_ax):
             vals, idxs, suspect = fused_pairwise_topk(
                 qnum, qcat, tnum, tcat, cat_weights, wsum, scale, k0,
                 mesh=mesh)
@@ -273,16 +274,18 @@ def pairwise_distances(qnum: np.ndarray, qcat: np.ndarray,
                 idxs = np.array(idxs)
                 # bin-overflow rows: exact re-resolve via the sort-based
                 # engine (the fused kernel's soundness check guarantees
-                # every possibly-affected row is in `bad`)
+                # every possibly-affected row is in `bad`).  The UNFOLDED
+                # operands go in — the recursive call folds the weights
+                # itself (a folded tnum here would double-apply them)
                 vb, ib = pairwise_distances(
-                    qnum0[bad], qcat0[bad], tnum, tcat, num_weights,
+                    qnum0[bad], qcat0[bad], tnum0, tcat, num_weights,
                     cat_weights, algorithm=algorithm, scale=scale,
                     top_k=k0, mesh=mesh, topk_method="sorted")
                 vals[bad], idxs[bad] = vb, ib
             return vals, idxs
     if topk_method == "fused":
-        raise ValueError("topk_method='fused' requires top_k on a "
-                         "1-D (model=1) mesh")
+        raise ValueError("topk_method='fused' requires top_k (and, on a "
+                         "2-D mesh, at least one numeric column)")
     if topk_method == "sorted":
         topk_method = "exact"
 

@@ -69,11 +69,17 @@ _fused_cache: dict = {}
 
 
 def fused_topk_supported(algorithm: str, k: int, nt: int,
-                         n_num: int, n_cat: int, scale: int) -> bool:
+                         n_num: int, n_cat: int, scale: int,
+                         m_ax: int = 1) -> bool:
     """Hard constraints of the fused engine: euclidean (the MXU
     expansion), shapes inside the kernel's VMEM budget, and a packing
-    budget that keeps the (value, index) pair inside one int32."""
-    idx_bits = max(int(np.ceil(np.log2(max(nt, 2)))), 1)
+    budget that keeps the (value, index) pair inside one int32.  The
+    index bits are computed on the PADDED candidate extent (a multiple
+    of ``m_ax * _TB``) — on a non-power-of-two model axis the padding
+    can cross a power of two and halve the value budget."""
+    step = m_ax * _TB
+    nt_pad = -(-max(nt, 1) // step) * step
+    idx_bits = max(int(np.ceil(np.log2(max(nt_pad, 2)))), 1)
     val_budget = 1 << (31 - idx_bits)
     return (algorithm == "euclidean"
             and 0 < k <= _MAX_K
@@ -86,7 +92,8 @@ def fused_topk_supported(algorithm: str, k: int, nt: int,
 
 def fused_topk_applicable(algorithm: str, k: int, nq: int, nt: int,
                           n_num: int, n_cat: int, scale: int,
-                          backend: Optional[str] = None) -> bool:
+                          backend: Optional[str] = None,
+                          m_ax: int = 1) -> bool:
     """Auto-selection gate: hard constraints plus the heuristics that
     make the fused path the win (a TPU backend and a candidate axis wide
     enough that sort-based selection is the bottleneck)."""
@@ -94,7 +101,7 @@ def fused_topk_applicable(algorithm: str, k: int, nq: int, nt: int,
     return (backend == "tpu"
             and nt >= 4 * _TB
             and fused_topk_supported(algorithm, k, nt, n_num, n_cat,
-                                     scale))
+                                     scale, m_ax=m_ax))
 
 
 def _make_kernel(F: int, Ccat: int, cat_w: tuple, wsum: float, scale: int,
@@ -141,7 +148,12 @@ def _make_kernel(F: int, Ccat: int, cat_w: tuple, wsum: float, scale: int,
         if cat_acc is not None:
             parts = cat_acc if parts is None else parts + cat_acc
         d = jnp.sqrt(parts / wsum)
-        di = (d * scale).astype(jnp.int32)           # [QB, TB]
+        # clamp before the int cast: padded candidate rows (huge fill
+        # values on 2-D meshes) and genuinely-overflowing distances land
+        # at a defined huge int (>= the packing budget, so stage 2 drops
+        # them) instead of an undefined float->int cast
+        di = jnp.minimum(d * scale,
+                         jnp.float32(2147483392.0)).astype(jnp.int32)
 
         base = j * _TB
         for s in range(_TB // _L):
@@ -176,12 +188,18 @@ def _build_fused(mesh, nq_pad: int, nt_pad: int, F: int, Ccat: int,
                  cat_w: tuple, wsum: float, scale: int, k: int,
                  nt_true: int, interpret: bool):
     d_ax = mesh.shape["data"]
+    m_ax = mesh.shape["model"]
     nq_loc = nq_pad // d_ax
-    ni, nj = nq_loc // _QB, nt_pad // _TB
+    nt_loc = nt_pad // m_ax
+    ni, nj = nq_loc // _QB, nt_loc // _TB
     idx_bits = max(int(np.ceil(np.log2(max(nt_pad, 2)))), 1)
     val_max = np.int32(1 << (31 - idx_bits))
     idx_mask = np.int32((1 << idx_bits) - 1)
-    kernel = _make_kernel(F, Ccat, cat_w, wsum, scale, nt_true, nj)
+    # on a 2-D mesh each model shard sees its full local extent (padding
+    # rows carry a huge numeric fill that the distance clamp pushes past
+    # the packing budget); on 1-D the kernel masks the tail by index
+    kernel = _make_kernel(F, Ccat, cat_w, wsum, scale,
+                          nt_true if m_ax == 1 else nt_loc, nj)
 
     def local(qn, qc, tn, tc):
         out_sds = [jax.ShapeDtypeStruct((nq_loc, _R * _L), jnp.int32)] * 2
@@ -216,8 +234,16 @@ def _build_fused(mesh, nq_pad: int, nt_pad: int, F: int, Ccat: int,
             )(*args)
 
             # stage 2: pack (value, index) into one int32 so a single
-            # top_k gives ascending lexicographic (value, index) order
-            packed = jnp.where((idxs >= 0) & (vals < val_max),
+            # top_k gives ascending lexicographic (value, index) order.
+            # On a 2-D mesh padding candidates reach the bins (the kernel
+            # cannot see per-shard valid extents); they are identified
+            # here by global index >= nt_true and excluded from the
+            # packing AND from every soundness predicate — they carry the
+            # clamp value, so they can never displace a real candidate
+            off = (jax.lax.axis_index("model") * nt_loc if m_ax > 1
+                   else 0)
+            bin_valid = (idxs >= 0) & (idxs + off < nt_true)
+            packed = jnp.where(bin_valid & (vals < val_max),
                                (vals << idx_bits) | idxs, _SENT)
             neg, _ = jax.lax.top_k(-packed, k)
             sel = -neg                                   # [nq_loc, k]
@@ -225,24 +251,53 @@ def _build_fused(mesh, nq_pad: int, nt_pad: int, F: int, Ccat: int,
             sel_i = jnp.where(sel == _SENT, -1, sel & idx_mask)
 
             # soundness check: a lost top-k element forces some bin's
-            # bottom register <= theta (see module docstring)
+            # bottom register <= theta (see module docstring); on a 2-D
+            # mesh the check runs per model shard against the shard's own
+            # local theta — the global top-k is a subset of the union of
+            # EXACT local top-ks, so any-shard-suspect covers every loss
             theta = sel_v[:, k - 1:k]
             tie_sel = jnp.where(sel_v == theta, sel_i, -1)
             imax = jnp.max(tie_sel, axis=1, keepdims=True)
             bot_v = vals[:, (_R - 1) * _L:]
             bot_i = idxs[:, (_R - 1) * _L:]
-            lost = (bot_v < theta) | ((bot_v == theta) & (bot_i <= imax)
-                                      & (bot_i >= 0))
+            bot_valid = bin_valid[:, (_R - 1) * _L:]
+            lost = bot_valid & ((bot_v < theta)
+                                | ((bot_v == theta) & (bot_i <= imax)))
+            # an under-filled selection is only suspicious when candidates
+            # were EXCLUDED by the packing budget (value overflow); a
+            # shard that simply holds fewer than k valid candidates (e.g.
+            # an all-padding model shard) has them all present and exact
+            overflow = jnp.any(bin_valid & (vals >= val_max), axis=1)
             suspect = (jnp.any(lost, axis=1)
-                       | (sel_v[:, k - 1] == _SENT))
-            return sel_v, sel_i, suspect
+                       | ((sel_v[:, k - 1] == _SENT) & overflow))
+            if m_ax == 1:
+                return sel_v, sel_i, suspect
 
+            # merge across model shards: re-pack with GLOBAL candidate
+            # indices (tie order = global lowest-index), gather k*m
+            # candidates, exact top-k; every shard computes the identical
+            # merge, so pmax marks the outputs model-invariant
+            gidx = sel_i + jax.lax.axis_index("model") * nt_loc
+            packed_g = jnp.where((sel_i >= 0) & (sel_v < val_max),
+                                 (sel_v << idx_bits) | gidx, _SENT)
+            allp = jax.lax.all_gather(packed_g, "model", axis=1,
+                                      tiled=True)       # [nq_loc, k*m]
+            neg_g, _ = jax.lax.top_k(-allp, k)
+            sel_g = -neg_g
+            gv = jnp.where(sel_g == _SENT, _SENT, sel_g >> idx_bits)
+            gi = jnp.where(sel_g == _SENT, -1, sel_g & idx_mask)
+            sus = jax.lax.pmax(suspect.astype(jnp.int32), "model") > 0
+            sus = sus | (gv[:, k - 1] == _SENT)
+            return (jax.lax.pmax(gv, "model"), jax.lax.pmax(gi, "model"),
+                    sus)
+
+    t_spec = P("model") if m_ax > 1 else P()
     # check_vma off: the interpret-mode Pallas body mixes shard-varying
     # tile data with unvarying iota/scratch and trips the static vma
-    # checker; there are no collectives here and out_specs are explicit
+    # checker; the only collectives are the explicit model-axis merge ops
     return jax.jit(shard_map(
         local, mesh=mesh,
-        in_specs=(P("data"), P("data"), P(), P()),
+        in_specs=(P("data"), P("data"), t_spec, t_spec),
         out_specs=(P("data"), P("data"), P("data")),
         check_vma=False))
 
@@ -265,17 +320,26 @@ def fused_pairwise_topk(qnum: np.ndarray, qcat: np.ndarray,
     """
     mesh = mesh or get_mesh()
     d_ax = mesh.shape["data"]
+    m_ax = mesh.shape["model"]
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     nq, nt = qnum.shape[0], tnum.shape[0]
     F, Ccat = qnum.shape[1], qcat.shape[1]
+    if m_ax > 1 and F == 0:
+        raise ValueError("2-D-mesh fused top-k needs a numeric column "
+                         "(padding rows are excluded by distance, not "
+                         "index) — use the sorted engine")
 
     qnum_p, _ = pad_rows(qnum.astype(np.float32), d_ax * _QB)
     qcat_p, _ = pad_rows(qcat.astype(np.int32), d_ax * _QB)
-    tnum_p, _ = pad_rows(tnum.astype(np.float32), _TB)
-    # pad categorical codes with -2: != any query code (missing is -1),
-    # but candidate padding is masked by global index in-kernel anyway
-    tcat_p, _ = pad_rows(tcat.astype(np.int32), _TB, fill=-2)
+    # 1-D: candidate padding is masked by global index in-kernel.  2-D:
+    # every model shard sees its full local extent, so padding rows carry
+    # a huge numeric fill whose clamped distance exceeds the packing
+    # budget — stage 2 drops them without any per-shard index bound
+    t_fill = 0 if m_ax == 1 else 1e15
+    tnum_p, _ = pad_rows(tnum.astype(np.float32), m_ax * _TB, fill=t_fill)
+    # categorical pads: -2 != any query code (missing is -1)
+    tcat_p, _ = pad_rows(tcat.astype(np.int32), m_ax * _TB, fill=-2)
     if F == 0:
         qnum_p = np.zeros((qnum_p.shape[0], 1), np.float32)
         tnum_p = np.zeros((tnum_p.shape[0], 1), np.float32)

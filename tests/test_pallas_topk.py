@@ -78,6 +78,29 @@ def test_fused_adversarial_overflow_falls_back(mesh1):
     assert suspect.all()
 
 
+def test_fused_fallback_with_nonunit_weights(mesh1):
+    """Regression: suspect rows re-resolve through the sort engine with
+    the UNFOLDED operands — a folded tnum would double-apply the
+    attribute weights, so fallback rows came back with distances that
+    matched no real candidate (caught only with weights != 1)."""
+    L = pallas_topk._L
+    nt = 4096
+    rng = np.random.default_rng(11)
+    tn = rng.uniform(5, 6, (nt, 3)).astype(np.float32)
+    tn[np.arange(0, nt, L)[:12]] = 0.25      # 12 > R near-rows in bin 0
+    qn = np.zeros((16, 3), np.float32)
+    ecat = np.zeros((16, 0), np.int32)
+    ecat_t = np.zeros((nt, 0), np.int32)
+    w = np.asarray([0.3, 1.7, 2.4])          # non-unit: folding matters
+    cw0 = np.zeros(0)
+    _both(mesh1, qn, ecat, tn, ecat_t, w, cw0, top_k=8)
+    from avenir_tpu.ops.distance import _fold_weights
+    qf, tf, wsum = _fold_weights(qn, tn, w, cw0, "euclidean")
+    _, _, suspect = pallas_topk.fused_pairwise_topk(
+        qf, ecat, tf, ecat_t, cw0, wsum, 1000, 8, mesh=mesh1)
+    assert suspect.all()
+
+
 def test_fused_benign_data_no_fallback(mesh1):
     """On spread-out data the soundness check should almost never fire
     (the fast path must actually be the fast path)."""
@@ -87,6 +110,59 @@ def test_fused_benign_data_no_fallback(mesh1):
     _, _, suspect = pallas_topk.fused_pairwise_topk(
         qf, qc, tf, tc, cw, wsum, 1000, 8, mesh=mesh1)
     assert suspect.sum() <= 2
+
+
+def _assert_fused_really_ran(qn, qc, tn, tc, nw, cw, k, mesh):
+    """Guard against vacuous passes: if every row were suspect, the
+    public API would return pure sorted-engine output and the merge path
+    would go untested (this happened when padding shards tripped the
+    under-fill check)."""
+    from avenir_tpu.ops.distance import _fold_weights
+
+    qf, tf, wsum = _fold_weights(qn, tn, nw, cw, "euclidean")
+    _, _, suspect = pallas_topk.fused_pairwise_topk(
+        qf, qc, tf, tc, cw, wsum, 1000, k, mesh=mesh)
+    assert suspect.mean() < 0.5, "fused engine fell back on most rows"
+
+
+def test_fused_2d_mesh_matches_sorted(mesh8):
+    """Candidates sharded over the model axis: per-shard fused top-k +
+    packed all-gather merge must equal the sorted engine bit-for-bit
+    (global lowest-index tie order included) — including meshes whose
+    padding leaves some model shards partially or entirely empty."""
+    from avenir_tpu.parallel import make_mesh
+
+    qn, qc, tn, tc, nw, cw = _rand(96, 1111, 5, 2, seed=7)
+    for data, model in ((4, 2), (2, 4), (1, 8)):
+        mesh2 = make_mesh(data=data, model=model)
+        _both(mesh2, qn, qc, tn, tc, nw, cw, top_k=7)
+        _assert_fused_really_ran(qn, qc, tn, tc, nw, cw, 7, mesh2)
+
+
+def test_fused_2d_mesh_ties(mesh8):
+    from avenir_tpu.parallel import make_mesh
+
+    qn, qc, tn, tc, nw, cw = _rand(40, 150, 4, 0, seed=8)
+    tn2 = np.repeat(tn, 5, axis=0)
+    tc2 = np.repeat(tc, 5, axis=0)
+    mesh2 = make_mesh(data=2, model=4)
+    _both(mesh2, qn, qc, tn2, tc2, nw, cw, top_k=9)
+    _assert_fused_really_ran(qn, qc, tn2, tc2, nw, cw, 9, mesh2)
+
+
+def test_fused_2d_pure_categorical_uses_sorted(mesh8):
+    # no numeric column -> the auto path must silently keep the sorted
+    # engine on 2-D meshes, and forcing 'fused' must fail loudly
+    from avenir_tpu.parallel import make_mesh
+
+    _, qc, _, tc, _, cw = _rand(16, 64, 0, 3, seed=9)
+    e = np.zeros((16, 0), np.float32)
+    et = np.zeros((64, 0), np.float32)
+    mesh2 = make_mesh(data=4, model=2)
+    pairwise_distances(e, qc, et, tc, np.zeros(0), cw, top_k=3, mesh=mesh2)
+    with pytest.raises(ValueError):
+        pairwise_distances(e, qc, et, tc, np.zeros(0), cw, top_k=3,
+                           mesh=mesh2, topk_method="fused")
 
 
 def test_fused_gates():
