@@ -327,15 +327,18 @@ def fused_pairwise_topk(qnum: np.ndarray, qcat: np.ndarray,
     F, Ccat = qnum.shape[1], qcat.shape[1]
     if m_ax > 1 and F == 0:
         raise ValueError("2-D-mesh fused top-k needs a numeric column "
-                         "(padding rows are excluded by distance, not "
-                         "index) — use the sorted engine")
+                         "(the huge pad fill keeps padding out of the "
+                         "bins' way; stage 2 then drops it by index) — "
+                         "use the sorted engine")
 
     qnum_p, _ = pad_rows(qnum.astype(np.float32), d_ax * _QB)
     qcat_p, _ = pad_rows(qcat.astype(np.int32), d_ax * _QB)
     # 1-D: candidate padding is masked by global index in-kernel.  2-D:
-    # every model shard sees its full local extent, so padding rows carry
-    # a huge numeric fill whose clamped distance exceeds the packing
-    # budget — stage 2 drops them without any per-shard index bound
+    # every model shard sees its full local extent; padding rows carry a
+    # huge numeric fill so they cannot displace real candidates from the
+    # bins, and stage 2 AUTHORITATIVELY excludes them by per-shard index
+    # bound (bin_valid) — the fill is a no-displacement guarantee, not
+    # the exclusion mechanism
     t_fill = 0 if m_ax == 1 else 1e15
     tnum_p, _ = pad_rows(tnum.astype(np.float32), m_ax * _TB, fill=t_fill)
     # categorical pads: -2 != any query code (missing is -1)

@@ -183,3 +183,37 @@ def test_fused_forced_unsupported_raises(mesh1):
     with pytest.raises(ValueError):
         pairwise_distances(qn, qc, tn, tc, nw, cw, top_k=4, mesh=mesh1,
                            algorithm="manhattan", topk_method="fused")
+
+
+def test_fused_fuzz_vs_sorted(mesh8, mesh1):
+    """Bounded fuzz: random shapes, weights, duplicate rows, categorical
+    mixes, ks, and meshes — fused must equal sorted bit-for-bit every
+    time (the fallback keeps adversarial draws exact)."""
+    from avenir_tpu.parallel import make_mesh
+
+    rng = np.random.default_rng(123)
+
+    for trial in range(12):
+        nq = int(rng.integers(1, 200))
+        nt = int(rng.integers(1, 3000))
+        F = int(rng.integers(0, 6))
+        C = int(rng.integers(0, 3)) if F else int(rng.integers(1, 3))
+        k = int(rng.integers(1, 12))
+        qn = rng.uniform(0, 1, (nq, F)).astype(np.float32)
+        tn = rng.uniform(0, 1, (nt, F)).astype(np.float32)
+        qc = rng.integers(0, 3, (nq, C)).astype(np.int32)
+        tc = rng.integers(0, 3, (nt, C)).astype(np.int32)
+        if trial % 3 == 0 and nt >= 8:     # heavy duplication -> ties
+            tn = np.repeat(tn[: max(nt // 8, 1)], 8, axis=0)[:nt]
+            tc = np.repeat(tc[: max(nt // 8, 1)], 8, axis=0)[:nt]
+        nw = rng.uniform(0.2, 3.0, F)
+        cw = rng.uniform(0.2, 3.0, C)
+        mesh = [mesh8, mesh1, make_mesh(data=2, model=4)][trial % 3]
+        if F == 0 and mesh.shape["model"] > 1:
+            continue                      # fused gated off: nothing to A/B
+        vr, ir = pairwise_distances(qn, qc, tn, tc, nw, cw, top_k=k,
+                                    mesh=mesh, topk_method="sorted")
+        vf, if_ = pairwise_distances(qn, qc, tn, tc, nw, cw, top_k=k,
+                                     mesh=mesh, topk_method="fused")
+        np.testing.assert_array_equal(vr, vf, err_msg=f"trial {trial}")
+        np.testing.assert_array_equal(ir, if_, err_msg=f"trial {trial}")
