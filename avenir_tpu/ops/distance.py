@@ -126,12 +126,44 @@ def _fold_weights(qnum, tnum, num_weights, cat_weights, algorithm):
 
 _ring_cache: dict = {}
 
+def _merge_bins(cv, ci, hv, hi, L, R):
+    """Merge two per-bin sorted-R register sets into one: an odd-even
+    merge network over the 2R candidates per bin keeps the R smallest,
+    with ties preferring the first (carry = earlier ring arrival)
+    operand.  O(R log R) compare-exchanges on [n, L] lanes — no sort."""
+    vs = [cv[:, r * L:(r + 1) * L] for r in range(R)] + \
+         [hv[:, r * L:(r + 1) * L] for r in range(R)]
+    is_ = [ci[:, r * L:(r + 1) * L] for r in range(R)] + \
+          [hi[:, r * L:(r + 1) * L] for r in range(R)]
+
+    def cmpx(a, b):
+        # stable compare-exchange: position a keeps priority on ties
+        swap = vs[b] < vs[a]
+        vs[a], vs[b] = (jnp.where(swap, vs[b], vs[a]),
+                        jnp.where(swap, vs[a], vs[b]))
+        is_[a], is_[b] = (jnp.where(swap, is_[b], is_[a]),
+                          jnp.where(swap, is_[a], is_[b]))
+
+    # Batcher odd-even merge of two sorted 4-lists (indices 0-3 | 4-7);
+    # for other R fall back to pairwise bubble merge (still O(R^2) wheres)
+    if R == 4:
+        for a, b in ((0, 4), (1, 5), (2, 6), (3, 7),
+                     (2, 4), (3, 5), (1, 2), (3, 4), (5, 6)):
+            cmpx(a, b)
+    else:
+        for i in range(R):
+            for a in range(2 * R - 1 - i):
+                cmpx(a, a + 1)
+    return (jnp.concatenate(vs[:R], axis=1),
+            jnp.concatenate(is_[:R], axis=1))
+
 
 def pairwise_topk_ring(qnum: np.ndarray, qcat: np.ndarray,
                        tnum: np.ndarray, tcat: np.ndarray,
                        num_weights: np.ndarray, cat_weights: np.ndarray,
                        k: int, algorithm: str = "euclidean",
-                       scale: int = 1000, mesh=None
+                       scale: int = 1000, mesh=None,
+                       selection: str = "auto"
                        ) -> Tuple[np.ndarray, np.ndarray]:
     """Per-query k nearest training rows with BOTH operands sharded.
 
@@ -142,21 +174,69 @@ def pairwise_topk_ring(qnum: np.ndarray, qcat: np.ndarray,
     (one neighbor hop per step, the bandwidth-optimal all-to-all of the
     scaling-book recipe): each device computes its [nq_local, nt/d]
     distance tile against the resident block while the next block is in
-    flight, folding the tile into a running top-k.  Neither the n^2
+    flight, folding the tile into a running selection.  Neither the n^2
     distance matrix nor the full training matrix ever exists on one chip.
 
+    ``selection='bins'`` (the ``auto`` default when the packing budget
+    allows) carries the fused engine's binned running minima across hops
+    instead of sorting per hop — per-hop cost drops from a chunked
+    ``top_k`` over the tile to ~20 elementwise ops/candidate, which is
+    what makes large per-hop tiles (nt/d in the 10k+ range) viable.  The
+    final k are selected from the L*R survivors by one narrow packed
+    ``top_k``; a value-exactness check (any bin's bottom register below
+    the selected k-th value, or packing-budget starvation) re-resolves
+    flagged rows through the broadcast engine, so returned DISTANCES are
+    always the true k smallest.  ``selection='sort'`` keeps the per-hop
+    chunked top-k.
+
     Returns host ``(dist[nq, k], idx[nq, k])`` with global training-row
-    indices, ascending by distance.  Among equal distances the order
-    reflects ring arrival, not global index order (the broadcast engine's
-    tie order) — callers needing exact tie parity use
-    ``pairwise_distances``.
+    indices, ascending by distance.  Among equal distances the returned
+    indices reflect ring arrival / bin retention, not global index order
+    (the broadcast engine's tie order) — callers needing exact tie
+    parity use ``pairwise_distances``.
     """
     mesh = mesh or get_mesh()
     d = mesh.shape["data"]
     nq, nt = qnum.shape[0], tnum.shape[0]
     k = min(k, nt)
+    qnum0, qcat0, tnum0 = qnum, qcat, tnum
     qnum, tnum, wsum = _fold_weights(qnum, tnum, num_weights, cat_weights,
                                      algorithm)
+    from .pallas_topk import _TB, fused_topk_applicable, fused_topk_supported
+    nt_pad_est = -(-max(nt, 1) // (d * _TB)) * d * _TB
+    idx_bits = max(int(np.ceil(np.log2(max(nt_pad_est, 2)))), 1)
+    if selection == "auto":
+        # same gates as the broadcast fused engine (hard shape/VMEM caps
+        # via supported(), backend + size heuristics via applicable()),
+        # with the padded extent from the ring's d*TB layout
+        selection = ("bins" if (qnum.shape[1] > 0
+                                and fused_topk_applicable(
+                                    algorithm, k, nq, nt, qnum.shape[1],
+                                    qcat.shape[1], scale, m_ax=d))
+                     else "sort")
+    if selection == "bins":
+        if qnum.shape[1] == 0 or not fused_topk_supported(
+                algorithm, k, nt, qnum.shape[1], qcat.shape[1], scale,
+                m_ax=d):
+            raise ValueError("ring selection='bins' needs the euclidean "
+                             "MXU kernel, a numeric column, and shapes "
+                             "inside the fused engine's caps; use "
+                             "selection='sort'")
+        vals, idxs, suspect = _ring_bins(
+            qnum, qcat, tnum, tcat, cat_weights, wsum, k, algorithm,
+            scale, mesh, nt, idx_bits)
+        bad = np.flatnonzero(suspect)
+        if bad.size:
+            vals, idxs = np.array(vals), np.array(idxs)
+            vb, ib = pairwise_distances(
+                qnum0[bad], qcat0[bad], tnum0, tcat, num_weights,
+                cat_weights, algorithm=algorithm, scale=scale, top_k=k,
+                mesh=mesh, topk_method="sorted")
+            vals[bad], idxs[bad] = vb, ib
+        return vals, idxs
+    if selection != "sort":
+        raise ValueError(f"unknown ring selection {selection!r}; "
+                         "use 'auto', 'bins' or 'sort'")
     qnum_p, _ = pad_rows(qnum, d)
     qcat_p, _ = pad_rows(qcat, d)
     tnum_p, tmask = pad_rows(tnum, d)
@@ -216,6 +296,106 @@ def pairwise_topk_ring(qnum: np.ndarray, qcat: np.ndarray,
     dist, idx = fn(qnum_p, qcat_p, tnum_p, tcat_p.astype(np.int32),
                    jnp.asarray(tmask), cat_weights.astype(np.float32))
     return np.asarray(dist)[:nq], np.asarray(idx)[:nq]
+
+
+_ring_bins_cache: dict = {}
+
+
+def _ring_bins(qnum, qcat, tnum, tcat, cat_weights, wsum, k, algorithm,
+               scale, mesh, nt_true, idx_bits):
+    """Sort-free ring selection: each hop runs the fused Pallas kernel on
+    the resident tile (bins built in VMEM, the same MXU+binned-minima
+    pass as the broadcast engine) and merges the hop's bins into the
+    carried bins with an O(R log R) compare-exchange network — no sort
+    anywhere in the hop loop.
+
+    Value-exactness argument (tie INDICES keep arrival/merge order, per
+    the ring's documented contract): per bin the structure always holds
+    the R smallest values seen (kernel bins are exact per tile; merging
+    two exact sets is exact), so a true-top-k element strictly below the
+    k-th value theta can only be missing if its bin's R survivors are
+    all <= it — flagged by ``bottom register < theta``.  Elements EQUAL
+    to theta always survive in sufficient multiplicity (L*R >= k, the
+    multiset argument in ops/pallas_topk.py), so the returned DISTANCES
+    are the true k smallest; flagged rows re-resolve via the broadcast
+    engine."""
+    from . import pallas_topk as pt
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    d = mesh.shape["data"]
+    nq, nt = qnum.shape[0], tnum.shape[0]
+    L, R = pt._L, pt._R
+    F, Ccat = qnum.shape[1], qcat.shape[1]
+    interpret = jax.default_backend() != "tpu"
+    qnum_p, _ = pad_rows(qnum.astype(np.float32), d * pt._QB)
+    qcat_p, _ = pad_rows(qcat.astype(np.int32), d * pt._QB)
+    # padding candidate rows carry a huge fill: their clamped distance
+    # exceeds the packing budget and the final selection also drops them
+    # by global index (same two-layer scheme as the 2-D fused engine)
+    tnum_p, _ = pad_rows(tnum.astype(np.float32), d * pt._TB, fill=1e15)
+    tcat_p, _ = pad_rows(tcat.astype(np.int32), d * pt._TB, fill=-2)
+    m = tnum_p.shape[0] // d
+    sentinel = np.int32(np.iinfo(np.int32).max)
+    val_max = np.int32(1 << (31 - idx_bits))
+    idx_mask = np.int32((1 << idx_bits) - 1)
+
+    key = (mesh, algorithm, scale, k, wsum, qnum_p.shape, qcat_p.shape,
+           tnum_p.shape, tcat_p.shape, nt_true,
+           tuple(np.asarray(cat_weights, np.float32)), interpret)
+    fn = _ring_bins_cache.get(key)
+    if fn is None:
+        n_loc = qnum_p.shape[0] // d
+        ni, nj = n_loc // pt._QB, m // pt._TB
+        kernel = pt._make_kernel(
+            F, Ccat, tuple(float(w) for w in
+                           np.asarray(cat_weights, np.float32)),
+            wsum, scale, m, nj)
+
+        def hop_bins(qn, qc, tn_b, tc_b):
+            return pt._bins_pallas_call(kernel, qn, qc, tn_b, tc_b, F,
+                                        Ccat, ni, nj, n_loc, interpret)
+
+        def local(qn, qc, tn, tc):
+            r = jax.lax.axis_index("data")
+            perm = [((i + 1) % d, i) for i in range(d)]
+
+            def step(s, carry):
+                tn_b, tc_b, cv, ci = carry
+                owner = (r + s) % d
+                hv, hi = hop_bins(qn, qc, tn_b, tc_b)
+                hi = jnp.where(hi >= 0, hi + owner * m, -1)
+                cv, ci = _merge_bins(cv, ci, hv, hi, L, R)
+
+                def rotate(blocks):
+                    return tuple(jax.lax.ppermute(b, "data", perm)
+                                 for b in blocks)
+
+                tn_b, tc_b = jax.lax.cond(
+                    s < d - 1, rotate, lambda b: b, (tn_b, tc_b))
+                return (tn_b, tc_b, cv, ci)
+
+            zero = (qn.sum() + qc.sum()).astype(jnp.int32) * 0
+            cv0 = jnp.full((qn.shape[0], R * L), sentinel, jnp.int32) + zero
+            ci0 = jnp.full((qn.shape[0], R * L), -1, jnp.int32) + zero
+            out = jax.lax.fori_loop(0, d, step, (tn, tc, cv0, ci0))
+            binv, bini = out[2], out[3]
+
+            # value-only contract: no tie-index term in the check
+            valid = (bini >= 0) & (bini < nt_true)
+            return pt.select_and_check(binv, bini, valid, k, idx_bits,
+                                       check_tie_index=False)
+
+        fn = jax.jit(shard_map(
+            local, mesh=mesh,
+            in_specs=(P("data"), P("data"), P("data"), P("data")),
+            out_specs=(P("data"), P("data"), P("data")),
+            check_vma=False))
+        _ring_bins_cache[key] = fn
+
+    vals, idxs, suspect = fn(qnum_p, qcat_p, tnum_p, tcat_p)
+    return (np.asarray(vals)[:nq], np.asarray(idxs)[:nq],
+            np.asarray(suspect)[:nq])
 
 
 def pairwise_distances(qnum: np.ndarray, qcat: np.ndarray,

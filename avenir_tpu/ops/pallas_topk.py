@@ -184,6 +184,78 @@ def _make_kernel(F: int, Ccat: int, cat_w: tuple, wsum: float, scale: int,
     return kernel
 
 
+def _bins_pallas_call(kernel, qn, qc, tn, tc, F: int, Ccat: int,
+                      ni: int, nj: int, nq_loc: int, interpret: bool):
+    """Invoke the bins kernel with the F/Ccat-conditional operand
+    plumbing (unused dummy blocks crash Mosaic) — shared by the
+    broadcast engine and the ring's per-hop call."""
+    in_specs, args = [], []
+    if F:
+        in_specs += [pl.BlockSpec((_QB, F), lambda i, j: (i, 0),
+                                  memory_space=pltpu.VMEM),
+                     pl.BlockSpec((_TB, F), lambda i, j: (j, 0),
+                                  memory_space=pltpu.VMEM)]
+        args += [qn, tn]
+    if Ccat:
+        in_specs += [pl.BlockSpec((_QB, Ccat), lambda i, j: (i, 0),
+                                  memory_space=pltpu.VMEM),
+                     pl.BlockSpec((_TB, Ccat), lambda i, j: (j, 0),
+                                  memory_space=pltpu.VMEM)]
+        args += [qc, tc]
+    with jax.enable_x64(False):
+        return pl.pallas_call(
+            kernel, grid=(ni, nj),
+            in_specs=in_specs,
+            out_specs=[pl.BlockSpec((_QB, _R * _L), lambda i, j: (i, 0),
+                                    memory_space=pltpu.VMEM)] * 2,
+            out_shape=[jax.ShapeDtypeStruct((nq_loc, _R * _L),
+                                            jnp.int32)] * 2,
+            scratch_shapes=[pltpu.VMEM((_QB, _R * _L), jnp.int32),
+                            pltpu.VMEM((_QB, _R * _L), jnp.int32)],
+            interpret=interpret,
+        )(*args)
+
+
+def select_and_check(vals, idxs, valid, k: int, idx_bits: int,
+                     check_tie_index: bool):
+    """Stage 2 + soundness check over a [n, R*L] bins structure — ONE
+    authoritative copy shared by the broadcast engine and the ring.
+
+    Packs (value << idx_bits | index) so a single narrow ``top_k`` gives
+    ascending lexicographic (value, index) order; ``valid`` masks bin
+    entries that must not participate (unfilled registers, padding rows
+    identified by index bound).  Returns ``(sel_v, sel_i, suspect)``
+    where suspect flags every row whose selection could be wrong: a
+    bottom register strictly below theta (a displaced better candidate),
+    with ``check_tie_index`` additionally flagging a possibly-displaced
+    LOWER-INDEX tie at theta (needed for the broadcast engine's
+    lowest-index tie contract; the ring's value-only contract skips it),
+    or an under-filled selection when candidates were excluded by the
+    packing budget."""
+    val_max = np.int32(1 << (31 - idx_bits))
+    idx_mask = np.int32((1 << idx_bits) - 1)
+    packed = jnp.where(valid & (vals < val_max),
+                       (vals << idx_bits) | idxs, _SENT)
+    neg, _ = jax.lax.top_k(-packed, k)
+    sel = -neg
+    sel_v = jnp.where(sel == _SENT, _SENT, sel >> idx_bits)
+    sel_i = jnp.where(sel == _SENT, -1, sel & idx_mask)
+
+    theta = sel_v[:, k - 1:k]
+    bot_v = vals[:, (_R - 1) * _L:]
+    bot_valid = valid[:, (_R - 1) * _L:]
+    lost = bot_valid & (bot_v < theta)
+    if check_tie_index:
+        bot_i = idxs[:, (_R - 1) * _L:]
+        tie_sel = jnp.where(sel_v == theta, sel_i, -1)
+        imax = jnp.max(tie_sel, axis=1, keepdims=True)
+        lost = lost | (bot_valid & (bot_v == theta) & (bot_i <= imax))
+    overflow = jnp.any(valid & (vals >= val_max), axis=1)
+    suspect = (jnp.any(lost, axis=1)
+               | ((sel_v[:, k - 1] == _SENT) & overflow))
+    return sel_v, sel_i, suspect
+
+
 def _build_fused(mesh, nq_pad: int, nt_pad: int, F: int, Ccat: int,
                  cat_w: tuple, wsum: float, scale: int, k: int,
                  nt_true: int, interpret: bool):
@@ -202,94 +274,40 @@ def _build_fused(mesh, nq_pad: int, nt_pad: int, F: int, Ccat: int,
                           nt_true if m_ax == 1 else nt_loc, nj)
 
     def local(qn, qc, tn, tc):
-        out_sds = [jax.ShapeDtypeStruct((nq_loc, _R * _L), jnp.int32)] * 2
-        in_specs, args = [], []
-        if F:
-            in_specs += [pl.BlockSpec((_QB, F), lambda i, j: (i, 0),
-                                      memory_space=pltpu.VMEM),
-                         pl.BlockSpec((_TB, F), lambda i, j: (j, 0),
-                                      memory_space=pltpu.VMEM)]
-            args += [qn, tn]
-        if Ccat:
-            in_specs += [pl.BlockSpec((_QB, Ccat), lambda i, j: (i, 0),
-                                      memory_space=pltpu.VMEM),
-                         pl.BlockSpec((_TB, Ccat), lambda i, j: (j, 0),
-                                      memory_space=pltpu.VMEM)]
-            args += [qc, tc]
-        with jax.enable_x64(False):
-            vals, idxs = pl.pallas_call(
-                kernel,
-                grid=(ni, nj),
-                in_specs=in_specs,
-                out_specs=[
-                    pl.BlockSpec((_QB, _R * _L), lambda i, j: (i, 0),
-                                 memory_space=pltpu.VMEM),
-                    pl.BlockSpec((_QB, _R * _L), lambda i, j: (i, 0),
-                                 memory_space=pltpu.VMEM),
-                ],
-                out_shape=out_sds,
-                scratch_shapes=[pltpu.VMEM((_QB, _R * _L), jnp.int32),
-                                pltpu.VMEM((_QB, _R * _L), jnp.int32)],
-                interpret=interpret,
-            )(*args)
+        vals, idxs = _bins_pallas_call(kernel, qn, qc, tn, tc, F, Ccat,
+                                       ni, nj, nq_loc, interpret)
+        # On a 2-D mesh padding candidates reach the bins (the kernel
+        # cannot see per-shard valid extents); they are identified by
+        # global index >= nt_true and excluded from the packing AND from
+        # every soundness predicate — they carry the clamp value, so
+        # they can never displace a real candidate.  On a 2-D mesh the
+        # check runs per model shard against the shard's own local
+        # theta: the global top-k is a subset of the union of EXACT
+        # local top-ks, so any-shard-suspect covers every loss.
+        off = (jax.lax.axis_index("model") * nt_loc if m_ax > 1 else 0)
+        bin_valid = (idxs >= 0) & (idxs + off < nt_true)
+        sel_v, sel_i, suspect = select_and_check(
+            vals, idxs, bin_valid, k, idx_bits, check_tie_index=True)
+        if m_ax == 1:
+            return sel_v, sel_i, suspect
 
-            # stage 2: pack (value, index) into one int32 so a single
-            # top_k gives ascending lexicographic (value, index) order.
-            # On a 2-D mesh padding candidates reach the bins (the kernel
-            # cannot see per-shard valid extents); they are identified
-            # here by global index >= nt_true and excluded from the
-            # packing AND from every soundness predicate — they carry the
-            # clamp value, so they can never displace a real candidate
-            off = (jax.lax.axis_index("model") * nt_loc if m_ax > 1
-                   else 0)
-            bin_valid = (idxs >= 0) & (idxs + off < nt_true)
-            packed = jnp.where(bin_valid & (vals < val_max),
-                               (vals << idx_bits) | idxs, _SENT)
-            neg, _ = jax.lax.top_k(-packed, k)
-            sel = -neg                                   # [nq_loc, k]
-            sel_v = jnp.where(sel == _SENT, _SENT, sel >> idx_bits)
-            sel_i = jnp.where(sel == _SENT, -1, sel & idx_mask)
-
-            # soundness check: a lost top-k element forces some bin's
-            # bottom register <= theta (see module docstring); on a 2-D
-            # mesh the check runs per model shard against the shard's own
-            # local theta — the global top-k is a subset of the union of
-            # EXACT local top-ks, so any-shard-suspect covers every loss
-            theta = sel_v[:, k - 1:k]
-            tie_sel = jnp.where(sel_v == theta, sel_i, -1)
-            imax = jnp.max(tie_sel, axis=1, keepdims=True)
-            bot_v = vals[:, (_R - 1) * _L:]
-            bot_i = idxs[:, (_R - 1) * _L:]
-            bot_valid = bin_valid[:, (_R - 1) * _L:]
-            lost = bot_valid & ((bot_v < theta)
-                                | ((bot_v == theta) & (bot_i <= imax)))
-            # an under-filled selection is only suspicious when candidates
-            # were EXCLUDED by the packing budget (value overflow); a
-            # shard that simply holds fewer than k valid candidates (e.g.
-            # an all-padding model shard) has them all present and exact
-            overflow = jnp.any(bin_valid & (vals >= val_max), axis=1)
-            suspect = (jnp.any(lost, axis=1)
-                       | ((sel_v[:, k - 1] == _SENT) & overflow))
-            if m_ax == 1:
-                return sel_v, sel_i, suspect
-
-            # merge across model shards: re-pack with GLOBAL candidate
-            # indices (tie order = global lowest-index), gather k*m
-            # candidates, exact top-k; every shard computes the identical
-            # merge, so pmax marks the outputs model-invariant
-            gidx = sel_i + jax.lax.axis_index("model") * nt_loc
-            packed_g = jnp.where((sel_i >= 0) & (sel_v < val_max),
-                                 (sel_v << idx_bits) | gidx, _SENT)
-            allp = jax.lax.all_gather(packed_g, "model", axis=1,
-                                      tiled=True)       # [nq_loc, k*m]
-            neg_g, _ = jax.lax.top_k(-allp, k)
-            sel_g = -neg_g
-            gv = jnp.where(sel_g == _SENT, _SENT, sel_g >> idx_bits)
-            gi = jnp.where(sel_g == _SENT, -1, sel_g & idx_mask)
-            sus = jax.lax.pmax(suspect.astype(jnp.int32), "model") > 0
-            sus = sus | (gv[:, k - 1] == _SENT)
-            return (jax.lax.pmax(gv, "model"), jax.lax.pmax(gi, "model"),
-                    sus)
+        # merge across model shards: re-pack with GLOBAL candidate
+        # indices (tie order = global lowest-index), gather k*m
+        # candidates, exact top-k; every shard computes the identical
+        # merge, so pmax marks the outputs model-invariant
+        gidx = sel_i + jax.lax.axis_index("model") * nt_loc
+        packed_g = jnp.where((sel_i >= 0) & (sel_v < val_max),
+                             (sel_v << idx_bits) | gidx, _SENT)
+        allp = jax.lax.all_gather(packed_g, "model", axis=1,
+                                  tiled=True)       # [nq_loc, k*m]
+        neg_g, _ = jax.lax.top_k(-allp, k)
+        sel_g = -neg_g
+        gv = jnp.where(sel_g == _SENT, _SENT, sel_g >> idx_bits)
+        gi = jnp.where(sel_g == _SENT, -1, sel_g & idx_mask)
+        sus = jax.lax.pmax(suspect.astype(jnp.int32), "model") > 0
+        sus = sus | (gv[:, k - 1] == _SENT)
+        return (jax.lax.pmax(gv, "model"), jax.lax.pmax(gi, "model"),
+                sus)
 
     t_spec = P("model") if m_ax > 1 else P()
     # check_vma off: the interpret-mode Pallas body mixes shard-varying

@@ -542,3 +542,71 @@ def test_same_type_similarity_topk_method_config(tmp_path, mesh8):
     with pytest.raises(ValueError, match="top-k method"):
         SameTypeSimilarity(bad).run(str(tmp_path / "inp"),
                                     str(tmp_path / "simi2"), mesh=mesh8)
+
+
+def test_ring_bins_selection_matches_sort(mesh8, mesh1):
+    """The sort-free binned ring selection must return the same DISTANCES
+    as the per-hop-sort ring and the broadcast engine (tie indices may
+    differ — the ring's documented contract)."""
+    from avenir_tpu.ops.distance import pairwise_distances, pairwise_topk_ring
+
+    rng = np.random.default_rng(21)
+    nq, nt, F = 37, 533, 4
+    qn = rng.uniform(0, 10, (nq, F)).astype(np.float32)
+    tn = rng.uniform(0, 10, (nt, F)).astype(np.float32)
+    eq = np.zeros((nq, 0), np.int32)
+    et = np.zeros((nt, 0), np.int32)
+    w, z = rng.uniform(0.5, 2, F), np.zeros(0)
+    for mesh in (mesh8, mesh1):
+        ref_d, _ = pairwise_distances(qn, eq, tn, et, w, z, top_k=6,
+                                      mesh=mesh, topk_method="sorted")
+        for sel in ("bins", "sort"):
+            d, i = pairwise_topk_ring(qn, eq, tn, et, w, z, 6, mesh=mesh,
+                                      selection=sel)
+            np.testing.assert_array_equal(d, ref_d)
+            # returned indices must actually carry the returned distances
+            full, _ = pairwise_distances(qn, eq, tn, et, w, z, mesh=mesh)
+            np.testing.assert_array_equal(
+                np.take_along_axis(full, i, axis=1), d)
+
+
+def test_ring_bins_adversarial_collision_falls_back(mesh8):
+    """All near neighbors at stride-L global indices land in one bin:
+    the value-exactness check must flag and the public result must still
+    be the true k smallest distances."""
+    from avenir_tpu.ops import pallas_topk
+    from avenir_tpu.ops.distance import pairwise_distances, pairwise_topk_ring
+
+    L = pallas_topk._L
+    nt = 2048
+    tn = np.full((nt, 2), 9.0, np.float32)
+    tn[np.arange(0, nt, L)[:12]] = 0.0     # 12 > R ties in bin 0
+    qn = np.zeros((8, 2), np.float32)
+    eq = np.zeros((8, 0), np.int32)
+    et = np.zeros((nt, 0), np.int32)
+    w, z = np.asarray([0.4, 2.2]), np.zeros(0)
+    ref_d, _ = pairwise_distances(qn, eq, tn, et, w, z, top_k=8,
+                                  mesh=mesh8, topk_method="sorted")
+    d, i = pairwise_topk_ring(qn, eq, tn, et, w, z, 8, mesh=mesh8,
+                              selection="bins")
+    np.testing.assert_array_equal(d, ref_d)
+
+
+def test_ring_auto_gate_huge_scale_uses_sort(mesh8):
+    """A scale past the packing budget must silently keep the per-hop
+    sort selection (correct at any scale)."""
+    from avenir_tpu.ops.distance import pairwise_distances, pairwise_topk_ring
+
+    rng = np.random.default_rng(5)
+    qn = rng.uniform(0, 1, (9, 3)).astype(np.float32)
+    tn = rng.uniform(0, 1, (200, 3)).astype(np.float32)
+    eq = np.zeros((9, 0), np.int32)
+    et = np.zeros((200, 0), np.int32)
+    w, z = np.ones(3), np.zeros(0)
+    scale = 1 << 28
+    ref_d, _ = pairwise_distances(qn, eq, tn, et, w, z, top_k=4,
+                                  mesh=mesh8, scale=scale,
+                                  topk_method="sorted")
+    d, _ = pairwise_topk_ring(qn, eq, tn, et, w, z, 4, scale=scale,
+                              mesh=mesh8)
+    np.testing.assert_array_equal(d, ref_d)
