@@ -71,7 +71,10 @@ def _java_int32(x):
     hi = 2147483520.0 if x.dtype == jnp.float32 else 2147483647.0
     x = jnp.where(jnp.isnan(x), 0.0, x)
     out = jnp.clip(x, -2147483648.0, hi).astype(jnp.int32)
-    return jnp.where(x >= hi, np.int32(2**31 - 1), out)
+    # strictly-above-hi pins to MAX_VALUE; x == hi is itself a
+    # representable in-range value whose clip+cast is already exact
+    # (Java (int)2147483520.0f == 2147483520, not MAX_VALUE)
+    return jnp.where(x > hi, np.int32(2**31 - 1), out)
 
 
 def _java_int32_np(x):

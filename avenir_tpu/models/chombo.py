@@ -135,7 +135,9 @@ class Projection:
             groups.setdefault(key, []).append(items)
 
         out = []
-        for key, recs in groups.items():
+        # reducer key-sorted group order, as a single-reducer chombo MR
+        # would emit (keys are text tuples, so lexicographic)
+        for key, recs in sorted(groups.items()):
             # numeric order only when the whole group's orderBy column
             # parses (the documented column-level rule); else
             # lexicographic — which orders ISO dates correctly

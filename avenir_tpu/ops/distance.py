@@ -211,7 +211,7 @@ def pairwise_topk_ring(qnum: np.ndarray, qcat: np.ndarray,
         # with the padded extent from the ring's d*TB layout
         selection = ("bins" if (qnum.shape[1] > 0
                                 and fused_topk_applicable(
-                                    algorithm, k, nq, nt, qnum.shape[1],
+                                    algorithm, k, nt, qnum.shape[1],
                                     qcat.shape[1], scale, m_ax=d))
                      else "sort")
     if selection == "bins":
@@ -291,6 +291,8 @@ def pairwise_topk_ring(qnum: np.ndarray, qcat: np.ndarray,
             in_specs=(P("data"), P("data"), P("data"), P("data"), P("data"),
                       P()),
             out_specs=(P("data"), P("data"))))
+        if len(_ring_cache) >= 4:       # bounded, like _encode_cache
+            _ring_cache.pop(next(iter(_ring_cache)))
         _ring_cache[key] = fn
 
     dist, idx = fn(qnum_p, qcat_p, tnum_p, tcat_p.astype(np.int32),
@@ -391,6 +393,8 @@ def _ring_bins(qnum, qcat, tnum, tcat, cat_weights, wsum, k, algorithm,
             in_specs=(P("data"), P("data"), P("data"), P("data")),
             out_specs=(P("data"), P("data"), P("data")),
             check_vma=False))
+        if len(_ring_bins_cache) >= 4:   # bounded, like _encode_cache
+            _ring_bins_cache.pop(next(iter(_ring_bins_cache)))
         _ring_bins_cache[key] = fn
 
     vals, idxs, suspect = fn(qnum_p, qcat_p, tnum_p, tcat_p)
@@ -444,7 +448,7 @@ def pairwise_distances(qnum: np.ndarray, qcat: np.ndarray,
             raise ValueError("fused top-k not supported for this shape; "
                              "use topk_method='exact'")
         if topk_method == "fused" or fused_topk_applicable(
-                algorithm, k0, nq, nt, n_num, n_cat, scale, m_ax=m_ax):
+                algorithm, k0, nt, n_num, n_cat, scale, m_ax=m_ax):
             vals, idxs, suspect = fused_pairwise_topk(
                 qnum, qcat, tnum, tcat, cat_weights, wsum, scale, k0,
                 mesh=mesh)

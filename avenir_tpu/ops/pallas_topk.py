@@ -90,7 +90,7 @@ def fused_topk_supported(algorithm: str, k: int, nt: int,
             and scale * 8 <= val_budget)
 
 
-def fused_topk_applicable(algorithm: str, k: int, nq: int, nt: int,
+def fused_topk_applicable(algorithm: str, k: int, nt: int,
                           n_num: int, n_cat: int, scale: int,
                           backend: Optional[str] = None,
                           m_ax: int = 1) -> bool:
@@ -377,6 +377,8 @@ def fused_pairwise_topk(qnum: np.ndarray, qcat: np.ndarray,
                           tuple(float(w) for w in
                                 np.asarray(cat_weights, np.float32)),
                           float(wsum), int(scale), int(k), nt, interpret)
+        if len(_fused_cache) >= 4:     # bounded, like _encode_cache
+            _fused_cache.pop(next(iter(_fused_cache)))
         _fused_cache[key] = fn
 
     vals, idxs, suspect = fn(qnum_p, qcat_p, tnum_p, tcat_p)

@@ -175,7 +175,7 @@ def test_fused_gates():
     assert not sup("euclidean", 16, 1 << 18, 8, 2, 10_000)  # packing budget
     # auto gate requires a TPU backend
     assert not pallas_topk.fused_topk_applicable(
-        "euclidean", 16, 1024, 16384, 8, 2, 1000, backend="cpu")
+        "euclidean", 16, 16384, 8, 2, 1000, backend="cpu")
 
 
 def test_fused_forced_unsupported_raises(mesh1):
