@@ -63,6 +63,7 @@ from ..core.config import JobConfig
 from ..core.io import read_lines, split_line, write_output
 from ..core.metrics import Counters
 from ..parallel.mesh import get_mesh, pad_rows
+from ..utils.caches import bounded_cache_get, bounded_cache_put
 
 
 def _fmt_support(v: float) -> str:
@@ -224,13 +225,11 @@ def _encode_transactions(in_path: str, delim_regex: str, skip: int,
         stamp = (st.st_mtime_ns, st.st_size)
     key = (os.path.abspath(in_path), stamp, delim_regex, skip, trans_ord,
            marker)
-    enc = _encode_cache.get(key)
+    enc = bounded_cache_get(_encode_cache, key)
     if enc is None:
         enc = _EncodedTransactions(in_path, delim_regex, skip, trans_ord,
                                    marker)
-        if len(_encode_cache) >= 4:
-            _encode_cache.pop(next(iter(_encode_cache)))
-        _encode_cache[key] = enc
+        bounded_cache_put(_encode_cache, key, enc)
     return enc
 
 
@@ -356,7 +355,7 @@ class FrequentItemsApriori:
 
         inc = None
         ckey = (id(enc), emit_trans_id, mesh, kept.tobytes())
-        cached = _inc_device_cache.get(ckey)
+        cached = bounded_cache_get(_inc_device_cache, ckey)
         if cached is not None and cached[0]() is not enc:
             cached = None                      # id reuse after gc
         if cached is None:
@@ -365,11 +364,10 @@ class FrequentItemsApriori:
             inc_p, mask = pad_rows(inc, d)
             inc_dev = shard_rows(inc_p, mesh)
             mask_dev = shard_rows(mask, mesh)
-            if len(_inc_device_cache) >= 2:
-                _inc_device_cache.pop(next(iter(_inc_device_cache)))
             ref = weakref.ref(
                 enc, lambda _: _inc_device_cache.pop(ckey, None))
-            _inc_device_cache[ckey] = (ref, inc_dev, mask_dev)
+            bounded_cache_put(_inc_device_cache, ckey,
+                              (ref, inc_dev, mask_dev), cap=2)
         else:
             _, inc_dev, mask_dev = cached
         # candidate-axis chunking: keep the [nt, S] indicator block under

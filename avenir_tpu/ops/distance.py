@@ -33,6 +33,7 @@ from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import get_mesh, pad_rows
+from ..utils.caches import bounded_cache_get, bounded_cache_put
 
 _pairwise_cache: dict = {}
 
@@ -202,29 +203,25 @@ def pairwise_topk_ring(qnum: np.ndarray, qcat: np.ndarray,
     qnum0, qcat0, tnum0 = qnum, qcat, tnum
     qnum, tnum, wsum = _fold_weights(qnum, tnum, num_weights, cat_weights,
                                      algorithm)
-    from .pallas_topk import _TB, fused_topk_applicable, fused_topk_supported
-    nt_pad_est = -(-max(nt, 1) // (d * _TB)) * d * _TB
-    idx_bits = max(int(np.ceil(np.log2(max(nt_pad_est, 2)))), 1)
+    from .pallas_topk import fused_topk_applicable, fused_topk_supported
     if selection == "auto":
         # same gates as the broadcast fused engine (hard shape/VMEM caps
-        # via supported(), backend + size heuristics via applicable()),
-        # with the padded extent from the ring's d*TB layout
-        selection = ("bins" if (qnum.shape[1] > 0
-                                and fused_topk_applicable(
-                                    algorithm, k, nt, qnum.shape[1],
-                                    qcat.shape[1], scale, m_ax=d))
+        # via supported(), backend + size heuristics via applicable());
+        # the packing budget is per-shard-segment, so any nt qualifies
+        selection = ("bins" if fused_topk_applicable(
+                        algorithm, k, nt, qnum.shape[1],
+                        qcat.shape[1], scale, m_ax=d)
                      else "sort")
     if selection == "bins":
-        if qnum.shape[1] == 0 or not fused_topk_supported(
+        if not fused_topk_supported(
                 algorithm, k, nt, qnum.shape[1], qcat.shape[1], scale,
                 m_ax=d):
             raise ValueError("ring selection='bins' needs the euclidean "
-                             "MXU kernel, a numeric column, and shapes "
-                             "inside the fused engine's caps; use "
-                             "selection='sort'")
+                             "MXU kernel and shapes inside the fused "
+                             "engine's caps; use selection='sort'")
         vals, idxs, suspect = _ring_bins(
             qnum, qcat, tnum, tcat, cat_weights, wsum, k, algorithm,
-            scale, mesh, nt, idx_bits)
+            scale, mesh, nt)
         bad = np.flatnonzero(suspect)
         if bad.size:
             vals, idxs = np.array(vals), np.array(idxs)
@@ -246,7 +243,7 @@ def pairwise_topk_ring(qnum: np.ndarray, qcat: np.ndarray,
 
     key = (mesh, algorithm, scale, k, wsum, qnum_p.shape, qcat_p.shape,
            tnum_p.shape, tcat_p.shape)
-    fn = _ring_cache.get(key)
+    fn = bounded_cache_get(_ring_cache, key)
     if fn is None:
         def local(qn, qc, tn, tc, tm, wc):
             r = jax.lax.axis_index("data")
@@ -291,9 +288,7 @@ def pairwise_topk_ring(qnum: np.ndarray, qcat: np.ndarray,
             in_specs=(P("data"), P("data"), P("data"), P("data"), P("data"),
                       P()),
             out_specs=(P("data"), P("data"))))
-        if len(_ring_cache) >= 4:       # bounded, like _encode_cache
-            _ring_cache.pop(next(iter(_ring_cache)))
-        _ring_cache[key] = fn
+        bounded_cache_put(_ring_cache, key, fn)
 
     dist, idx = fn(qnum_p, qcat_p, tnum_p, tcat_p.astype(np.int32),
                    jnp.asarray(tmask), cat_weights.astype(np.float32))
@@ -304,12 +299,16 @@ _ring_bins_cache: dict = {}
 
 
 def _ring_bins(qnum, qcat, tnum, tcat, cat_weights, wsum, k, algorithm,
-               scale, mesh, nt_true, idx_bits):
+               scale, mesh, nt_true):
     """Sort-free ring selection: each hop runs the fused Pallas kernel on
-    the resident tile (bins built in VMEM, the same MXU+binned-minima
-    pass as the broadcast engine) and merges the hop's bins into the
-    carried bins with an O(R log R) compare-exchange network — no sort
-    anywhere in the hop loop.
+    the resident tile (packed bins built in VMEM, the same
+    MXU+binned-minima pass as the broadcast engine), unpacks the hop's
+    bins to (value, global index) and merges them into the carried bins
+    with an O(R log R) compare-exchange network — no sort anywhere in
+    the hop loop.  The kernel packs SHARD-LOCAL indices (segmented at
+    ``_SEG`` rows within a hop), so the int32 value budget is computed
+    on the per-shard segment extent and the ring stays alive at
+    millions of global candidate rows.
 
     Value-exactness argument (tie INDICES keep arrival/merge order, per
     the ring's documented contract): per bin the structure always holds
@@ -320,10 +319,9 @@ def _ring_bins(qnum, qcat, tnum, tcat, cat_weights, wsum, k, algorithm,
     to theta always survive in sufficient multiplicity (L*R >= k, the
     multiset argument in ops/pallas_topk.py), so the returned DISTANCES
     are the true k smallest; flagged rows re-resolve via the broadcast
-    engine."""
+    engine.  Rows whose packing budget excluded a real candidate carry
+    the kernel's overflow bit and flag when under-filled."""
     from . import pallas_topk as pt
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     d = mesh.shape["data"]
     nq, nt = qnum.shape[0], tnum.shape[0]
@@ -332,42 +330,64 @@ def _ring_bins(qnum, qcat, tnum, tcat, cat_weights, wsum, k, algorithm,
     interpret = jax.default_backend() != "tpu"
     qnum_p, _ = pad_rows(qnum.astype(np.float32), d * pt._QB)
     qcat_p, _ = pad_rows(qcat.astype(np.int32), d * pt._QB)
-    # padding candidate rows carry a huge fill: their clamped distance
-    # exceeds the packing budget and the final selection also drops them
-    # by global index (same two-layer scheme as the 2-D fused engine)
-    tnum_p, _ = pad_rows(tnum.astype(np.float32), d * pt._TB, fill=1e15)
+    # padding candidate rows are masked authoritatively in-kernel by the
+    # per-hop/per-segment real-row count (the SMEM ``nv`` scalar)
+    tnum_p, _ = pad_rows(tnum.astype(np.float32), d * pt._TB)
     tcat_p, _ = pad_rows(tcat.astype(np.int32), d * pt._TB, fill=-2)
+    if F == 0:
+        qnum_p = np.zeros((qnum_p.shape[0], 1), np.float32)
+        tnum_p = np.zeros((tnum_p.shape[0], 1), np.float32)
+    if Ccat == 0:
+        qcat_p = np.zeros((qcat_p.shape[0], 1), np.int32)
+        tcat_p = np.zeros((tcat_p.shape[0], 1), np.int32)
     m = tnum_p.shape[0] // d
     sentinel = np.int32(np.iinfo(np.int32).max)
-    val_max = np.int32(1 << (31 - idx_bits))
-    idx_mask = np.int32((1 << idx_bits) - 1)
+    seg_ext = pt._seg_extent(m)
+    bits = pt._seg_bits(seg_ext)
+    idx_mask = np.int32((1 << bits) - 1)
+    seg_bases = list(range(0, m, seg_ext))
 
     key = (mesh, algorithm, scale, k, wsum, qnum_p.shape, qcat_p.shape,
            tnum_p.shape, tcat_p.shape, nt_true,
            tuple(np.asarray(cat_weights, np.float32)), interpret)
-    fn = _ring_bins_cache.get(key)
+    fn = bounded_cache_get(_ring_bins_cache, key)
     if fn is None:
         n_loc = qnum_p.shape[0] // d
-        ni, nj = n_loc // pt._QB, m // pt._TB
-        kernel = pt._make_kernel(
-            F, Ccat, tuple(float(w) for w in
-                           np.asarray(cat_weights, np.float32)),
-            wsum, scale, m, nj)
-
-        def hop_bins(qn, qc, tn_b, tc_b):
-            return pt._bins_pallas_call(kernel, qn, qc, tn_b, tc_b, F,
-                                        Ccat, ni, nj, n_loc, interpret)
+        ni = n_loc // pt._QB
+        cat_w = tuple(float(w) for w in
+                      np.asarray(cat_weights, np.float32))
+        kernels = {}
+        for base in seg_bases:
+            nj = min(seg_ext, m - base) // pt._TB
+            if nj not in kernels:
+                kernels[nj] = pt._make_kernel(F, Ccat, cat_w, wsum, scale,
+                                              nj, bits, reduce_out=False)
 
         def local(qn, qc, tn, tc):
             r = jax.lax.axis_index("data")
             perm = [((i + 1) % d, i) for i in range(d)]
 
             def step(s, carry):
-                tn_b, tc_b, cv, ci = carry
+                tn_b, tc_b, cv, ci, over = carry
                 owner = (r + s) % d
-                hv, hi = hop_bins(qn, qc, tn_b, tc_b)
-                hi = jnp.where(hi >= 0, hi + owner * m, -1)
-                cv, ci = _merge_bins(cv, ci, hv, hi, L, R)
+                nv_blk = jnp.clip(jnp.int32(nt_true) - owner * m, 0, m)
+                for base in seg_bases:
+                    ext = min(seg_ext, m - base)
+                    nv = jnp.reshape(
+                        jnp.clip(nv_blk - base, 0, ext).astype(jnp.int32),
+                        (1,))
+                    bins, flags = pt._bins_pallas_call(
+                        kernels[ext // pt._TB], nv, qn, qc,
+                        tn_b[base:base + ext] if F else tn_b,
+                        tc_b[base:base + ext] if Ccat else tc_b,
+                        F, Ccat, ni, ext // pt._TB, n_loc, R * L,
+                        interpret)
+                    hv = jnp.where(bins == sentinel, sentinel,
+                                   bins >> bits)
+                    hi = jnp.where(bins == sentinel, -1,
+                                   (bins & idx_mask) + (owner * m + base))
+                    cv, ci = _merge_bins(cv, ci, hv, hi, L, R)
+                    over = over | jnp.any(flags < 0, axis=1)
 
                 def rotate(blocks):
                     return tuple(jax.lax.ppermute(b, "data", perm)
@@ -375,27 +395,35 @@ def _ring_bins(qnum, qcat, tnum, tcat, cat_weights, wsum, k, algorithm,
 
                 tn_b, tc_b = jax.lax.cond(
                     s < d - 1, rotate, lambda b: b, (tn_b, tc_b))
-                return (tn_b, tc_b, cv, ci)
+                return (tn_b, tc_b, cv, ci, over)
 
+            # derive the carries from the inputs so they are data-varying
+            # from the start (a plain full() is unvarying and trips scan's
+            # vma check); sums work for zero-width operands too
             zero = (qn.sum() + qc.sum()).astype(jnp.int32) * 0
             cv0 = jnp.full((qn.shape[0], R * L), sentinel, jnp.int32) + zero
             ci0 = jnp.full((qn.shape[0], R * L), -1, jnp.int32) + zero
-            out = jax.lax.fori_loop(0, d, step, (tn, tc, cv0, ci0))
-            binv, bini = out[2], out[3]
+            over0 = jnp.zeros((qn.shape[0],), bool) | (zero > 0)
+            out = jax.lax.fori_loop(0, d, step,
+                                    (tn, tc, cv0, ci0, over0))
+            binv, bini, over = out[2], out[3], out[4]
 
-            # value-only contract: no tie-index term in the check
-            valid = (bini >= 0) & (bini < nt_true)
-            return pt.select_and_check(binv, bini, valid, k, idx_bits,
-                                       check_tie_index=False)
+            # value-only contract: select the k smallest carried values
+            # (tie indices keep bin/arrival order) and run the
+            # bottom-register check on values alone
+            v2, pos = topk_smallest(binv, k)
+            i2 = jnp.take_along_axis(bini, pos, axis=1)
+            theta = v2[:, k - 1:k]
+            lost = jnp.any(binv[:, (R - 1) * L:] < theta, axis=1)
+            underfill = v2[:, k - 1] == sentinel
+            return v2, i2, lost | (underfill & over)
 
         fn = jax.jit(shard_map(
             local, mesh=mesh,
             in_specs=(P("data"), P("data"), P("data"), P("data")),
             out_specs=(P("data"), P("data"), P("data")),
             check_vma=False))
-        if len(_ring_bins_cache) >= 4:   # bounded, like _encode_cache
-            _ring_bins_cache.pop(next(iter(_ring_bins_cache)))
-        _ring_bins_cache[key] = fn
+        bounded_cache_put(_ring_bins_cache, key, fn)
 
     vals, idxs, suspect = fn(qnum_p, qcat_p, tnum_p, tcat_p)
     return (np.asarray(vals)[:nq], np.asarray(idxs)[:nq],
@@ -438,8 +466,7 @@ def pairwise_distances(qnum: np.ndarray, qcat: np.ndarray,
                                      algorithm)
 
     k0 = min(top_k, nt) if top_k else None
-    if (k0 is not None and topk_method in ("exact", "fused")
-            and (m_ax == 1 or qnum.shape[1] > 0)):
+    if k0 is not None and topk_method in ("exact", "fused"):
         from .pallas_topk import (fused_pairwise_topk, fused_topk_applicable,
                                   fused_topk_supported)
         n_num, n_cat = qnum.shape[1], qcat.shape[1]
@@ -468,8 +495,7 @@ def pairwise_distances(qnum: np.ndarray, qcat: np.ndarray,
                 vals[bad], idxs[bad] = vb, ib
             return vals, idxs
     if topk_method == "fused":
-        raise ValueError("topk_method='fused' requires top_k (and, on a "
-                         "2-D mesh, at least one numeric column)")
+        raise ValueError("topk_method='fused' requires top_k")
     if topk_method == "sorted":
         topk_method = "exact"
 

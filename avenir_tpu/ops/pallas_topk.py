@@ -15,29 +15,52 @@ NearestNeighbor.java:80-81, resource/knn.sh:46-59):
    on the VPU, and each tile folds straight into a per-row *binned
    running-minima* structure in VMEM scratch -- ``L`` bins per query row
    (bin = candidate index mod L), each bin keeping its ``R`` smallest
-   (value, index) pairs in sorted registers.  Strict ``<`` insertion
-   keeps the earliest-seen element at equal value, and tiles arrive in
-   ascending global index order, so ties preserve lowest-index-first
-   order exactly.  The VPU register update overlaps the next tile's MXU
-   pass, so selection is nearly free; the [nq, nt] block never exists.
-2. **Narrow exact top-k**: the ``R*L`` candidates per row are packed as
-   ``(value << idx_bits) | index`` into one int32 so a single-operand
-   ``lax.top_k`` yields ascending (value, index) lexicographic order --
-   bit-identical tie semantics to ``topk_smallest``.
-3. **Soundness check (free)**: a true top-k element can only be lost if
-   more than ``R`` of the true top-k share one bin -- in that case every
-   register of that bin holds a value <= theta (the selected k-th
-   value).  So ``any(bottom_register < theta or (== theta and its index
-   <= max selected tie index))`` flags *every* possible loss.  Expected
-   flag rate is data-independent ~ L*(k/L)^(R+1)/(R+1)! per row (~1e-3
-   at k=16, L=128, R=4) plus rows whose theta tie-group is dense;
-   flagged rows are re-run through the sort-based engine by the caller,
-   so results are exact on ALL inputs -- adversarial index layouts only
-   cost speed, never correctness.
+   entries as PACKED ``(value << idx_bits) | index`` int32 registers.
+   Packed values are unique per row (the index field is), so strict
+   ``<`` insertion is a total order that bakes in the
+   lowest-index-first tie contract and needs only one register file
+   (r4's separate value/index registers cost ~2x the VPU work and 2x
+   the output DMA; packing took the 16k x 16k x 256 kernel from 3.4 ms
+   to 2.1 ms).
+2. **In-kernel merge tree** (k <= 16): on the last candidate tile the
+   L=128 sorted-4 bins reduce to 8 sorted-16 lists via exact Batcher
+   odd-even merges (4+4 -> 8, 8+8 -> 16) and bitonic keep-16 merges --
+   all compare-exchanges on [QB, lane] slices, overlapped with the next
+   row-block's MXU passes.  Keep-16 of two sorted 16-lists loses
+   nothing for any top-k with k <= 16, so the reduction adds ZERO
+   fallback rate; it cuts the stage-2 selection width from 512 to 128
+   (measured: lax.top_k over [16k, 512] costs 1.14 ms vs 0.17 ms over
+   [16k, 128]).  For 16 < k <= 64 the kernel emits the full bins.
+3. **Narrow exact top-k**: one single-operand ``lax.top_k`` over the
+   packed survivors yields ascending (value, index) lexicographic
+   order -- bit-identical tie semantics to ``topk_smallest``.
+4. **Soundness check (free)**: packed values are unique, so a
+   selection-deserving element can only be lost if all ``R`` registers
+   of its bin are packed-smaller -- then that bin's bottom register <
+   the selected k-th packed value, and ``any(bottom < sel[k-1])``
+   flags *every* possible loss.  Rows whose bins excluded a real
+   candidate by the packing budget (value >= val_max) carry an
+   overflow bit (the sign bit of the bottom-register output) and flag
+   when under-filled.  Expected flag rate is data-independent
+   ~ L*(k/L)^(R+1)/(R+1)! per row (~1e-3 at k=16, L=128, R=4);
+   flagged rows are re-run through the sort-based engine by the
+   caller, so results are exact on ALL inputs -- adversarial index
+   layouts only cost speed, never correctness.
+
+Scale: the candidate axis is processed in segments of ``_SEG = 2^18``
+rows (each segment its own bins pass + narrow select, merged by one
+lexicographic two-key sort), so the int32 packing budget is computed on
+the SEGMENT extent -- 18 index bits, 2^13 value budget -- independent
+of the global candidate count.  There is no nt cap: millions of
+candidate rows run as a few segments, and on 2-D meshes the per-shard
+segment loop composes with the cross-shard (value, index) merge.
 
 Measured (v5e, 16384 x 16384 x 256 f32, k=16, dispatch-amortized):
-kernel 3.4 ms + packed top-k ~1.5 ms ~= 12-15% of bf16 peak vs 1.2%
-for the sort-based engine, with 0 flagged rows on the bench workload.
+kernel + in-kernel merge 1.6 ms + packed top-k 0.17 ms ~= 40% of bf16
+peak vs 1.2% for the sort-based engine (Mosaic's native f32 dot runs
+the MXU at its multi-pass f32 rate; a manual bf16 hi/lo split measured
+SLOWER because Mosaic schedules separate dots worse than its own f32
+lowering), with ~1e-3 flagged rows on the bench workload.
 """
 
 from __future__ import annotations
@@ -53,19 +76,33 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import get_mesh, pad_rows
+from ..utils.caches import bounded_cache_get, bounded_cache_put
 
 _QB = 512          # query rows per tile (swept on v5e: 512x512 beats
-_TB = 512          # 256x512 by ~15% — fewer grid steps, same VMEM fit)
+_TB = 512          # 256x512 and 1024-wide tiles; 1024 rows OOM VMEM)
 _L = 128           # bins per query row (candidate index mod L)
 _R = 4             # registers (running smallest) per bin
+_NGROUPS = 8       # reduced output: 8 sorted-16 lists (k <= 16 path)
+_WRED = 16 * _NGROUPS
 _MAX_K = 64
 _MAX_F = 1024
 _MAX_CAT = 16
-_MAX_NT = 1 << 18  # idx fits 18 bits -> value budget 2^13 > any sane scale
+_SEG = 1 << 18     # candidate-axis segment: packing budget is per-segment
 
 _SENT = np.int32(np.iinfo(np.int32).max)
 
 _fused_cache: dict = {}
+
+
+def _seg_extent(nt_loc: int) -> int:
+    """Per-call segment extent: one segment when the local candidate
+    axis fits, else _SEG-row segments (a multiple of _TB)."""
+    return min(nt_loc, _SEG)
+
+
+def _seg_bits(extent: int) -> int:
+    """Index bits for a segment extent (packing budget = 2^(31-bits))."""
+    return max(int(np.ceil(np.log2(max(extent, 2)))), 1)
 
 
 def fused_topk_supported(algorithm: str, k: int, nt: int,
@@ -73,17 +110,16 @@ def fused_topk_supported(algorithm: str, k: int, nt: int,
                          m_ax: int = 1) -> bool:
     """Hard constraints of the fused engine: euclidean (the MXU
     expansion), shapes inside the kernel's VMEM budget, and a packing
-    budget that keeps the (value, index) pair inside one int32.  The
-    index bits are computed on the PADDED candidate extent (a multiple
-    of ``m_ax * _TB``) — on a non-power-of-two model axis the padding
-    can cross a power of two and halve the value budget."""
+    budget that keeps ``(value << idx_bits) | index`` inside one int32.
+    The budget is computed on the per-shard SEGMENT extent (at most
+    2^18 rows -> >= 2^13 value budget), so there is no candidate-count
+    cap -- large nt runs as several segments merged by a two-key sort."""
     step = m_ax * _TB
     nt_pad = -(-max(nt, 1) // step) * step
-    idx_bits = max(int(np.ceil(np.log2(max(nt_pad, 2)))), 1)
-    val_budget = 1 << (31 - idx_bits)
+    bits = _seg_bits(_seg_extent(nt_pad // m_ax))
+    val_budget = 1 << (31 - bits)
     return (algorithm == "euclidean"
             and 0 < k <= _MAX_K
-            and nt <= _MAX_NT
             and n_num + n_cat > 0
             and n_num <= _MAX_F
             and n_cat <= _MAX_CAT
@@ -104,28 +140,104 @@ def fused_topk_applicable(algorithm: str, k: int, nt: int,
                                      scale, m_ax=m_ax))
 
 
+# --------------------------------------------------------------------------
+# compare-exchange merge networks (verified by the 0-1 principle in
+# tests/test_pallas_topk.py::test_merge_networks_zero_one_principle)
+
+def _oem_comps(idx):
+    """Batcher odd-even merge network for a list whose two halves are
+    sorted; returns compare-exchange index pairs."""
+    n = len(idx)
+    if n == 2:
+        return [(idx[0], idx[1])]
+    half = n // 2
+    a, b = idx[:half], idx[half:]
+    comps = _oem_comps(a[0::2] + b[0::2]) + _oem_comps(a[1::2] + b[1::2])
+    comps += [(idx[i], idx[i + 1]) for i in range(1, n - 1, 2)]
+    return comps
+
+
+_OEM44 = tuple(_oem_comps(list(range(8))))
+_OEM88 = tuple(_oem_comps(list(range(16))))
+
+
+def _cmpx(vs, a, b):
+    sw = vs[b] < vs[a]
+    vs[a], vs[b] = jnp.where(sw, vs[b], vs[a]), jnp.where(sw, vs[a], vs[b])
+
+
+def _merge_net(xs, ys, net):
+    vs = list(xs) + list(ys)
+    for a, b in net:
+        _cmpx(vs, a, b)
+    return vs
+
+
+def _keep16(xs, ys):
+    """Two sorted 16-lists -> sorted 16 smallest of the union: min
+    against the reversed partner gives a bitonic sequence; a 4-stage
+    bitonic merge sorts it.  Exact for every top-k with k <= 16."""
+    z = [jnp.minimum(xs[i], ys[15 - i]) for i in range(16)]
+    for gap in (8, 4, 2, 1):
+        for i in range(16):
+            if i & gap == 0 and i + gap < 16:
+                _cmpx(z, i, i + gap)
+    return z
+
+
+def _reduce_bins(regs):
+    """[R=4 sorted registers x L=128 lane-bins] -> 8 sorted-16 lists of
+    _NGROUPS lanes each, concatenated to [QB, _WRED].  Levels: exact
+    4+4 and 8+8 Batcher merges, then exact keep-16 merges -- no level
+    discards anything a top-16 selection could need."""
+    h = _L // 2
+    groups = _merge_net([rg[:, :h] for rg in regs],
+                        [rg[:, h:] for rg in regs], _OEM44)
+    h //= 2
+    groups = _merge_net([a[:, :h] for a in groups],
+                        [a[:, h:] for a in groups], _OEM88)
+    width = h
+    while width > _NGROUPS:
+        h = width // 2
+        groups = _keep16([a[:, :h] for a in groups],
+                         [a[:, h:] for a in groups])
+        width = h
+    return jnp.concatenate(groups, axis=1)
+
+
+# --------------------------------------------------------------------------
+
 def _make_kernel(F: int, Ccat: int, cat_w: tuple, wsum: float, scale: int,
-                 nt_true: int, nj: int):
-    """Tile kernel: distance block on MXU/VPU + binned register insert."""
+                 nj: int, bits: int, reduce_out: bool):
+    """Tile kernel: distance block on MXU/VPU + packed register insert.
+
+    Inputs: an SMEM (1,) scalar ``nv`` (count of REAL candidate rows in
+    this segment/shard -- the authoritative padding mask) followed by
+    the [qn, tn] / [qc, tc] operand blocks (conditionally plumbed: an
+    unused dummy block crashes Mosaic).  Outputs: ``main`` ([QB, _WRED]
+    reduced survivors when ``reduce_out`` else [QB, _R*_L] full bins)
+    and ``flags`` = bottom registers with the per-row overflow bit in
+    the sign position."""
+    val_max = np.int32(1 << (31 - bits))
 
     def kernel(*refs):
-        # inputs are packed [qn, tn]? [qc, tc]? depending on F/Ccat so
-        # Mosaic never sees an unused dummy block
-        pos = 0
+        nv_ref = refs[0]
+        pos = 1
         qn_ref = tn_ref = qc_ref = tc_ref = None
         if F:
-            qn_ref, tn_ref = refs[0], refs[1]
-            pos = 2
+            qn_ref, tn_ref = refs[pos], refs[pos + 1]
+            pos += 2
         if Ccat:
             qc_ref, tc_ref = refs[pos], refs[pos + 1]
             pos += 2
-        valout_ref, idxout_ref, binv, bini = refs[pos:pos + 4]
+        main_ref, flags_ref, binp, oflow = refs[pos:pos + 4]
         j = pl.program_id(1)
+        nv = nv_ref[0]
 
         @pl.when(j == 0)
         def _init():
-            binv[:] = jnp.full_like(binv, _SENT)
-            bini[:] = jnp.full_like(bini, -1)
+            binp[:] = jnp.full_like(binp, _SENT)
+            oflow[:] = jnp.zeros_like(oflow)
 
         # arithmetic mirrors _block_dist exactly (numeric part + one
         # summed categorical part, then a true divide by wsum) so the
@@ -148,10 +260,10 @@ def _make_kernel(F: int, Ccat: int, cat_w: tuple, wsum: float, scale: int,
         if cat_acc is not None:
             parts = cat_acc if parts is None else parts + cat_acc
         d = jnp.sqrt(parts / wsum)
-        # clamp before the int cast: padded candidate rows (huge fill
-        # values on 2-D meshes) and genuinely-overflowing distances land
-        # at a defined huge int (>= the packing budget, so stage 2 drops
-        # them) instead of an undefined float->int cast
+        # clamp before the int cast: genuinely-overflowing distances
+        # land at a defined huge int (>= val_max, so they pack to the
+        # sentinel and set the overflow bit) instead of an undefined
+        # float->int cast
         di = jnp.minimum(d * scale,
                          jnp.float32(2147483392.0)).astype(jnp.int32)
 
@@ -161,35 +273,43 @@ def _make_kernel(F: int, Ccat: int, cat_w: tuple, wsum: float, scale: int,
                 base + s * _L
                 + jax.lax.broadcasted_iota(jnp.int32, (1, _L), 1),
                 (di.shape[0], _L))
-            v = jnp.where(g < nt_true,
-                          di[:, s * _L:(s + 1) * _L], _SENT)
-            regs_v = [binv[:, r * _L:(r + 1) * _L] for r in range(_R)]
-            regs_i = [bini[:, r * _L:(r + 1) * _L] for r in range(_R)]
-            lt = [v < rv for rv in regs_v]
-            # sorted-insert: strict < keeps the earlier (lower-index)
-            # element on equal values; tiles arrive in index order
+            real = g < nv
+            v = di[:, s * _L:(s + 1) * _L]
+            p = jnp.where(real & (v < val_max), (v << bits) | g, _SENT)
+            oflow[:] |= jnp.where(real & (v >= val_max),
+                                  jnp.int32(1), jnp.int32(0))
+            regs = [binp[:, r * _L:(r + 1) * _L] for r in range(_R)]
+            # sorted-insert on packed values: strict < is a total order
+            # (indices are unique), so lowest-index-first tie retention
+            # is automatic
+            lt = [p < rv for rv in regs]
             for r in range(_R - 1, 0, -1):
-                binv[:, r * _L:(r + 1) * _L] = jnp.where(
-                    lt[r - 1], regs_v[r - 1], jnp.where(lt[r], v, regs_v[r]))
-                bini[:, r * _L:(r + 1) * _L] = jnp.where(
-                    lt[r - 1], regs_i[r - 1], jnp.where(lt[r], g, regs_i[r]))
-            binv[:, 0:_L] = jnp.where(lt[0], v, regs_v[0])
-            bini[:, 0:_L] = jnp.where(lt[0], g, regs_i[0])
+                binp[:, r * _L:(r + 1) * _L] = jnp.where(
+                    lt[r - 1], regs[r - 1], jnp.where(lt[r], p, regs[r]))
+            binp[:, 0:_L] = jnp.where(lt[0], p, regs[0])
 
         @pl.when(j == nj - 1)
         def _out():
-            valout_ref[:] = binv[:]
-            idxout_ref[:] = bini[:]
+            flags_ref[:] = (binp[:, (_R - 1) * _L:]
+                            | (oflow[:] << 31))
+            if reduce_out:
+                main_ref[:] = _reduce_bins(
+                    [binp[:, r * _L:(r + 1) * _L] for r in range(_R)])
+            else:
+                main_ref[:] = binp[:]
 
     return kernel
 
 
-def _bins_pallas_call(kernel, qn, qc, tn, tc, F: int, Ccat: int,
-                      ni: int, nj: int, nq_loc: int, interpret: bool):
+def _bins_pallas_call(kernel, nv, qn, qc, tn, tc, F: int, Ccat: int,
+                      ni: int, nj: int, nq_loc: int, W: int,
+                      interpret: bool):
     """Invoke the bins kernel with the F/Ccat-conditional operand
     plumbing (unused dummy blocks crash Mosaic) — shared by the
-    broadcast engine and the ring's per-hop call."""
-    in_specs, args = [], []
+    broadcast engine and the ring's per-hop call.  ``nv`` is the (1,)
+    int32 real-candidate count for this segment/shard."""
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]
+    args = [nv]
     if F:
         in_specs += [pl.BlockSpec((_QB, F), lambda i, j: (i, 0),
                                   memory_space=pltpu.VMEM),
@@ -206,54 +326,55 @@ def _bins_pallas_call(kernel, qn, qc, tn, tc, F: int, Ccat: int,
         return pl.pallas_call(
             kernel, grid=(ni, nj),
             in_specs=in_specs,
-            out_specs=[pl.BlockSpec((_QB, _R * _L), lambda i, j: (i, 0),
-                                    memory_space=pltpu.VMEM)] * 2,
-            out_shape=[jax.ShapeDtypeStruct((nq_loc, _R * _L),
-                                            jnp.int32)] * 2,
+            out_specs=[pl.BlockSpec((_QB, W), lambda i, j: (i, 0),
+                                    memory_space=pltpu.VMEM),
+                       pl.BlockSpec((_QB, _L), lambda i, j: (i, 0),
+                                    memory_space=pltpu.VMEM)],
+            out_shape=[jax.ShapeDtypeStruct((nq_loc, W), jnp.int32),
+                       jax.ShapeDtypeStruct((nq_loc, _L), jnp.int32)],
             scratch_shapes=[pltpu.VMEM((_QB, _R * _L), jnp.int32),
-                            pltpu.VMEM((_QB, _R * _L), jnp.int32)],
+                            pltpu.VMEM((_QB, _L), jnp.int32)],
             interpret=interpret,
         )(*args)
 
 
-def select_and_check(vals, idxs, valid, k: int, idx_bits: int,
-                     check_tie_index: bool):
-    """Stage 2 + soundness check over a [n, R*L] bins structure — ONE
-    authoritative copy shared by the broadcast engine and the ring.
+def select_and_check(main, flags, k: int, bits: int):
+    """Stage 2 + soundness check over packed survivors — ONE
+    authoritative copy shared by the broadcast engine's 1-D, segmented
+    and 2-D paths.
 
-    Packs (value << idx_bits | index) so a single narrow ``top_k`` gives
-    ascending lexicographic (value, index) order; ``valid`` masks bin
-    entries that must not participate (unfilled registers, padding rows
-    identified by index bound).  Returns ``(sel_v, sel_i, suspect)``
-    where suspect flags every row whose selection could be wrong: a
-    bottom register strictly below theta (a displaced better candidate),
-    with ``check_tie_index`` additionally flagging a possibly-displaced
-    LOWER-INDEX tie at theta (needed for the broadcast engine's
-    lowest-index tie contract; the ring's value-only contract skips it),
-    or an under-filled selection when candidates were excluded by the
-    packing budget."""
-    val_max = np.int32(1 << (31 - idx_bits))
-    idx_mask = np.int32((1 << idx_bits) - 1)
-    packed = jnp.where(valid & (vals < val_max),
-                       (vals << idx_bits) | idxs, _SENT)
-    neg, _ = jax.lax.top_k(-packed, k)
+    ``main`` holds packed ``(value << bits) | index`` candidates
+    (sentinel = empty); a single narrow ``top_k`` gives ascending
+    lexicographic (value, index) order.  ``flags`` carries each bin's
+    bottom register with the overflow bit in the sign.  Returns
+    ``(sel_v, sel_i, suspect)`` where suspect flags every row whose
+    selection could be wrong: a bin's bottom register packed-below the
+    selected k-th element (a displaced better candidate — covers value
+    ties exactly, since packed order is total), or an under-filled
+    selection when real candidates were excluded by the packing
+    budget."""
+    idx_mask = np.int32((1 << bits) - 1)
+    neg, _ = jax.lax.top_k(-main, k)
     sel = -neg
-    sel_v = jnp.where(sel == _SENT, _SENT, sel >> idx_bits)
+    sel_v = jnp.where(sel == _SENT, _SENT, sel >> bits)
     sel_i = jnp.where(sel == _SENT, -1, sel & idx_mask)
 
-    theta = sel_v[:, k - 1:k]
-    bot_v = vals[:, (_R - 1) * _L:]
-    bot_valid = valid[:, (_R - 1) * _L:]
-    lost = bot_valid & (bot_v < theta)
-    if check_tie_index:
-        bot_i = idxs[:, (_R - 1) * _L:]
-        tie_sel = jnp.where(sel_v == theta, sel_i, -1)
-        imax = jnp.max(tie_sel, axis=1, keepdims=True)
-        lost = lost | (bot_valid & (bot_v == theta) & (bot_i <= imax))
-    overflow = jnp.any(valid & (vals >= val_max), axis=1)
-    suspect = (jnp.any(lost, axis=1)
-               | ((sel_v[:, k - 1] == _SENT) & overflow))
+    bot = flags & jnp.int32(0x7FFFFFFF)
+    over = flags < 0
+    lost = jnp.any(bot < sel[:, k - 1:k], axis=1)
+    underfill = sel[:, k - 1] == _SENT
+    suspect = lost | (underfill & jnp.any(over, axis=1))
     return sel_v, sel_i, suspect
+
+
+def _lex_merge(v_all, i_all, k: int):
+    """Exact top-k of concatenated per-segment/per-shard selections:
+    one two-key ascending sort on (value, index) — the packing-free
+    merge that keeps the global lowest-index tie contract at any
+    candidate count (a packed merge would need index bits for the
+    GLOBAL extent and starve the value budget)."""
+    v_s, i_s = jax.lax.sort((v_all, i_all), dimension=1, num_keys=2)
+    return v_s[:, :k], i_s[:, :k]
 
 
 def _build_fused(mesh, nq_pad: int, nt_pad: int, F: int, Ccat: int,
@@ -263,50 +384,62 @@ def _build_fused(mesh, nq_pad: int, nt_pad: int, F: int, Ccat: int,
     m_ax = mesh.shape["model"]
     nq_loc = nq_pad // d_ax
     nt_loc = nt_pad // m_ax
-    ni, nj = nq_loc // _QB, nt_loc // _TB
-    idx_bits = max(int(np.ceil(np.log2(max(nt_pad, 2)))), 1)
-    val_max = np.int32(1 << (31 - idx_bits))
-    idx_mask = np.int32((1 << idx_bits) - 1)
-    # on a 2-D mesh each model shard sees its full local extent (padding
-    # rows carry a huge numeric fill that the distance clamp pushes past
-    # the packing budget); on 1-D the kernel masks the tail by index
-    kernel = _make_kernel(F, Ccat, cat_w, wsum, scale,
-                          nt_true if m_ax == 1 else nt_loc, nj)
+    ni = nq_loc // _QB
+    seg_ext = _seg_extent(nt_loc)
+    bits = _seg_bits(seg_ext)
+    reduce_out = k <= 16
+    W = _WRED if reduce_out else _R * _L
+    seg_bases = list(range(0, nt_loc, seg_ext))
+    kernels = {}
+    for base in seg_bases:
+        ext = min(seg_ext, nt_loc - base)
+        nj = ext // _TB
+        if nj not in kernels:
+            kernels[nj] = _make_kernel(F, Ccat, cat_w, wsum, scale, nj,
+                                       bits, reduce_out)
 
     def local(qn, qc, tn, tc):
-        vals, idxs = _bins_pallas_call(kernel, qn, qc, tn, tc, F, Ccat,
-                                       ni, nj, nq_loc, interpret)
-        # On a 2-D mesh padding candidates reach the bins (the kernel
-        # cannot see per-shard valid extents); they are identified by
-        # global index >= nt_true and excluded from the packing AND from
-        # every soundness predicate — they carry the clamp value, so
-        # they can never displace a real candidate.  On a 2-D mesh the
-        # check runs per model shard against the shard's own local
-        # theta: the global top-k is a subset of the union of EXACT
-        # local top-ks, so any-shard-suspect covers every loss.
+        # per-shard real-candidate count: the authoritative padding /
+        # ragged-edge mask, applied in-kernel (no fill-value tricks)
         off = (jax.lax.axis_index("model") * nt_loc if m_ax > 1 else 0)
-        bin_valid = (idxs >= 0) & (idxs + off < nt_true)
-        sel_v, sel_i, suspect = select_and_check(
-            vals, idxs, bin_valid, k, idx_bits, check_tie_index=True)
+        nv_shard = jnp.clip(jnp.int32(nt_true) - off, 0, nt_loc)
+
+        vs, is_, sus = [], [], []
+        for base in seg_bases:
+            ext = min(seg_ext, nt_loc - base)
+            nv = jnp.reshape(
+                jnp.clip(nv_shard - base, 0, ext).astype(jnp.int32), (1,))
+            main, flags = _bins_pallas_call(
+                kernels[ext // _TB], nv,
+                qn, qc,
+                tn[base:base + ext] if F else tn,
+                tc[base:base + ext] if Ccat else tc,
+                F, Ccat, ni, ext // _TB, nq_loc, W, interpret)
+            sv, si, ss = select_and_check(main, flags, k, bits)
+            if base:
+                si = jnp.where(si >= 0, si + base, -1)
+            vs.append(sv)
+            is_.append(si)
+            sus.append(ss)
+        if len(seg_bases) > 1:
+            sel_v, sel_i = _lex_merge(jnp.concatenate(vs, axis=1),
+                                      jnp.concatenate(is_, axis=1), k)
+            suspect = jnp.stack(sus, 0).any(0)
+        else:
+            sel_v, sel_i, suspect = vs[0], is_[0], sus[0]
         if m_ax == 1:
             return sel_v, sel_i, suspect
 
-        # merge across model shards: re-pack with GLOBAL candidate
-        # indices (tie order = global lowest-index), gather k*m
-        # candidates, exact top-k; every shard computes the identical
-        # merge, so pmax marks the outputs model-invariant
-        gidx = sel_i + jax.lax.axis_index("model") * nt_loc
-        packed_g = jnp.where((sel_i >= 0) & (sel_v < val_max),
-                             (sel_v << idx_bits) | gidx, _SENT)
-        allp = jax.lax.all_gather(packed_g, "model", axis=1,
-                                  tiled=True)       # [nq_loc, k*m]
-        neg_g, _ = jax.lax.top_k(-allp, k)
-        sel_g = -neg_g
-        gv = jnp.where(sel_g == _SENT, _SENT, sel_g >> idx_bits)
-        gi = jnp.where(sel_g == _SENT, -1, sel_g & idx_mask)
+        # merge across model shards with GLOBAL candidate indices (tie
+        # order = global lowest-index); every shard computes the
+        # identical merge, so pmax marks the outputs model-invariant
+        gi = jnp.where(sel_i >= 0, sel_i + off.astype(jnp.int32), -1)
+        v_all = jax.lax.all_gather(sel_v, "model", axis=1, tiled=True)
+        i_all = jax.lax.all_gather(gi, "model", axis=1, tiled=True)
+        gv, gidx = _lex_merge(v_all, i_all, k)
         sus = jax.lax.pmax(suspect.astype(jnp.int32), "model") > 0
         sus = sus | (gv[:, k - 1] == _SENT)
-        return (jax.lax.pmax(gv, "model"), jax.lax.pmax(gi, "model"),
+        return (jax.lax.pmax(gv, "model"), jax.lax.pmax(gidx, "model"),
                 sus)
 
     t_spec = P("model") if m_ax > 1 else P()
@@ -343,23 +476,14 @@ def fused_pairwise_topk(qnum: np.ndarray, qcat: np.ndarray,
         interpret = jax.default_backend() != "tpu"
     nq, nt = qnum.shape[0], tnum.shape[0]
     F, Ccat = qnum.shape[1], qcat.shape[1]
-    if m_ax > 1 and F == 0:
-        raise ValueError("2-D-mesh fused top-k needs a numeric column "
-                         "(the huge pad fill keeps padding out of the "
-                         "bins' way; stage 2 then drops it by index) — "
-                         "use the sorted engine")
 
     qnum_p, _ = pad_rows(qnum.astype(np.float32), d_ax * _QB)
     qcat_p, _ = pad_rows(qcat.astype(np.int32), d_ax * _QB)
-    # 1-D: candidate padding is masked by global index in-kernel.  2-D:
-    # every model shard sees its full local extent; padding rows carry a
-    # huge numeric fill so they cannot displace real candidates from the
-    # bins, and stage 2 AUTHORITATIVELY excludes them by per-shard index
-    # bound (bin_valid) — the fill is a no-displacement guarantee, not
-    # the exclusion mechanism
-    t_fill = 0 if m_ax == 1 else 1e15
-    tnum_p, _ = pad_rows(tnum.astype(np.float32), m_ax * _TB, fill=t_fill)
-    # categorical pads: -2 != any query code (missing is -1)
+    # candidate padding is masked authoritatively in-kernel by the
+    # per-shard/per-segment real-row count (the SMEM ``nv`` scalar), so
+    # pad rows need no fill-value tricks and zero-numeric-column 2-D
+    # meshes are fine
+    tnum_p, _ = pad_rows(tnum.astype(np.float32), m_ax * _TB)
     tcat_p, _ = pad_rows(tcat.astype(np.int32), m_ax * _TB, fill=-2)
     if F == 0:
         qnum_p = np.zeros((qnum_p.shape[0], 1), np.float32)
@@ -371,15 +495,13 @@ def fused_pairwise_topk(qnum: np.ndarray, qcat: np.ndarray,
     key = (mesh, qnum_p.shape, qcat_p.shape, tnum_p.shape, tcat_p.shape,
            F, Ccat, tuple(np.asarray(cat_weights, np.float32)),
            float(wsum), int(scale), int(k), nt, interpret)
-    fn = _fused_cache.get(key)
+    fn = bounded_cache_get(_fused_cache, key)
     if fn is None:
         fn = _build_fused(mesh, qnum_p.shape[0], tnum_p.shape[0], F, Ccat,
                           tuple(float(w) for w in
                                 np.asarray(cat_weights, np.float32)),
                           float(wsum), int(scale), int(k), nt, interpret)
-        if len(_fused_cache) >= 4:     # bounded, like _encode_cache
-            _fused_cache.pop(next(iter(_fused_cache)))
-        _fused_cache[key] = fn
+        bounded_cache_put(_fused_cache, key, fn)
 
     vals, idxs, suspect = fn(qnum_p, qcat_p, tnum_p, tcat_p)
     return (np.asarray(vals)[:nq], np.asarray(idxs)[:nq],

@@ -1,0 +1,33 @@
+"""Bounded LRU caches for compiled/jitted functions.
+
+Every engine keeps a small dict of jitted functions keyed by
+(mesh, shapes, constants).  Python 3.7+ dicts preserve insertion order,
+so eviction pops the first key; a plain get() would make that FIFO —
+a workload alternating among more than ``cap`` distinct configurations
+would evict and recompile its hottest function on every call.  These
+helpers make hits refresh recency (move-to-end), turning the bound
+into a true LRU (advisor finding, round 4)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+_DEFAULT_CAP = 4
+
+
+def bounded_cache_get(cache: dict, key) -> Optional[Any]:
+    """Return ``cache[key]`` (refreshing its recency) or None."""
+    val = cache.pop(key, None)
+    if val is not None:
+        cache[key] = val        # re-insert: now most recently used
+    return val
+
+
+def bounded_cache_put(cache: dict, key, value,
+                      cap: int = _DEFAULT_CAP) -> None:
+    """Insert ``key -> value``, evicting the least recently used entry
+    once the cache holds ``cap`` items."""
+    cache.pop(key, None)
+    while len(cache) >= cap:
+        cache.pop(next(iter(cache)))
+    cache[key] = value

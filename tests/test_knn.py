@@ -570,6 +570,44 @@ def test_ring_bins_selection_matches_sort(mesh8, mesh1):
                 np.take_along_axis(full, i, axis=1), d)
 
 
+def test_ring_bins_segmented_hop(mesh8, monkeypatch):
+    """Per-shard candidate extents above the segment cap: each hop runs
+    one kernel pass per segment with shard-local packed indices; the
+    per-segment global-index offset, nv clipping and overflow
+    accumulation must reproduce the broadcast engine's distances."""
+    from avenir_tpu.ops import distance as dmod
+    from avenir_tpu.ops import pallas_topk
+    from avenir_tpu.ops.distance import pairwise_distances, pairwise_topk_ring
+
+    monkeypatch.setattr(pallas_topk, "_SEG", 512)
+    dmod._ring_bins_cache.clear()
+    try:
+        rng = np.random.default_rng(31)
+        nq, nt, F = 24, 2900, 3
+        qn = rng.uniform(0, 10, (nq, F)).astype(np.float32)
+        tn = rng.uniform(0, 10, (nt, F)).astype(np.float32)
+        eq = np.zeros((nq, 0), np.int32)
+        et = np.zeros((nt, 0), np.int32)
+        w, z = rng.uniform(0.5, 2, F), np.zeros(0)
+        for mesh in (mesh8, mesh1_of(mesh8)):
+            ref_d, _ = pairwise_distances(qn, eq, tn, et, w, z, top_k=5,
+                                          mesh=mesh, topk_method="sorted")
+            d, i = pairwise_topk_ring(qn, eq, tn, et, w, z, 5, mesh=mesh,
+                                      selection="bins")
+            np.testing.assert_array_equal(d, ref_d)
+            full, _ = pairwise_distances(qn, eq, tn, et, w, z, mesh=mesh)
+            np.testing.assert_array_equal(
+                np.take_along_axis(full, i, axis=1), d)
+    finally:
+        dmod._ring_bins_cache.clear()
+
+
+def mesh1_of(mesh8):
+    from avenir_tpu.parallel import make_mesh
+    import jax
+    return make_mesh(devices=jax.devices()[:1])
+
+
 def test_ring_bins_adversarial_collision_falls_back(mesh8):
     """All near neighbors at stride-L global indices land in one bin:
     the value-exactness check must flag and the public result must still

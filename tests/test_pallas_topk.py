@@ -150,19 +150,18 @@ def test_fused_2d_mesh_ties(mesh8):
     _assert_fused_really_ran(qn, qc, tn2, tc2, nw, cw, 9, mesh2)
 
 
-def test_fused_2d_pure_categorical_uses_sorted(mesh8):
-    # no numeric column -> the auto path must silently keep the sorted
-    # engine on 2-D meshes, and forcing 'fused' must fail loudly
+def test_fused_2d_pure_categorical(mesh8):
+    # no numeric column on a 2-D mesh: the in-kernel real-row count
+    # (SMEM nv scalar) masks padding authoritatively, so the fused
+    # engine works without the old fill-value trick that required a
+    # numeric column
     from avenir_tpu.parallel import make_mesh
 
     _, qc, _, tc, _, cw = _rand(16, 64, 0, 3, seed=9)
     e = np.zeros((16, 0), np.float32)
     et = np.zeros((64, 0), np.float32)
     mesh2 = make_mesh(data=4, model=2)
-    pairwise_distances(e, qc, et, tc, np.zeros(0), cw, top_k=3, mesh=mesh2)
-    with pytest.raises(ValueError):
-        pairwise_distances(e, qc, et, tc, np.zeros(0), cw, top_k=3,
-                           mesh=mesh2, topk_method="fused")
+    _both(mesh2, e, qc, et, tc, np.zeros(0), cw, top_k=3)
 
 
 def test_fused_gates():
@@ -170,12 +169,85 @@ def test_fused_gates():
     assert sup("euclidean", 16, 16384, 8, 2, 1000)
     assert not sup("manhattan", 16, 16384, 8, 2, 1000)
     assert not sup("euclidean", 128, 16384, 8, 2, 1000)     # k > max
-    assert not sup("euclidean", 16, 1 << 20, 8, 2, 1000)    # nt too big
+    assert sup("euclidean", 16, 1 << 20, 8, 2, 1000)        # segmented: no
+    assert sup("euclidean", 16, 1 << 22, 8, 2, 1000)        # nt cap
     assert not sup("euclidean", 16, 16384, 0, 0, 1000)      # no columns
     assert not sup("euclidean", 16, 1 << 18, 8, 2, 10_000)  # packing budget
+    # small nt: fewer index bits -> bigger value budget, large scale OK
+    assert sup("euclidean", 16, 8192, 8, 2, 10_000)
     # auto gate requires a TPU backend
     assert not pallas_topk.fused_topk_applicable(
         "euclidean", 16, 16384, 8, 2, 1000, backend="cpu")
+
+
+def test_merge_networks_zero_one_principle():
+    """The in-kernel reduce uses Batcher odd-even merges + bitonic
+    keep-16; verify them exhaustively by the 0-1 principle (a merge
+    network is correct iff it merges every 0-1 input)."""
+    for net, half in ((pallas_topk._OEM44, 4), (pallas_topk._OEM88, 8)):
+        for za in range(half + 1):
+            for zb in range(half + 1):
+                v = ([0] * za + [1] * (half - za)
+                     + [0] * zb + [1] * (half - zb))
+                vs = [np.array([x]) for x in v]
+                for a, b in net:
+                    sw = vs[b] < vs[a]
+                    vs[a], vs[b] = (np.where(sw, vs[b], vs[a]),
+                                    np.where(sw, vs[a], vs[b]))
+                assert [int(x[0]) for x in vs] == sorted(v)
+    # keep16: random check incl. ties against the exact answer
+    rng = np.random.default_rng(2)
+    import jax.numpy as jnp
+    for _ in range(200):
+        x = np.sort(rng.integers(0, 12, 16))
+        y = np.sort(rng.integers(0, 12, 16))
+        xs = [jnp.asarray([int(v)]) for v in x]
+        ys = [jnp.asarray([int(v)]) for v in y]
+        z = pallas_topk._keep16(xs, ys)
+        got = [int(v[0]) for v in z]
+        assert got == sorted(np.concatenate([x, y]).tolist())[:16]
+
+
+def test_fused_segmented_candidate_axis(mesh1, monkeypatch):
+    """nt above the segment extent: the per-segment selections must
+    lex-merge to the exact global (value, lowest-index) top-k.  The
+    segment extent is patched down so the test exercises the multi-
+    segment path at CI scale."""
+    monkeypatch.setattr(pallas_topk, "_SEG", 1024)
+    pallas_topk._fused_cache.clear()
+    try:
+        qn, qc, tn, tc, nw, cw = _rand(64, 3000, 4, 1, seed=13)
+        _both(mesh1, qn, qc, tn, tc, nw, cw, top_k=9)
+        # duplicates across segment boundaries: global tie order
+        tn2 = np.repeat(tn[:500], 6, axis=0)
+        tc2 = np.repeat(tc[:500], 6, axis=0)
+        _both(mesh1, qn, qc, tn2, tc2, nw, cw, top_k=9)
+    finally:
+        pallas_topk._fused_cache.clear()
+
+
+def test_fused_segmented_2d_mesh(mesh8, monkeypatch):
+    from avenir_tpu.parallel import make_mesh
+
+    monkeypatch.setattr(pallas_topk, "_SEG", 512)
+    pallas_topk._fused_cache.clear()
+    try:
+        qn, qc, tn, tc, nw, cw = _rand(48, 2222, 3, 1, seed=14)
+        mesh2 = make_mesh(data=2, model=4)
+        _both(mesh2, qn, qc, tn, tc, nw, cw, top_k=6)
+        _assert_fused_really_ran(qn, qc, tn, tc, nw, cw, 6, mesh2)
+    finally:
+        pallas_topk._fused_cache.clear()
+
+
+def test_fused_k_above_16_uses_bins_path(mesh1):
+    """16 < k <= 64 skips the in-kernel keep-16 reduce and selects from
+    the full bins; still exact vs the sorted engine."""
+    qn, qc, tn, tc, nw, cw = _rand(32, 2600, 5, 0, seed=15)
+    _both(mesh1, qn, qc, tn, tc, nw, cw, top_k=40)
+    tn2 = np.repeat(tn[:400], 6, axis=0)
+    tc2 = np.repeat(tc[:400], 6, axis=0)
+    _both(mesh1, qn, qc, tn2, tc2, nw, cw, top_k=33)
 
 
 def test_fused_forced_unsupported_raises(mesh1):
