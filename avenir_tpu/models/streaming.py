@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import re
 import time
+from collections import deque
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -58,10 +59,13 @@ class Transport:
 
 
 class InMemoryTransport(Transport):
-    """Process-local queues (tests / embedded use)."""
+    """Process-local queues (tests / embedded use).  Events live in a
+    deque: the fleet loop pops tens of thousands per wave and a list's
+    ``pop(0)`` is O(queue) per pop — the r4 bench spent more time
+    shifting list elements than stepping learners."""
 
     def __init__(self):
-        self.events: List[str] = []
+        self.events: deque = deque()
         self.rewards: List[str] = []
         self.actions: List[str] = []
 
@@ -72,7 +76,7 @@ class InMemoryTransport(Transport):
         self.rewards.append(f"{action_id},{reward}")
 
     def next_event(self) -> Optional[str]:
-        return self.events.pop(0) if self.events else None
+        return self.events.popleft() if self.events else None
 
     def read_rewards(self) -> List[str]:
         out, self.rewards = self.rewards, []
@@ -252,10 +256,10 @@ class GroupedStreamingLearnerLoop:
         self.reward_count = 0
         self.malformed_count = 0
 
-    def apply_rewards(self) -> int:
-        """Drain ``entityID,actionID,reward`` messages as one bulk scatter;
-        malformed or unknown-action messages are counted and skipped so one
-        bad queue entry cannot take down the fleet loop."""
+    def _parse_rewards(self):
+        """Drain and validate ``entityID,actionID,reward`` messages;
+        malformed or unknown-action messages are counted and skipped so
+        one bad queue entry cannot take down the fleet loop."""
         gids, aids, rs = [], [], []
         for msg in self.transport.read_rewards():
             parts = msg.split(",")
@@ -264,21 +268,28 @@ class GroupedStreamingLearnerLoop:
                     or not _INT_RE.fullmatch(parts[2])):
                 self.malformed_count += 1
                 continue
-            reward = int(parts[2])
             gids.append(parts[0])
             aids.append(parts[1])
-            rs.append(reward)
+            rs.append(int(parts[2]))
+        self.reward_count += len(gids)
+        return gids, aids, rs
+
+    def apply_rewards(self) -> int:
+        """Drain the reward queue into the fleet as one bulk scatter."""
+        gids, aids, rs = self._parse_rewards()
         if gids:
             self.group.add_groups(gids)
             self.group.set_rewards(gids, aids, rs)
-        self.reward_count += len(gids)
         return len(gids)
 
-    def step_batch(self, max_events: int = 1024) -> int:
-        """Drain rewards, then up to ``max_events`` events; entities repeat
-        across waves (a second event for the same entity steps its learner
-        again, preserving per-event semantics)."""
-        self.apply_rewards()
+    def _dispatch_batch(self, max_events: int):
+        """Drain up to ``max_events`` events, apply pending rewards, and
+        dispatch the masked device step(s) WITHOUT materializing the
+        selections: returns ``(n_events, pending)`` where pending holds
+        ``(wave_entities, rows, sels_device)`` records for ``_emit``.
+        The async dispatch is what lets ``run()`` overlap the next
+        wave's transport drain/parse with this wave's device step (the
+        ``models/bayesian._train_streamed`` double-buffer pattern)."""
         entities: List[str] = []
         for _ in range(max_events):
             msg = self.transport.next_event()
@@ -291,38 +302,153 @@ class GroupedStreamingLearnerLoop:
                 self.malformed_count += 1
                 continue
             entities.append(ent)
+        # rewards AFTER the event drain (a transport refilled mid-drain
+        # delivers this wave's rewards in time) but BEFORE the step
+        # dispatch — the bolt's rewards-before-selection order
+        # (ReinforcementLearnerBolt.java:92-99)
+        gids, aids, rs = self._parse_rewards()
         if not entities:
-            return 0
+            if gids:
+                self.group.add_groups(gids)
+                self.group.set_rewards(gids, aids, rs)
+            return 0, []
         self.group.add_groups(entities)
-        pending = entities
-        while pending:
+        if gids:
+            self.group.add_groups(gids)
+        out = []
+        todo = entities
+        first = True
+        while todo:
             wave: List[str] = []
             seen = set()
             rest: List[str] = []
-            for e in pending:
+            for e in todo:
                 (rest if e in seen else wave).append(e)
                 seen.add(e)
-            active = np.zeros(self.group.capacity, dtype=bool)
             rows = self.group.rows_for(wave)
-            active[rows] = True
             # batch.size selections per event in ONE jitted scan, matching
             # the scalar loop's learner.next_actions() / the bolt's
-            # eventID,action[,action...] format
-            sels = self.group.step_masked(active, self.group.batch_size)
-            for e, r in zip(wave, rows):
-                acts = ",".join(self.group.action_ids[s[r]] for s in sels)
-                self.transport.write_action(f"{e},{acts}")
-            pending = rest
+            # eventID,action[,action...] format.  Wave inputs (reward
+            # triples + active rows) ship as ONE packed int32 array —
+            # through a tunneled device each device_put / eager op is a
+            # serial ~100 ms round trip, so the RPC count per wave IS
+            # the throughput; buckets are powers of two so recompiles
+            # are O(log max-wave).  The first sub-wave carries the
+            # drained rewards; duplicate-entity sub-waves go reward-free.
+            nr = len(gids) if first else 0
+            rb = 8
+            while rb < nr:
+                rb *= 2
+            wb = 8
+            while wb < len(wave):
+                wb *= 2
+            packed = np.full(2 + 3 * rb + wb, self.group.capacity,
+                             np.int32)     # pad rows = capacity (dropped)
+            packed[0], packed[1] = nr, len(wave)
+            packed[2:2 + 3 * rb] = 0
+            if nr:
+                packed[2:2 + nr] = self.group.rows_for(gids)
+                packed[2 + rb:2 + rb + nr] = [
+                    self.group._aindex[x] for x in aids]
+                packed[2 + 2 * rb:2 + 2 * rb + nr] = rs
+            packed[2 + 3 * rb:2 + 3 * rb + len(wave)] = rows
+            sels = self.group.step_waved_async(packed, rb,
+                                               self.group.batch_size)
+            first = False
+            out.append((wave, rows, sels))
+            todo = rest
         self.event_count += len(entities)
-        return len(entities)
+        return len(entities), out
+
+    def _emit(self, pending) -> None:
+        """Materialize the device selections and write the
+        ``entityID,action[,action...]`` messages.  All pending waves'
+        selections concatenate ON DEVICE first so the whole batch costs
+        ONE blocking transfer (each read is a full tunnel round trip)."""
+        if not pending:
+            return
+        names = np.asarray(self.group.action_ids, dtype=object)
+        # concatenate per CAPACITY group: an auto-enroll between
+        # pipelined waves grows the fleet's state arrays, so backlogged
+        # selections may have different widths — one transfer per
+        # distinct shape (growth is O(log fleet), so still amortized)
+        mats: List = [None] * len(pending)
+        by_shape: Dict[tuple, List[int]] = {}
+        for i, (_, _, s) in enumerate(pending):
+            by_shape.setdefault(tuple(s.shape), []).append(i)
+        import jax.numpy as jnp
+        for shape, idxs in by_shape.items():
+            if len(idxs) == 1:
+                mats[idxs[0]] = np.asarray(pending[idxs[0]][2])
+                continue
+            flat = np.asarray(jnp.concatenate(
+                [pending[i][2] for i in idxs], axis=0))
+            ns = shape[0]
+            for j, i in enumerate(idxs):
+                mats[i] = flat[j * ns:(j + 1) * ns]
+        for (wave, rows, _), sels in zip(pending, mats):
+            acts = names[sels[:, rows]]                   # [n_steps, W]
+            if acts.shape[0] == 1:
+                for e, a in zip(wave, acts[0]):
+                    self.transport.write_action(f"{e},{a}")
+            else:
+                for i, e in enumerate(wave):
+                    self.transport.write_action(
+                        e + "," + ",".join(acts[:, i]))
+
+    def step_batch(self, max_events: int = 1024) -> int:
+        """Drain rewards + up to ``max_events`` events and write their
+        actions before returning (the synchronous surface; ``run()``
+        pipelines batches instead).  Entities repeating within a batch
+        step their learner once per event, preserving per-event
+        semantics."""
+        n, pending = self._dispatch_batch(max_events)
+        self._emit(pending)
+        return n
+
+    # dispatched batches whose selections are still device futures;
+    # bounding the backlog bounds action latency while amortizing the
+    # blocking device read (a full tunnel round trip) across waves
+    MAX_PENDING_BATCHES = 4
 
     def run(self, max_events: Optional[int] = None,
             idle_timeout: Optional[float] = 1.0,
             poll_interval: float = 0.01, batch: int = 1024) -> int:
-        return _pull_loop(
-            lambda room: self.step_batch(batch if room is None
-                                         else min(batch, room)),
-            max_events, idle_timeout, poll_interval)
+        """Pipelined pull loop: subsequent waves' drain/parse/dispatch
+        run while earlier device steps are still in flight; actions are
+        emitted (the blocking device read) once ``MAX_PENDING_BATCHES``
+        waves are queued, on idle, and before returning — so the queue
+        drains at dispatch speed and every action is flushed by exit."""
+        processed = 0
+        idle_since = None
+        prev: List = []
+        try:
+            while max_events is None or processed < max_events:
+                room = (batch if max_events is None
+                        else min(batch, max_events - processed))
+                n, pending = self._dispatch_batch(room)
+                if n:
+                    processed += n
+                    prev.extend(pending)
+                    if len(prev) >= self.MAX_PENDING_BATCHES:
+                        self._emit(prev)
+                        prev = []
+                    idle_since = None
+                    continue
+                if prev:                   # idle: flush before sleeping
+                    self._emit(prev)
+                    prev = []
+                if idle_timeout is None:
+                    time.sleep(poll_interval)
+                    continue
+                if idle_since is None:
+                    idle_since = time.monotonic()
+                elif time.monotonic() - idle_since > idle_timeout:
+                    break
+                time.sleep(poll_interval)
+        finally:
+            self._emit(prev)
+        return processed
 
 
 class ReinforcementLearnerTopology:

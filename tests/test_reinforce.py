@@ -597,6 +597,28 @@ def test_grouped_loop_batch_size_and_enroll_dedup():
     assert int(loop.group.total[loop.group.rows_for(["e9"])[0]]) == 3
 
 
+def test_grouped_loop_pipelined_emit_across_capacity_growth():
+    """Backlogged waves may straddle a fleet-capacity growth (auto-
+    enrollment doubles the state arrays), so the batched emit must
+    group selections by shape instead of concatenating mixed widths —
+    this crashed with a TypeError before the per-shape grouping."""
+    from avenir_tpu.models.streaming import (GroupedStreamingLearnerLoop,
+                                             InMemoryTransport)
+
+    config = {"reinforcement.learner.type": "upperConfidenceBoundOne",
+              "reinforcement.learner.actions": "x,y"}
+    t = InMemoryTransport()
+    loop = GroupedStreamingLearnerLoop(config, t, entities=["e0"])
+    cap0 = loop.group.capacity
+    for i in range(4):
+        t.push_event("e0", i)
+    for i in range(40):                      # forces capacity growth
+        t.push_event(f"n{i}", 9)
+    n = loop.run(max_events=44, idle_timeout=0.0, batch=4)
+    assert n == 44 and len(t.actions) == 44
+    assert loop.group.capacity > cap0
+
+
 def test_grouped_loop_skips_malformed_rewards():
     """2-field or unknown-action reward messages are counted and skipped,
     never crashing the fleet loop."""
