@@ -1,0 +1,111 @@
+"""Lucene-parity tokenizer (models/text.py): golden fixture pinning the
+StandardAnalyzer(Version.LUCENE_35) behavior the reference relies on
+(text/WordCounter.java:117-128, bayesian/BayesianDistribution.java:126-131).
+
+The expected outputs are derived from the UAX#29 word-break rules with
+the Unicode-6.0 class memberships (the data Lucene 3.5's JFlex grammar
+was generated from) plus Lucene's LowerCaseFilter, English StopFilter,
+and the maxTokenLength=255 discard in StandardTokenizer.incrementToken.
+No Lucene runtime exists in this environment, so the fixture is a
+spec-derived golden — each case cites the rule that produces it."""
+
+import numpy as np
+
+from avenir_tpu.models.text import (LUCENE_STOP_WORDS, MAX_TOKEN_LENGTH,
+                                    standard_tokenize, _uax29_words)
+
+GOLDEN = [
+    # (input, expected tokens after lowercase + stop filter)
+    # WB6/7: apostrophe (MidNumLet) joins letters
+    ("Don't stop believing", ["don't", "stop", "believing"]),
+    # leading/trailing apostrophes are not mid positions
+    ("'hello' 'quoted'", ["hello", "quoted"]),
+    # possessive: letter ' letter joins; trailing 's kept in-token
+    ("john.smith's house", ["john.smith's", "house"]),
+    # WB11/12: period/comma (MidNumLet/MidNum) join digits
+    ("pi is 3.14159 and 1,000,000 counts", ["pi", "3.14159",
+                                            "1,000,000", "counts"]),
+    # trailing separator does not join (needs a digit after)
+    ("end. 3. 4, x", ["end", "3", "4", "x"]),
+    # WB9/10: letters and digits form one ALPHANUM token
+    ("x86 3rd r2d2", ["x86", "3rd", "r2d2"]),
+    # hyphen is a break in UAX#29 (unlike ClassicAnalyzer's behavior)
+    ("wi-fi faster-than-light", ["wi", "fi", "faster", "than", "light"]),
+    # WB6: period between letters joins (domains, acronyms)
+    ("visit example.com or U.S.A. today", ["visit", "example.com",
+                                           "u.s.a", "today"]),
+    # colon was MidLetter in Unicode 6.0 (Lucene 3.5 era)
+    ("ratio a:b holds", ["ratio", "a:b", "holds"]),
+    # semicolon was MidNum in Unicode 6.0: digits join, letters don't
+    ("1;2 but a;b", ["1;2", "b"]),            # 'a' is a stop word
+    # WB13a/b: underscore (ExtendNumLet) joins words/numbers
+    ("foo_bar _lead trail_ snake_case_2", ["foo_bar", "_lead", "trail_",
+                                           "snake_case_2"]),
+    # bare underscores are not words
+    ("___ _ __", []),
+    # email: '@' breaks; the domain rejoins by WB6
+    ("mail foo@bar.com now", ["mail", "foo", "bar.com", "now"]),
+    # stop words removed AFTER lowercasing
+    ("The AND The IF these THEIR", []),
+    # mixed-class mids only join their own class: letter.digit breaks
+    ("x.1 1.x", ["x", "1", "1", "x"]),
+    # double mid characters break (WB6/11 need exactly one mid between)
+    ("x..z 1..2 x''z", ["x", "z", "1", "2", "x", "z"]),
+]
+
+
+def test_standard_tokenize_lucene_golden():
+    for text, want in GOLDEN:
+        assert standard_tokenize(text) == want, text
+
+
+def test_max_token_length_discard():
+    # 255 chars: kept; 256: DISCARDED (not truncated), like
+    # StandardTokenizer.incrementToken's skip-and-bump-posIncr
+    keep = "x" * MAX_TOKEN_LENGTH
+    drop = "y" * (MAX_TOKEN_LENGTH + 1)
+    assert standard_tokenize(f"{keep} ok") == [keep, "ok"]
+    assert standard_tokenize(f"{drop} ok") == ["ok"]
+
+
+def test_stop_set_is_lucene_33():
+    # exactly StopAnalyzer.ENGLISH_STOP_WORDS_SET
+    assert len(LUCENE_STOP_WORDS) == 33
+    assert {"a", "the", "such", "их" if False else "will"} <= LUCENE_STOP_WORDS
+
+
+def test_cjk_segmentation():
+    # IDEOGRAPHIC: one token per Han char; KATAKANA: runs; mixed with
+    # Latin
+    assert _uax29_words("日本語 text") == ["日", "本", "語", "text"]
+    assert _uax29_words("カタカナ run") == ["カタカナ", "run"]
+    # U+30FB KATAKANA MIDDLE DOT is Word_Break=Other in Unicode 6.0:
+    # it SEPARATES katakana words (the common name separator)
+    assert _uax29_words("カタ・カナ") == ["カタ", "カナ"]
+    # voiced-sound marks U+309B/309C are Katakana: they join runs
+    assert _uax29_words("ウ゛ェ") == ["ウ゛ェ"]
+
+
+def test_unicode_letters_and_digits():
+    # non-ASCII letters are ALetter; Arabic-Indic digits are Numeric
+    assert standard_tokenize("café naïve") == ["café", "naïve"]
+    assert _uax29_words("٣٤") == ["٣٤"]
+
+
+def test_tokenizer_feeds_wordcount_and_nb_text_mode(tmp_path, mesh8):
+    """End-to-end: WordCounter counts the UAX#29 tokens (3.14 and
+    example.com survive as single tokens; stop words are gone)."""
+    from avenir_tpu.core import JobConfig, write_output
+    from avenir_tpu.models.text import WordCounter
+
+    write_output(str(tmp_path / "in"),
+                 ["The value 3.14 at example.com",
+                  "example.com again: 3.14 the pi"])
+    WordCounter(JobConfig({"text.field.ordinal": "0"})).run(
+        str(tmp_path / "in"), str(tmp_path / "out"), mesh=mesh8)
+    counts = dict(
+        l.rsplit(",", 1)
+        for l in open(tmp_path / "out" / "part-r-00000").read().splitlines())
+    assert counts["3.14"] == "2"
+    assert counts["example.com"] == "2"
+    assert "the" not in counts and "The" not in counts
