@@ -34,25 +34,57 @@ class TemporalFilter:
     """Map-only epoch-time-range row filter (resource/fit.sh:30-41).
 
     Config (resource/fit.properties:8-14): ``time.stamp.field.ordinal``,
-    ``time.range`` = comma-separated ``start:end`` epoch-second windows
-    (inclusive), ``time.stamp.in.mili`` (divide by 1000 first),
-    ``time.zone.shift.hours`` (added before the compare),
-    ``seasonal.cycle.type`` — the reference pipeline uses
-    ``anyTimeRange``; other chombo cycle types are out of scope and fail
-    fast.  Rows inside any window pass through unchanged.
+    ``time.range`` = comma-separated ``start:end`` windows (inclusive),
+    ``time.stamp.in.mili`` (divide by 1000 first),
+    ``time.zone.shift.hours`` (added before the compare), and
+    ``seasonal.cycle.type``.  The reference pipeline uses
+    ``anyTimeRange`` (windows in raw epoch seconds); the other chombo
+    SeasonalAnalyzer cycle types interpret the windows as positions
+    WITHIN the cycle — chombo's source is not vendored in the reference
+    repo (SURVEY §2.0), so the cycle index definitions below are
+    reconstructed and documented here: ``quarterHourOfDay`` 0-95,
+    ``halfHourOfDay`` 0-47, ``hourOfDay`` 0-23 (all straight epoch
+    divisions), ``dayOfWeek`` 0-6 with 0 = Sunday (Java
+    Calendar.DAY_OF_WEEK order minus one), ``weekDayOrWeekEnd`` 0 =
+    weekday / 1 = weekend, ``monthOfYear`` 0-11 (UTC).  Unknown types
+    still fail fast.  Rows inside any window pass through unchanged.
     """
+
+    CYCLES = ("anyTimeRange", "quarterHourOfDay", "halfHourOfDay",
+              "hourOfDay", "dayOfWeek", "weekDayOrWeekEnd", "monthOfYear")
 
     def __init__(self, config: JobConfig):
         self.config = config
+
+    @staticmethod
+    def _cycle_index(cycle: str, t: int) -> int:
+        if cycle == "anyTimeRange":
+            return t
+        if cycle == "quarterHourOfDay":
+            return (t // 900) % 96
+        if cycle == "halfHourOfDay":
+            return (t // 1800) % 48
+        if cycle == "hourOfDay":
+            return (t // 3600) % 24
+        if cycle == "dayOfWeek":
+            # epoch day 0 (1970-01-01) was a Thursday; 0 = Sunday per
+            # Java Calendar.DAY_OF_WEEK - 1
+            return ((t // 86400) + 4) % 7
+        if cycle == "weekDayOrWeekEnd":
+            return 1 if ((t // 86400) + 4) % 7 in (0, 6) else 0
+        if cycle == "monthOfYear":
+            import time as _time
+            return _time.gmtime(t).tm_mon - 1
+        raise AssertionError(cycle)
 
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         cfg = self.config
         counters = Counters()
         cycle = cfg.get("seasonal.cycle.type", "anyTimeRange")
-        if cycle != "anyTimeRange":
+        if cycle not in self.CYCLES:
             raise ValueError(
-                f"seasonal.cycle.type {cycle!r} not supported; the "
-                "reference pipeline (fit.properties) uses anyTimeRange")
+                f"seasonal.cycle.type {cycle!r} not supported; known "
+                f"types: {', '.join(self.CYCLES)}")
         ts_ord = cfg.must_int("time.stamp.field.ordinal")
         in_mili = cfg.get_boolean("time.stamp.in.mili", False)
         shift = 3600 * (cfg.get_int("time.zone.shift.hours", 0) or 0)
@@ -61,7 +93,9 @@ class TemporalFilter:
             lo, _, hi = spec.partition(":")
             if not hi:
                 raise ValueError(f"bad time.range window {spec!r}; "
-                                 "expected start:end epoch seconds")
+                                 "expected start:end (epoch seconds for "
+                                 "anyTimeRange, cycle positions "
+                                 "otherwise)")
             ranges.append((int(lo), int(hi)))
         delim_regex = cfg.field_delim_regex()
 
@@ -72,7 +106,8 @@ class TemporalFilter:
             if in_mili:
                 t //= 1000
             t += shift
-            if any(lo <= t <= hi for lo, hi in ranges):
+            idx = self._cycle_index(cycle, t)
+            if any(lo <= idx <= hi for lo, hi in ranges):
                 out.append(line)
                 counters.incr("Basic", "Records emitted")
         write_output(out_path, out)

@@ -216,9 +216,9 @@ def pairwise_topk_ring(qnum: np.ndarray, qcat: np.ndarray,
         if not fused_topk_supported(
                 algorithm, k, nt, qnum.shape[1], qcat.shape[1], scale,
                 m_ax=d):
-            raise ValueError("ring selection='bins' needs the euclidean "
-                             "MXU kernel and shapes inside the fused "
-                             "engine's caps; use selection='sort'")
+            raise ValueError("ring selection='bins' needs shapes inside "
+                             "the fused engine's caps; use "
+                             "selection='sort'")
         vals, idxs, suspect = _ring_bins(
             qnum, qcat, tnum, tcat, cat_weights, wsum, k, algorithm,
             scale, mesh, nt)
@@ -361,7 +361,8 @@ def _ring_bins(qnum, qcat, tnum, tcat, cat_weights, wsum, k, algorithm,
             nj = min(seg_ext, m - base) // pt._TB
             if nj not in kernels:
                 kernels[nj] = pt._make_kernel(F, Ccat, cat_w, wsum, scale,
-                                              nj, bits, reduce_out=False)
+                                              nj, bits, reduce_out=False,
+                                              algorithm=algorithm)
 
         def local(qn, qc, tn, tc):
             r = jax.lax.axis_index("data")
@@ -478,7 +479,7 @@ def pairwise_distances(qnum: np.ndarray, qcat: np.ndarray,
                 algorithm, k0, nt, n_num, n_cat, scale, m_ax=m_ax):
             vals, idxs, suspect = fused_pairwise_topk(
                 qnum, qcat, tnum, tcat, cat_weights, wsum, scale, k0,
-                mesh=mesh)
+                mesh=mesh, algorithm=algorithm)
             bad = np.flatnonzero(suspect)
             if bad.size:
                 vals = np.array(vals)

@@ -86,6 +86,7 @@ _NGROUPS = 8       # reduced output: 8 sorted-16 lists (k <= 16 path)
 _WRED = 16 * _NGROUPS
 _MAX_K = 64
 _MAX_F = 1024
+_MAX_F_MANHATTAN = 64   # manhattan's numeric part is VPU broadcast work
 _MAX_CAT = 16
 _SEG = 1 << 18     # candidate-axis segment: packing budget is per-segment
 
@@ -108,20 +109,25 @@ def _seg_bits(extent: int) -> int:
 def fused_topk_supported(algorithm: str, k: int, nt: int,
                          n_num: int, n_cat: int, scale: int,
                          m_ax: int = 1) -> bool:
-    """Hard constraints of the fused engine: euclidean (the MXU
-    expansion), shapes inside the kernel's VMEM budget, and a packing
-    budget that keeps ``(value << idx_bits) | index`` inside one int32.
-    The budget is computed on the per-shard SEGMENT extent (at most
-    2^18 rows -> >= 2^13 value budget), so there is no candidate-count
-    cap -- large nt runs as several segments merged by a two-key sort."""
+    """Hard constraints of the fused engine: shapes inside the kernel's
+    VMEM budget and a packing budget that keeps ``(value << idx_bits) |
+    index`` inside one int32.  The budget is computed on the per-shard
+    SEGMENT extent (at most 2^18 rows -> >= 2^13 value budget), so
+    there is no candidate-count cap -- large nt runs as several
+    segments merged by a two-key sort.  Euclidean runs the numeric part
+    on the MXU at any width; manhattan's |a-b| is broadcast VPU work
+    (one unrolled [QB, TB] pass per column, Neighborhood.java:59-118),
+    so it is capped at 64 numeric columns — still the binned-minima
+    selection win that the ~1%-MFU sort engine lacks."""
     step = m_ax * _TB
     nt_pad = -(-max(nt, 1) // step) * step
     bits = _seg_bits(_seg_extent(nt_pad // m_ax))
     val_budget = 1 << (31 - bits)
-    return (algorithm == "euclidean"
+    max_f = {"euclidean": _MAX_F, "manhattan": _MAX_F_MANHATTAN}
+    return (algorithm in max_f
             and 0 < k <= _MAX_K
             and n_num + n_cat > 0
-            and n_num <= _MAX_F
+            and n_num <= max_f[algorithm]
             and n_cat <= _MAX_CAT
             and scale * 8 <= val_budget)
 
@@ -208,7 +214,8 @@ def _reduce_bins(regs):
 # --------------------------------------------------------------------------
 
 def _make_kernel(F: int, Ccat: int, cat_w: tuple, wsum: float, scale: int,
-                 nj: int, bits: int, reduce_out: bool):
+                 nj: int, bits: int, reduce_out: bool,
+                 algorithm: str = "euclidean"):
     """Tile kernel: distance block on MXU/VPU + packed register insert.
 
     Inputs: an SMEM (1,) scalar ``nv`` (count of REAL candidate rows in
@@ -246,12 +253,17 @@ def _make_kernel(F: int, Ccat: int, cat_w: tuple, wsum: float, scale: int,
         if F:
             qt = qn_ref[:]                          # [QB, F]
             tt = tn_ref[:]                          # [TB, F]
-            cross = jax.lax.dot_general(
-                qt, tt, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)  # [QB, TB]
-            q2 = jnp.sum(qt * qt, axis=1, keepdims=True)
-            t2 = jnp.sum(tt * tt, axis=1, keepdims=True).T
-            parts = jnp.maximum(q2 + t2 - 2.0 * cross, 0.0)
+            if algorithm == "euclidean":
+                cross = jax.lax.dot_general(
+                    qt, tt, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)  # [QB, TB]
+                q2 = jnp.sum(qt * qt, axis=1, keepdims=True)
+                t2 = jnp.sum(tt * tt, axis=1, keepdims=True).T
+                parts = jnp.maximum(q2 + t2 - 2.0 * cross, 0.0)
+            else:                                   # manhattan: VPU
+                for c in range(F):
+                    term = jnp.abs(qt[:, c:c + 1] - tt[:, c:c + 1].T)
+                    parts = term if parts is None else parts + term
         cat_acc = None
         for c in range(Ccat):
             mism = (qc_ref[:, c:c + 1] != tc_ref[:, c:c + 1].T)
@@ -259,7 +271,9 @@ def _make_kernel(F: int, Ccat: int, cat_w: tuple, wsum: float, scale: int,
             cat_acc = term if cat_acc is None else cat_acc + term
         if cat_acc is not None:
             parts = cat_acc if parts is None else parts + cat_acc
-        d = jnp.sqrt(parts / wsum)
+        d = parts / wsum
+        if algorithm == "euclidean":
+            d = jnp.sqrt(d)
         # clamp before the int cast: genuinely-overflowing distances
         # land at a defined huge int (>= val_max, so they pack to the
         # sentinel and set the overflow bit) instead of an undefined
@@ -379,7 +393,8 @@ def _lex_merge(v_all, i_all, k: int):
 
 def _build_fused(mesh, nq_pad: int, nt_pad: int, F: int, Ccat: int,
                  cat_w: tuple, wsum: float, scale: int, k: int,
-                 nt_true: int, interpret: bool):
+                 nt_true: int, interpret: bool,
+                 algorithm: str = "euclidean"):
     d_ax = mesh.shape["data"]
     m_ax = mesh.shape["model"]
     nq_loc = nq_pad // d_ax
@@ -396,7 +411,7 @@ def _build_fused(mesh, nq_pad: int, nt_pad: int, F: int, Ccat: int,
         nj = ext // _TB
         if nj not in kernels:
             kernels[nj] = _make_kernel(F, Ccat, cat_w, wsum, scale, nj,
-                                       bits, reduce_out)
+                                       bits, reduce_out, algorithm)
 
     def local(qn, qc, tn, tc):
         # per-shard real-candidate count: the authoritative padding /
@@ -457,7 +472,8 @@ def fused_pairwise_topk(qnum: np.ndarray, qcat: np.ndarray,
                         tnum: np.ndarray, tcat: np.ndarray,
                         cat_weights: np.ndarray, wsum: float,
                         scale: int, k: int, mesh=None,
-                        interpret: Optional[bool] = None
+                        interpret: Optional[bool] = None,
+                        algorithm: str = "euclidean"
                         ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Exact per-query k smallest (value, index) via the fused kernel.
 
@@ -494,13 +510,14 @@ def fused_pairwise_topk(qnum: np.ndarray, qcat: np.ndarray,
 
     key = (mesh, qnum_p.shape, qcat_p.shape, tnum_p.shape, tcat_p.shape,
            F, Ccat, tuple(np.asarray(cat_weights, np.float32)),
-           float(wsum), int(scale), int(k), nt, interpret)
+           float(wsum), int(scale), int(k), nt, interpret, algorithm)
     fn = bounded_cache_get(_fused_cache, key)
     if fn is None:
         fn = _build_fused(mesh, qnum_p.shape[0], tnum_p.shape[0], F, Ccat,
                           tuple(float(w) for w in
                                 np.asarray(cat_weights, np.float32)),
-                          float(wsum), int(scale), int(k), nt, interpret)
+                          float(wsum), int(scale), int(k), nt, interpret,
+                          algorithm)
         bounded_cache_put(_fused_cache, key, fn)
 
     vals, idxs, suspect = fn(qnum_p, qcat_p, tnum_p, tcat_p)

@@ -47,13 +47,46 @@ def test_temporal_filter_mili_shift_and_multi_range(tmp_path):
     assert out.get("Basic", "Records read") == 3
 
 
-def test_temporal_filter_rejects_other_cycle_types(tmp_path):
+def test_temporal_filter_rejects_unknown_cycle_types(tmp_path):
     _write(str(tmp_path / "in" / "part-00000"), ["a,1,x"])
     cfg = JobConfig({"tef.time.stamp.field.ordinal": "1",
                      "tef.time.range": "0:2",
-                     "tef.seasonal.cycle.type": "hourOfDay"}, "tef")
+                     "tef.seasonal.cycle.type": "lunarPhase"}, "tef")
     with pytest.raises(ValueError):
         TemporalFilter(cfg).run(str(tmp_path / "in"), str(tmp_path / "out"))
+
+
+def test_temporal_filter_seasonal_cycles(tmp_path):
+    """Seasonal cycle types: windows are positions within the cycle.
+    2021-03-01 (Monday) 00:30/09:30/13:30 UTC + 2021-03-06 (Saturday)
+    09:30 exercise hourOfDay, dayOfWeek, weekDayOrWeekEnd,
+    quarterHourOfDay and monthOfYear."""
+    mon0030 = 1614558600                     # 2021-03-01 00:30 UTC
+    mon0930 = 1614558600 + 9 * 3600          # 09:30 same Monday
+    mon1330 = 1614558600 + 13 * 3600
+    sat0930 = mon0930 + 5 * 86400            # Saturday
+    rows = [f"a,{mon0030},x", f"b,{mon0930},x",
+            f"c,{mon1330},x", f"d,{sat0930},x"]
+    _write(str(tmp_path / "in" / "part-00000"), rows)
+
+    def run(cycle, window):
+        cfg = JobConfig({"tef.time.stamp.field.ordinal": "1",
+                         "tef.time.range": window,
+                         "tef.seasonal.cycle.type": cycle}, "tef")
+        TemporalFilter(cfg).run(str(tmp_path / "in"),
+                                str(tmp_path / ("out_" + cycle)))
+        return _read(str(tmp_path / ("out_" + cycle)))
+
+    # business hours 9..16: keeps the two 09:30s and the 13:30
+    assert run("hourOfDay", "9:16") == [rows[1], rows[2], rows[3]]
+    # Monday = day 1 (0 = Sunday, Calendar.DAY_OF_WEEK order)
+    assert run("dayOfWeek", "1:1") == rows[:3]
+    # weekend bucket keeps only the Saturday row
+    assert run("weekDayOrWeekEnd", "1:1") == [rows[3]]
+    # quarter-hour 0:30 falls in slot 2 (00:30..00:44)
+    assert run("quarterHourOfDay", "2:2") == [rows[0]]
+    # March = month index 2
+    assert run("monthOfYear", "2:2") == rows
 
 
 def test_projection_grouping_ordering_compact(tmp_path):

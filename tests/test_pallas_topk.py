@@ -167,7 +167,10 @@ def test_fused_2d_pure_categorical(mesh8):
 def test_fused_gates():
     sup = pallas_topk.fused_topk_supported
     assert sup("euclidean", 16, 16384, 8, 2, 1000)
-    assert not sup("manhattan", 16, 16384, 8, 2, 1000)
+    assert sup("manhattan", 16, 16384, 8, 2, 1000)
+    assert sup("manhattan", 16, 16384, 64, 2, 1000)
+    assert not sup("manhattan", 16, 16384, 65, 2, 1000)     # VPU F cap
+    assert not sup("cosine", 16, 16384, 8, 2, 1000)
     assert not sup("euclidean", 128, 16384, 8, 2, 1000)     # k > max
     assert sup("euclidean", 16, 1 << 20, 8, 2, 1000)        # segmented: no
     assert sup("euclidean", 16, 1 << 22, 8, 2, 1000)        # nt cap
@@ -251,10 +254,59 @@ def test_fused_k_above_16_uses_bins_path(mesh1):
 
 
 def test_fused_forced_unsupported_raises(mesh1):
-    qn, qc, tn, tc, nw, cw = _rand(16, 128, 3, 0, seed=5)
+    qn, qc, tn, tc, nw, cw = _rand(16, 128, 80, 0, seed=5)
     with pytest.raises(ValueError):
+        # manhattan numeric width above the VPU cap
         pairwise_distances(qn, qc, tn, tc, nw, cw, top_k=4, mesh=mesh1,
                            algorithm="manhattan", topk_method="fused")
+
+
+def test_fused_manhattan_matches_sorted(mesh8, mesh1):
+    """Manhattan's numeric part runs as unrolled VPU broadcast work in
+    the fused kernel (no MXU expansion); values+indices must still equal
+    the sorted engine bit-for-bit, including the no-sqrt scaling."""
+    from avenir_tpu.parallel import make_mesh
+
+    qn, qc, tn, tc, nw, cw = _rand(90, 1111, 6, 2, seed=21)
+    for mesh in (mesh8, mesh1, make_mesh(data=2, model=4)):
+        _both(mesh, qn, qc, tn, tc, nw, cw, top_k=7,
+              algorithm="manhattan")
+    # ties through duplicated rows
+    tn2 = np.repeat(tn[:150], 6, axis=0)
+    tc2 = np.repeat(tc[:150], 6, axis=0)
+    _both(mesh8, qn, qc, tn2, tc2, nw, cw, top_k=9, algorithm="manhattan")
+
+
+def test_fused_manhattan_pure_categorical(mesh8):
+    from avenir_tpu.parallel import make_mesh
+
+    _, qc, _, tc, _, cw = _rand(24, 300, 0, 3, seed=22)
+    e = np.zeros((24, 0), np.float32)
+    et = np.zeros((300, 0), np.float32)
+    for mesh in (mesh8, make_mesh(data=4, model=2)):
+        _both(mesh, e, qc, et, tc, np.zeros(0), cw, top_k=5,
+              algorithm="manhattan")
+
+
+def test_ring_bins_manhattan(mesh8):
+    from avenir_tpu.ops.distance import pairwise_topk_ring
+
+    rng = np.random.default_rng(23)
+    nq, nt, F = 30, 700, 5
+    qn = rng.uniform(0, 10, (nq, F)).astype(np.float32)
+    tn = rng.uniform(0, 10, (nt, F)).astype(np.float32)
+    eq = np.zeros((nq, 0), np.int32)
+    et = np.zeros((nt, 0), np.int32)
+    w, z = rng.uniform(0.5, 2, F), np.zeros(0)
+    ref_d, _ = pairwise_distances(qn, eq, tn, et, w, z, top_k=6,
+                                  mesh=mesh8, topk_method="sorted",
+                                  algorithm="manhattan")
+    d, i = pairwise_topk_ring(qn, eq, tn, et, w, z, 6, mesh=mesh8,
+                              algorithm="manhattan", selection="bins")
+    np.testing.assert_array_equal(d, ref_d)
+    full, _ = pairwise_distances(qn, eq, tn, et, w, z, mesh=mesh8,
+                                 algorithm="manhattan")
+    np.testing.assert_array_equal(np.take_along_axis(full, i, axis=1), d)
 
 
 def test_fused_fuzz_vs_sorted(mesh8, mesh1):
@@ -281,11 +333,12 @@ def test_fused_fuzz_vs_sorted(mesh8, mesh1):
         nw = rng.uniform(0.2, 3.0, F)
         cw = rng.uniform(0.2, 3.0, C)
         mesh = [mesh8, mesh1, make_mesh(data=2, model=4)][trial % 3]
-        if F == 0 and mesh.shape["model"] > 1:
-            continue                      # fused gated off: nothing to A/B
+        alg = ["euclidean", "manhattan"][trial % 2]
         vr, ir = pairwise_distances(qn, qc, tn, tc, nw, cw, top_k=k,
-                                    mesh=mesh, topk_method="sorted")
+                                    mesh=mesh, topk_method="sorted",
+                                    algorithm=alg)
         vf, if_ = pairwise_distances(qn, qc, tn, tc, nw, cw, top_k=k,
-                                     mesh=mesh, topk_method="fused")
-        np.testing.assert_array_equal(vr, vf, err_msg=f"trial {trial}")
-        np.testing.assert_array_equal(ir, if_, err_msg=f"trial {trial}")
+                                     mesh=mesh, topk_method="fused",
+                                     algorithm=alg)
+        np.testing.assert_array_equal(vr, vf, err_msg=f"trial {trial} {alg}")
+        np.testing.assert_array_equal(ir, if_, err_msg=f"trial {trial} {alg}")
