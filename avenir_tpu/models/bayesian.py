@@ -851,7 +851,12 @@ class BayesianPredictor:
                 "n_healthy": int(healthy.sum()),
                 "n_tail": int((~healthy).sum())}
 
-    def run(self, in_path: str, out_path: str) -> Counters:
+    def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
+        """Score ``in_path`` (map-only).  With ``mesh``, rows shard over
+        the ``data`` axis and the batch scores as one ``shard_map`` pass
+        (the scoring math is row-local, so sharded and single-device
+        runs are bit-identical — asserted per mesh factorization by the
+        dryrun's whole-job parity leg)."""
         counters = Counters()
         delim_regex = self.config.field_delim_regex()
         delim = self.config.field_delim_out()
@@ -889,12 +894,31 @@ class BayesianPredictor:
         score_fn = (self._score_batch_f32
                     if self.score_precision == "float32"
                     else self._score_batch)
-        probs, feat_prior, feat_post = jax.jit(score_fn)(
-            jnp.asarray(ds.x), jnp.asarray(ds.values),
-            *[jnp.asarray(t) for t in tables])
-        probs = np.asarray(probs)
-        feat_prior = np.asarray(feat_prior)
-        feat_post = np.asarray(feat_post)
+        n = ds.x.shape[0]
+        if mesh is not None and mesh.shape["data"] > 1:
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            from ..parallel.mesh import pad_rows
+
+            d = mesh.shape["data"]
+            x_p, _ = pad_rows(ds.x, d)
+            v_p, _ = pad_rows(ds.values, d)
+            spec_t = tuple(P() for _ in tables)
+            fn = jax.jit(shard_map(
+                score_fn, mesh=mesh,
+                in_specs=(P("data"), P("data")) + spec_t,
+                out_specs=(P("data"), P("data"), P("data"))))
+            probs, feat_prior, feat_post = fn(
+                jnp.asarray(x_p), jnp.asarray(v_p),
+                *[jnp.asarray(t) for t in tables])
+        else:
+            probs, feat_prior, feat_post = jax.jit(score_fn)(
+                jnp.asarray(ds.x), jnp.asarray(ds.values),
+                *[jnp.asarray(t) for t in tables])
+        probs = np.asarray(probs)[:n]
+        feat_prior = np.asarray(feat_prior)[:n]
+        feat_post = np.asarray(feat_post)[:n]
 
         cls_field = schema.class_attr_field()
         actuals = [records[i][cls_field.ordinal] for i in range(len(records))]

@@ -47,6 +47,7 @@ from ..core.io import read_lines, split_line, write_output
 from ..core.metrics import Counters
 from ..core.schema import FeatureSchema
 from ..parallel.mesh import get_mesh, pad_rows
+from ..utils.caches import bounded_cache_get, bounded_cache_put
 
 CONVERGED = 100
 NOT_CONVERGED = 101
@@ -88,21 +89,26 @@ _grad_cache = {}
 
 
 def _gradient_fn(mesh, shape_key):
-    fn = _grad_cache.get((mesh, shape_key))
+    fn = bounded_cache_get(_grad_cache, (mesh, shape_key))
     if fn is None:
         def local(x, y, mask, w):
             # mapper hot loop: sigmoid scores + gradient outer-sum, one
-            # matvec pair on the MXU per shard; psum = reducer sum
-            z = x @ w
+            # matvec pair on the MXU per shard; psum = reducer sum.
+            # HIGHEST precision: the TPU default rounds f32 operands to
+            # bf16 (8 mantissa bits), which would quantize scores and
+            # gradients ~0.4% — the reference's mapper computes in
+            # doubles (LogisticRegressionJob gradient math)
+            hi = jax.lax.Precision.HIGHEST
+            z = jnp.matmul(x, w, precision=hi)
             p = 1.0 / (1.0 + jnp.exp(-z))
-            g = x.T @ jnp.where(mask, y - p, 0.0)
+            g = jnp.matmul(x.T, jnp.where(mask, y - p, 0.0), precision=hi)
             return jax.lax.psum(g, "data")
 
         fn = jax.jit(shard_map(
             local, mesh=mesh,
             in_specs=(P("data"), P("data"), P("data"), P()),
             out_specs=P()))
-        _grad_cache[(mesh, shape_key)] = fn
+        bounded_cache_put(_grad_cache, (mesh, shape_key), fn)
     return fn
 
 

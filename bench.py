@@ -67,7 +67,8 @@ def best_of(fn, reps=3):
 
 def samples_of(fn, reps=REPS):
     """``reps`` independent wall-clock samples of ``fn()`` (warmed up by
-    the caller): the spread is the evidence, the median the value."""
+    the caller): the min is the value (ambient contention on the shared
+    chip only inflates samples), the full spread the evidence."""
     out = []
     for _ in range(reps):
         t0 = time.perf_counter()
@@ -78,8 +79,8 @@ def samples_of(fn, reps=REPS):
 
 # --------------------------------------------------------------------------
 # bench history: committed BENCH_r*.json files carry each round's metrics;
-# comparing the median against the best prior value is what makes a silent
-# regression (like r4's kNN 18.1% -> 14.3% MFU drop) loud.
+# comparing the (min-time) value against the best prior value is what makes
+# a silent regression (like r4's kNN 18.1% -> 14.3% MFU drop) loud.
 
 def _history_values():
     """{metric_name: [prior values...]} from committed BENCH_r*.json."""
@@ -719,7 +720,8 @@ def bench_nb_score():
         _java_int32_np(ratio * 100)
 
     base_rows = n / best_of(np_run, 2)
-    out = {"metric": "nb_score_rows_per_sec_per_chip",
+    out = {"metric": "nb_score_f32_default_rows_per_sec_per_chip",
+           "renamed_from": "nb_score_rows_per_sec_per_chip",
            "value": round(rows_per_sec),
            "unit": "rows/sec/chip (2M rows, DEFAULT f32 log-space path, "
                    "parity-asserted vs f64 on-chip, dispatch-amortized)",
