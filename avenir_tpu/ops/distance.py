@@ -512,7 +512,7 @@ def pairwise_distances(qnum: np.ndarray, qcat: np.ndarray,
 
     key = (mesh, algorithm, scale, k, wsum, topk_method, qnum_p.shape,
            qcat_p.shape, tnum_p.shape, tcat_p.shape)
-    fn = _pairwise_cache.get(key)
+    fn = bounded_cache_get(_pairwise_cache, key)
     if fn is None:
         sentinel = np.int32(np.iinfo(np.int32).max)
 
@@ -547,7 +547,9 @@ def pairwise_distances(qnum: np.ndarray, qcat: np.ndarray,
             local, mesh=mesh,
             in_specs=(P("data"), P("data"), t_spec, t_spec, t_spec, P()),
             out_specs=out_specs))
-        _pairwise_cache[key] = fn
+        # suspect-row fallbacks re-enter with varying nq shapes, so keep
+        # a few more entries than the 4-deep engine caches
+        bounded_cache_put(_pairwise_cache, key, fn, cap=8)
 
     args = (qnum_p, qcat_p, tnum_p.astype(np.float32),
             tcat_p.astype(np.int32), tmask,
