@@ -33,7 +33,7 @@ from __future__ import annotations
 import re
 import time
 from collections import deque
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
