@@ -392,24 +392,32 @@ def bench_knn_distance():
 
     import functools
 
-    @functools.partial(jax.jit, static_argnames="R")
-    def rloop(q, qc, t, tc, R):
-        # R engine passes per dispatch; the +i*1e-6 query shift makes
-        # each iteration index-dependent so XLA cannot hoist it (the
-        # explicit f32 cast keeps the global x64 mode from promoting
-        # the whole query matrix to an emulated-f64 matmul)
-        def body(i, acc):
-            shift = (i * jnp.float32(1e-6)).astype(jnp.float32)
-            v, ii, s = fn(q + shift, qc, t, tc)
-            return (acc + v.ravel()[0] + ii.ravel()[0]
-                    + s.ravel()[0].astype(jnp.int32))
-        return jax.lax.fori_loop(0, R, body, (q[0, 0] * 0).astype(jnp.int32))
+    def make_amortized_loop(engine):
+        """R engine passes per dispatch inside one jitted fori_loop; the
+        +i*1e-6 shift on the first operand makes each iteration
+        index-dependent so XLA cannot hoist it (the explicit f32 cast
+        keeps the global x64 mode from promoting the operand to an
+        emulated-f64 matmul), and folding one element of every output
+        into the carry forces the whole engine to execute."""
+        @functools.partial(jax.jit, static_argnames="R")
+        def loop(R, *a):
+            def body(i, acc):
+                shift = (i * jnp.float32(1e-6)).astype(jnp.float32)
+                outs = engine(a[0] + shift, *a[1:])
+                for o in outs:
+                    acc = acc + o.ravel()[0].astype(jnp.int32)
+                return acc
+            return jax.lax.fori_loop(0, R, body,
+                                     (a[0][0, 0] * 0).astype(jnp.int32))
+        return loop
+
+    rloop = make_amortized_loop(fn)
 
     # the kernel runs in ~2 ms, well under the tunnel's fixed per-dispatch
     # round-trip — so time two R values per sample and take the
     # difference quotient, which cancels the constant dispatch exactly
     for r in (R_LO, R_HI):
-        np.asarray(rloop(qd, qcd, td, tcd, r))  # warmup/compile
+        np.asarray(rloop(r, qd, qcd, td, tcd))  # warmup/compile
     # value = MEDIAN of the same-rep difference quotients: pairing t_lo
     # and t_hi from the same rep cancels slow-varying ambient
     # contention on the shared chip (mixing mins across reps produced
@@ -417,8 +425,8 @@ def bench_knn_distance():
     # spiky reps; the full per-rep list ships as the spread evidence
     per_iters = []
     for _ in range(REPS):
-        t_lo = best_of(lambda: np.asarray(rloop(qd, qcd, td, tcd, R_LO)), 1)
-        t_hi = best_of(lambda: np.asarray(rloop(qd, qcd, td, tcd, R_HI)), 1)
+        t_lo = best_of(lambda: np.asarray(rloop(R_LO, qd, qcd, td, tcd)), 1)
+        t_hi = best_of(lambda: np.asarray(rloop(R_HI, qd, qcd, td, tcd)), 1)
         per_iters.append((t_hi - t_lo) / (R_HI - R_LO))
     per_iter = statistics.median(per_iters)
 
@@ -442,20 +450,65 @@ def bench_knn_distance():
                  (qr, np.zeros((qr.shape[0], 1), np.int32),
                   tr, np.zeros((tr.shape[0], 1), np.int32))]
 
-    @functools.partial(jax.jit, static_argnames="R")
-    def ring_loop(R, *a):
-        def body(i, acc):
-            sh = (i * jnp.float32(1e-6)).astype(jnp.float32)
-            out = ring_fn(a[0] + sh, *a[1:])
-            return acc + out[0].ravel()[0].astype(jnp.int32)
-        return jax.lax.fori_loop(0, R, body,
-                                 (a[0][0, 0] * 0).astype(jnp.int32))
+    ring_loop = make_amortized_loop(ring_fn)
 
     for r in (R_LO, R_HI):
         np.asarray(ring_loop(r, *ring_args))
     ring_dev = ((best_of(lambda: np.asarray(ring_loop(R_HI, *ring_args)))
                  - best_of(lambda: np.asarray(ring_loop(R_LO, *ring_args))))
                 / (R_HI - R_LO))
+
+    # --- million-row candidate axis: the segmented path at real scale -
+    # (VERDICT r4 item 4 evidence: packing budget computed per 2^18-row
+    # segment, selections lex-merged — verified vs the sorted engine on
+    # a row sample, then timed)
+    nt_m, f_m, nq_m = 1_050_000, 64, 2048
+    rng_m = np.random.default_rng(7)
+    q_m = rng_m.uniform(0, 1, (nq_m, f_m)).astype(np.float32)
+    t_m = rng_m.uniform(0, 1, (nt_m, f_m)).astype(np.float32)
+    eq_m = np.zeros((nq_m, 0), np.int32)
+    et_m = np.zeros((nt_m, 0), np.int32)
+    w_m = np.ones(f_m)
+    ns = 256
+    vf_m, if_m = pairwise_distances(q_m[:ns], eq_m[:ns], t_m, et_m, w_m, cw,
+                                    top_k=k, mesh=mesh, topk_method="fused")
+    vs_m, _ = pairwise_distances(q_m[:ns], eq_m[:ns], t_m, et_m, w_m, cw,
+                                 top_k=k, mesh=mesh, topk_method="sorted")
+    d_m = np.abs(vf_m.astype(np.int64) - vs_m.astype(np.int64)).max()
+    assert d_m <= 1, f"segmented 1M-row fused drift {d_m} > 1 int unit"
+    # index validity through the lex-merge: an exact f64 oracle distance
+    # computed AT the fused indices must match the sorted engine's k
+    # smallest within the same 1-unit boundary (a mis-offset segment
+    # index would surface as a wildly wrong gathered distance)
+    gat = t_m[if_m].astype(np.float64)              # [ns, k, F]
+    d2g = ((q_m[:ns, None, :].astype(np.float64) - gat) ** 2).sum(-1)
+    dg = np.sort((np.sqrt(d2g / f_m) * 1000).astype(np.int64), axis=1)
+    # <=2: the f32 engine's +-1 int rounding vs the f64 oracle can stack
+    # with +-1 of rank misalignment among dense ties after the sort; a
+    # mis-offset segment index would gather a distance off by hundreds
+    assert np.abs(dg - np.sort(vs_m.astype(np.int64), axis=1)).max() <= 2, \
+        "segmented 1M-row fused indices carry wrong oracle distances"
+
+    qf_m, tf_m, _ = _fold_weights(q_m, t_m, w_m, cw, "euclidean")
+    qp_m, _ = pad_rows(qf_m, n_chips * pallas_topk._QB)
+    tp_m, _ = pad_rows(tf_m, pallas_topk._TB)
+    fn_m = pallas_topk._build_fused(
+        mesh, qp_m.shape[0], tp_m.shape[0], f_m, 0, (), float(f_m), 1000,
+        k, nt_m, interpret=False)
+    qd_m, td_m = jax.device_put(qp_m), jax.device_put(tp_m)
+    qc_m = jax.device_put(np.zeros((qp_m.shape[0], 1), np.int32))
+    tc_m = jax.device_put(np.zeros((tp_m.shape[0], 1), np.int32))
+
+    mloop = make_amortized_loop(fn_m)
+    for r in (3, 9):
+        np.asarray(mloop(r, qd_m, qc_m, td_m, tc_m))
+    m_quots = []
+    for _ in range(REPS):
+        t3 = best_of(lambda: np.asarray(mloop(3, qd_m, qc_m, td_m, tc_m)), 1)
+        t9 = best_of(lambda: np.asarray(mloop(9, qd_m, qc_m, td_m, tc_m)), 1)
+        m_quots.append((t9 - t3) / 6)
+    per_m = statistics.median(m_quots)
+    gflops_m = 2.0 * nq_m * nt_m * f_m / per_m / 1e9 / n_chips
 
     # single-core NumPy baseline: identical math incl. int scale + top-k
     def np_run():
@@ -474,6 +527,13 @@ def bench_knn_distance():
            "vs_baseline": round(gflops_chip / base_gflops, 3),
            "fallback_rows": n_fallback,
            "drifted_rows_oracle_checked": int(drifted.size),
+           "segmented_1m_gflops_per_chip": round(gflops_m, 1),
+           "segmented_1m_gflops_spread": [
+               round(2.0 * nq_m * nt_m * f_m / t / 1e9 / n_chips, 1)
+               for t in sorted(m_quots)],
+           "segmented_1m_shape": f"{nq_m}x{nt_m}x{f_m} (4+ segments, "
+                                 f"values+indices A/B- and "
+                                 f"oracle-checked on {ns} rows)",
            "ring_engine_wall_clock_sec": round(ring_t, 4),
            "ring_engine_device_ms_per_pass": round(1e3 * ring_dev, 2)}
     peak = _bf16_peak()
