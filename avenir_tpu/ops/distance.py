@@ -451,10 +451,11 @@ def pairwise_distances(qnum: np.ndarray, qcat: np.ndarray,
     with a sound overflow check falling back per-row to the sort path)
     when applicable, else the sort-based selection.  ``'fused'`` /
     ``'sorted'`` force one engine; ``'approx'`` opts into
-    ``lax.approx_min_k``.  On TPU the two exact engines may differ by
-    ±1 int unit on a ~1e-3 fraction of rows (MXU one-pass rounding of
-    the cross-term lands on different sides of the int-scale boundary);
-    on CPU both are bit-identical to the NumPy oracle.
+    ``lax.approx_min_k``.  The two exact engines compute the cross-term
+    through different matmul shapes, so a distance landing exactly on
+    an int-scale rounding boundary may differ by ±1 unit between them —
+    ~1e-3 of rows on TPU (MXU pass rounding), and empirically ~1e-5 of
+    ELEMENTS on CPU (XLA dot tiling; a 60-trial fuzz found one).
     """
     mesh = mesh or get_mesh()
     d = mesh.shape["data"]
