@@ -302,6 +302,29 @@ def test_f32_scoring_adversarial_tail_densities(mesh8):
     assert np.abs(p32[band] - p64[band]).max() <= 1
 
 
+def test_predictor_mesh_sharded_scoring_byte_parity(tmp_path, mesh8, mesh1):
+    """BayesianPredictor.run(mesh=...) shards rows over the data axis;
+    the scoring math is row-local, so the sharded run's output file must
+    be byte-identical to the single-device run (the contract the
+    multichip dryrun's whole-job parity leg asserts per mesh
+    factorization) — ragged row count on purpose."""
+    rows = gen_telecom_churn(137, seed=23)
+    schema_path = tmp_path / "schema.json"
+    schema_path.write_text(json.dumps(SCHEMA))
+    write_output(str(tmp_path / "train"), [",".join(r) for r in rows[:100]])
+    write_output(str(tmp_path / "test"), [",".join(r) for r in rows[100:]])
+    BayesianDistribution(JobConfig({
+        "feature.schema.file.path": str(schema_path)})).run(
+        str(tmp_path / "train"), str(tmp_path / "model"), mesh=mesh8)
+    for tag, mesh in (("m8", mesh8), ("m1", mesh1)):
+        BayesianPredictor(JobConfig({
+            "feature.schema.file.path": str(schema_path),
+            "bayesian.model.file.path": str(tmp_path / "model")})).run(
+            str(tmp_path / "test"), str(tmp_path / f"pred_{tag}"), mesh=mesh)
+    assert (open(tmp_path / "pred_m8" / "part-r-00000").read()
+            == open(tmp_path / "pred_m1" / "part-r-00000").read())
+
+
 def test_java_int_cast_extremes(mesh8):
     """Numeric-extreme cast parity (BayesianPredictor.java:416, JLS
     §5.1.3): ratios past 2^31 saturate at Integer.MAX_VALUE, NaN ratios

@@ -597,6 +597,54 @@ def test_grouped_loop_batch_size_and_enroll_dedup():
     assert int(loop.group.total[loop.group.rows_for(["e9"])[0]]) == 3
 
 
+def test_step_waved_async_matches_eager_reward_path():
+    """The fused wave call (packed reward scatter + masked steps in one
+    jit, key advanced in-jit) must leave the SAME learner state as the
+    eager set_rewards + step_masked path when both consume the same
+    rewards and step the same rows — including duplicate (group, action)
+    reward entries and zero-weight padding."""
+    from avenir_tpu.models.reinforce_vec import VectorizedLearnerGroup
+
+    def build():
+        g = VectorizedLearnerGroup(
+            "upperConfidenceBoundOne", [f"g{i}" for i in range(6)],
+            ["x", "y", "z"], {"reward.scale": "4", "min.trial": "1",
+                              "random.seed": "7"})
+        return g
+
+    a_grp, b_grp = build(), build()
+    gids = ["g1", "g2", "g2", "g5"]          # duplicate (g2) entries
+    aids = ["x", "z", "z", "y"]
+    rs = [8, 12, 4, 20]
+    active_rows = [0, 2, 5]
+
+    # eager path
+    a_grp.set_rewards(gids, aids, rs)
+    active = np.zeros(a_grp.capacity, bool)
+    active[active_rows] = True
+    a_grp.step_masked(active, 2)
+
+    # fused packed path (bucket 8 -> 4 padding entries)
+    rb, wb = 8, 8
+    packed = np.full(2 + 3 * rb + wb, b_grp.capacity, np.int32)
+    packed[0], packed[1] = len(gids), len(active_rows)
+    packed[2:2 + 3 * rb] = 0
+    packed[2:2 + len(gids)] = b_grp.rows_for(gids)
+    packed[2 + rb:2 + rb + len(aids)] = [b_grp._aindex[x] for x in aids]
+    packed[2 + 2 * rb:2 + 2 * rb + len(rs)] = rs
+    packed[2 + 3 * rb:2 + 3 * rb + len(active_rows)] = active_rows
+    b_grp.step_waved_async(packed, rb, 2)
+
+    np.testing.assert_array_equal(np.asarray(a_grp.rsum),
+                                  np.asarray(b_grp.rsum))
+    np.testing.assert_array_equal(np.asarray(a_grp.rcnt),
+                                  np.asarray(b_grp.rcnt))
+    np.testing.assert_array_equal(np.asarray(a_grp.trials),
+                                  np.asarray(b_grp.trials))
+    np.testing.assert_array_equal(np.asarray(a_grp.total),
+                                  np.asarray(b_grp.total))
+
+
 def test_grouped_loop_pipelined_emit_across_capacity_growth():
     """Backlogged waves may straddle a fleet-capacity growth (auto-
     enrollment doubles the state arrays), so the batched emit must
