@@ -327,18 +327,24 @@ class DatasetEncoder:
                               ids if ids is not None else [], [])
 
     def encode_path_chunks(self, path: str, delim: str = ",",
-                           chunk_bytes: int = 48 << 20):
+                           chunk_bytes: int = 48 << 20,
+                           chunk_rows: Optional[int] = None):
         """Generator over C-encoded chunks of the input, split at line
         boundaries: yields ``(x, values, y, n_rows)`` per chunk with the
         SAME shared vocabularies as ``encode_path`` (codes are globally
         stable across chunks), so callers can pipeline
         encode -> device-transfer -> count with double buffering instead
         of one serial pass (the streaming-record-reader role of Hadoop
-        input splits).  Raises ``ChunkedEncodeUnsupported`` when the
-        native path does not apply — callers fall back to
-        ``encode_path``.  No per-chunk bin shifting happens here: callers
-        own the declared-extent/negative-bin guards (see
-        models.bayesian's streamed trainer)."""
+        input splits).  ``chunk_rows`` selects fixed ROW chunks (the
+        ``pipeline.chunk.rows`` surface; boundaries from one vectorized
+        newline scan — blank lines count toward a chunk's line budget but
+        not its parsed rows, so chunks are <= chunk_rows rows each);
+        otherwise chunks are ~``chunk_bytes``.  Raises
+        ``ChunkedEncodeUnsupported`` when the native path does not apply
+        — callers fall back to ``encode_path``.  No per-chunk bin
+        shifting happens here: callers own the
+        declared-extent/negative-bin guards (see models.bayesian's
+        streamed trainer)."""
         from .io import is_plain_delim
         from .. import native
 
@@ -357,12 +363,24 @@ class DatasetEncoder:
         id_ord = -1          # the training path never reads row ids;
         #                      skipping them drops the id-bytes copy pass
         buf = native._read_buffer(path)
+        row_ends = None
+        if chunk_rows is not None:
+            chunk_rows = max(int(chunk_rows), 1)
+            nl = np.flatnonzero(np.frombuffer(buf, dtype=np.uint8)
+                                == ord("\n"))
+            # byte offset just past every chunk_rows-th line boundary
+            row_ends = list(nl[chunk_rows - 1::chunk_rows] + 1)
+            if not row_ends or row_ends[-1] < len(buf):
+                row_ends.append(len(buf))
         pos = 0
         while pos < len(buf):
-            end = min(pos + chunk_bytes, len(buf))
-            if end < len(buf):
-                nl = buf.find(b"\n", end)
-                end = len(buf) if nl < 0 else nl + 1
+            if row_ends is not None:
+                end = int(row_ends.pop(0))
+            else:
+                end = min(pos + chunk_bytes, len(buf))
+                if end < len(buf):
+                    nl = buf.find(b"\n", end)
+                    end = len(buf) if nl < 0 else nl + 1
             chunk = buf[pos:end]
             # the newline count equals the parser's row count only when no
             # blank lines exist (csv_scan/csv_parse skip them); blanks are

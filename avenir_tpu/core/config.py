@@ -94,6 +94,22 @@ class JobConfig:
     def field_delim_out(self) -> str:
         return self.get("field.delim.out", self.get("field.delim", ","))
 
+    # -- streaming-ingest pipeline surface (core/pipeline.py) -------------
+    # Every count-table trainer honors these keys (with the usual job
+    # prefix fallback): ``pipeline.chunk.rows`` enables chunked streaming
+    # ingest, ``pipeline.prefetch.depth`` bounds the host->device
+    # double-buffer (0 = strict serial), ``pipeline.device.budget.bytes``
+    # derives the chunk size from an explicit device-memory budget.
+    def pipeline_chunk_rows(self, row_bytes: Optional[int] = None,
+                            default: Optional[int] = None) -> Optional[int]:
+        from .pipeline import chunk_rows_from_config
+        return chunk_rows_from_config(self, row_bytes=row_bytes,
+                                      default=default)
+
+    def pipeline_prefetch_depth(self) -> int:
+        from .pipeline import prefetch_depth_from_config
+        return prefetch_depth_from_config(self)
+
 
 def parse_properties(text: str) -> Dict[str, str]:
     """Parse Java .properties: ``k=v`` / ``k: v`` lines, #/! comments,

@@ -1,0 +1,363 @@
+"""Out-of-core chunked ingest: double-buffered host->device prefetch with
+streaming on-device aggregation.
+
+Every batch trainer in this package reduces its input to a small dense
+count/moment table (``ops.counting``).  The monolithic path materializes the
+WHOLE encoded row matrix on host, ships it in one blocking ``device_put``,
+and counts once — fine when the dataset fits, hopeless when it does not, and
+serial either way (parse, transfer, and compute never overlap; the headline
+BENCH numbers are dispatch-amortized and exclude all of it).  This module is
+the end-to-end replacement: the input streams through in fixed-size ROW
+chunks and the chips stay busy while the host parses ahead.
+
+Pipeline shape (the ``DataParallelPartitioner`` idiom from SNIPPETS.md —
+explicit data shardings, process-local chunks placed onto the mesh's data
+axis — crossed with Hadoop's streaming record reader):
+
+    reader/parser (host thread)  ->  async device_put (H2D)  ->  fold (TPU)
+         chunk c+2                       chunk c+1                 chunk c
+
+- **Chunking** is by rows (``pipeline.chunk.rows``), split on line
+  boundaries through the ``is_plain_delim`` fast path with ONE bulk NumPy
+  split per chunk (``iter_field_chunks``) — no per-line Python loop.
+- **Prefetch** (``pipeline.prefetch.depth``) bounds how many chunks may be
+  parsed + transferred ahead of the fold consuming them: depth 0 is the
+  strict serial reference (parse, transfer, fold, block — no overlap), depth
+  d >= 1 runs the parser/transfer on a worker thread feeding a bounded queue
+  so chunk c+1's H2D copy overlaps chunk c's device compute.  Device
+  residency is bounded by (depth + 2) chunks + the carry, never the dataset:
+  inputs larger than HBM stream through (``rows_for_budget`` sizes chunks
+  from an explicit ``pipeline.device.budget.bytes``).
+- **Aggregation** is a jitted, DONATED accumulator: every consumer exposes
+  the same ``local_fn(*chunk_shards, mask, *static_args) -> pytree`` used by
+  ``ops.counting.sharded_reduce`` and the engine folds
+  ``carry = carry + psum(local_fn(chunk))`` with the carry buffer donated,
+  so the accumulator never copies and the count tables are BIT-IDENTICAL to
+  the monolithic pass (integer scatter-adds commute; asserted per consumer
+  in tests/test_pipeline.py).
+
+Consumers wired through this engine: Naive Bayes training
+(models/bayesian), Markov transition counts (models/markov), decision-tree
+level passes and split-gain counting (models/tree), Apriori support counting
+(models/association), mutual-information tables (models/mutual_info).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# config keys (the .properties surface; JobConfig prefix fallback applies)
+KEY_CHUNK_ROWS = "pipeline.chunk.rows"
+KEY_PREFETCH_DEPTH = "pipeline.prefetch.depth"
+KEY_DEVICE_BUDGET = "pipeline.device.budget.bytes"
+
+DEFAULT_CHUNK_ROWS = 1 << 16
+DEFAULT_PREFETCH_DEPTH = 2
+
+
+def chunk_rows_from_config(cfg, row_bytes: Optional[int] = None,
+                           default: Optional[int] = None) -> Optional[int]:
+    """Resolve the chunk row count: explicit ``pipeline.chunk.rows`` wins;
+    else a configured ``pipeline.device.budget.bytes`` (with a caller row
+    size estimate) derives it; else ``default`` (None = caller keeps its
+    monolithic path)."""
+    rows = cfg.get_int(KEY_CHUNK_ROWS, None)
+    if rows is not None:
+        if rows <= 0:
+            raise ValueError(f"{KEY_CHUNK_ROWS} must be positive: {rows}")
+        return rows
+    budget = cfg.get_int(KEY_DEVICE_BUDGET, None)
+    if budget is not None and row_bytes:
+        return rows_for_budget(budget, row_bytes,
+                               prefetch_depth_from_config(cfg))
+    return default
+
+
+def prefetch_depth_from_config(cfg) -> int:
+    depth = cfg.get_int(KEY_PREFETCH_DEPTH, DEFAULT_PREFETCH_DEPTH)
+    if depth < 0:
+        raise ValueError(f"{KEY_PREFETCH_DEPTH} must be >= 0: {depth}")
+    return depth
+
+
+def rows_for_budget(budget_bytes: int, row_bytes: int,
+                    prefetch_depth: int = DEFAULT_PREFETCH_DEPTH) -> int:
+    """Chunk rows such that all concurrently-live chunks fit the device
+    budget: up to ``depth`` queued + 1 folding + 1 in transfer."""
+    live = prefetch_depth + 2
+    return max(int(budget_bytes) // (max(int(row_bytes), 1) * live), 1)
+
+
+# ---------------------------------------------------------------------------
+# chunk readers (host side)
+# ---------------------------------------------------------------------------
+
+def iter_line_chunks(path: str, chunk_rows: int) -> Iterator[List[str]]:
+    """Yield non-empty record lines in chunks of ``chunk_rows`` — the
+    row-chunked form of ``core.io.read_lines`` (same skip-blank contract),
+    reading one buffered file at a time so memory is O(chunk)."""
+    from .io import _input_files
+
+    if chunk_rows <= 0:
+        raise ValueError(f"chunk_rows must be positive: {chunk_rows}")
+    buf: List[str] = []
+    for fp in _input_files(path):
+        with open(fp, "r") as fh:
+            for line in fh:
+                line = line.rstrip("\n")
+                if line:
+                    buf.append(line)
+                    if len(buf) >= chunk_rows:
+                        yield buf
+                        buf = []
+    if buf:
+        yield buf
+
+
+def iter_field_chunks(path: str, delim_regex: str,
+                      chunk_rows: int) -> Iterator[object]:
+    """Row chunks as 2-D string ndarrays via ONE whole-chunk split (the
+    ``read_field_matrix`` bulk parser, per chunk): the vectorized ingest
+    fast path for plain single-character delimiters.  Ragged chunks or
+    regex delimiters degrade to per-line field lists — callers treat both
+    shapes uniformly (ndarray column indexing vs list indexing is hidden
+    behind ``DatasetEncoder.encode``)."""
+    from .io import is_plain_delim, split_line
+
+    plain = is_plain_delim(delim_regex)
+    for lines in iter_line_chunks(path, chunk_rows):
+        if plain:
+            n_delim = lines[0].count(delim_regex)
+            if all(l.count(delim_regex) == n_delim for l in lines):
+                flat = delim_regex.join(lines).split(delim_regex)
+                yield np.asarray(flat, dtype=str).reshape(
+                    len(lines), n_delim + 1)
+                continue
+        yield [split_line(l, delim_regex) for l in lines]
+
+
+def peek(it: Iterable):
+    """(first item, iterator replaying it) — lets callers size static
+    extents (caps) from the first chunk before the fold compiles.  Returns
+    (None, empty iterator) for an empty stream."""
+    it = iter(it)
+    try:
+        first = next(it)
+    except StopIteration:
+        return None, iter(())
+
+    def chain():
+        yield first
+        yield from it
+
+    return first, chain()
+
+
+# ---------------------------------------------------------------------------
+# the streaming fold engine
+# ---------------------------------------------------------------------------
+
+# Compiled (first, accumulate) step pairs keyed like ops.counting's reduce
+# cache: a stable local_fn object + static args lets every chunk (and every
+# training run) hit the jit cache.
+_fold_cache: dict = {}
+
+
+def _fold_fns(local_fn: Callable, mesh, static_args: tuple,
+              ndims: Tuple[int, ...], n_bcast: int):
+    import jax
+    from ..parallel.mesh import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    key = (local_fn, mesh, static_args, ndims, n_bcast)
+    fns = _fold_cache.get(key)
+    if fns is not None:
+        return fns
+    axes = tuple(mesh.axis_names)
+    row_specs = tuple(P(axes, *([None] * (nd - 1))) for nd in ndims)
+    chunk_specs = row_specs + (P(axes),) + (P(),) * n_bcast
+
+    def first(*args):
+        shards, m = args[:len(ndims)], args[len(ndims)]
+        bcast = args[len(ndims) + 1:]
+        out = local_fn(*shards, m, *bcast, *static_args)
+        return jax.tree_util.tree_map(
+            lambda t: jax.lax.psum(t, axes), out)
+
+    first_fn = jax.jit(shard_map(first, mesh=mesh, in_specs=chunk_specs,
+                                 out_specs=P()))
+
+    def acc(carry, *args):
+        shards, m = args[:len(ndims)], args[len(ndims)]
+        bcast = args[len(ndims) + 1:]
+        out = local_fn(*shards, m, *bcast, *static_args)
+        return jax.tree_util.tree_map(
+            lambda c, t: c + jax.lax.psum(t, axes), carry, out)
+
+    # donate_argnums=0: the carry buffer is reused in place — the
+    # accumulator costs zero copies however many chunks stream through
+    acc_fn = jax.jit(shard_map(acc, mesh=mesh,
+                               in_specs=(P(),) + chunk_specs,
+                               out_specs=P()),
+                     donate_argnums=0)
+    fns = (first_fn, acc_fn)
+    _fold_cache[key] = fns
+    return fns
+
+
+def _bucket_rows(n: int, d: int, capacity: Optional[int]) -> int:
+    """Padded leading extent for an n-row chunk on a d-device mesh: the
+    fixed ``capacity`` (one compiled shape for every chunk including the
+    ragged tail) or the next power-of-two per-shard rows (O(log) shapes
+    for variable-size chunks, e.g. flattened transition-pair streams)."""
+    if capacity is not None:
+        if n > capacity:
+            raise ValueError(f"chunk of {n} rows exceeds capacity {capacity}")
+        return -(-capacity // d) * d
+    per = -(-n // d)
+    return d * (1 << max(per - 1, 0).bit_length())
+
+
+class _PrefetchError:
+    __slots__ = ("exc",)
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+_DONE = object()
+
+
+def streaming_fold(chunks: Iterable[Tuple[np.ndarray, ...]],
+                   local_fn: Callable,
+                   static_args: tuple = (),
+                   broadcast_args: Sequence[np.ndarray] = (),
+                   mesh=None,
+                   prefetch_depth: int = DEFAULT_PREFETCH_DEPTH,
+                   capacity: Optional[int] = None):
+    """Fold row chunks into one replicated count pytree on device.
+
+    ``chunks`` yields tuples of host arrays sharing a leading row count
+    (any per-chunk host work — parsing, binning, host-side moment
+    accumulation, cap guards — belongs in the generator: with
+    ``prefetch_depth >= 1`` it runs on the prefetch thread, overlapping
+    the device fold).  Each chunk is padded to the bucketed extent with a
+    validity mask (False rows contribute nothing — the ``count_table``
+    drop contract), placed row-sharded over every mesh axis with an ASYNC
+    ``device_put``, and folded:
+
+        carry = carry + psum(local_fn(*shards, mask, *broadcast, *static))
+
+    with the carry donated (in-place accumulate).  ``broadcast_args`` are
+    transferred once and replicated (e.g. a candidate-itemset index
+    matrix).  ``prefetch_depth`` 0 = strict serial (each fold blocks
+    before the next chunk parses: the no-overlap reference the bench
+    A/Bs against); depth d >= 1 = worker-thread parse + transfer, at
+    most d chunks queued ahead.
+
+    Returns the carry pytree as host numpy arrays, or None if the stream
+    was empty.  Exceptions in the generator (e.g. a cap-guard
+    ``ChunkedEncodeUnsupported``) propagate to the caller regardless of
+    which thread raised them.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import get_mesh
+
+    mesh = mesh or get_mesh()
+    d = int(mesh.devices.size)
+    axes = tuple(mesh.axis_names)
+
+    def row_sharding(ndim):
+        return NamedSharding(mesh, P(axes, *([None] * (ndim - 1))))
+
+    bcast_dev = tuple(
+        jax.device_put(np.asarray(b), NamedSharding(mesh, P()))
+        for b in broadcast_args)
+
+    def transfer(arrs):
+        arrs = tuple(np.asarray(a) for a in arrs)
+        n = arrs[0].shape[0]
+        for a in arrs:
+            if a.shape[0] != n:
+                raise ValueError("chunk arrays disagree on row count")
+        target = _bucket_rows(n, d, capacity)
+        mask = np.zeros(target, dtype=bool)
+        mask[:n] = True
+        out = []
+        for a in arrs:
+            if target != n:
+                pad = np.zeros((target - n,) + a.shape[1:], dtype=a.dtype)
+                a = np.concatenate([a, pad])
+            out.append(jax.device_put(a, row_sharding(a.ndim)))
+        out.append(jax.device_put(mask, row_sharding(1)))
+        return tuple(out)
+
+    carry = None
+    fns = None
+
+    def fold(dev):
+        nonlocal carry, fns
+        if fns is None:
+            fns = _fold_fns(local_fn, mesh, static_args,
+                            tuple(a.ndim for a in dev[:-1]), len(bcast_dev))
+        if carry is None:
+            carry = fns[0](*dev, *bcast_dev)
+        else:
+            carry = fns[1](carry, *dev, *bcast_dev)
+
+    if prefetch_depth <= 0:
+        # strict serial: parse -> transfer -> fold -> BLOCK, per chunk
+        for item in chunks:
+            fold(transfer(item))
+            carry = jax.block_until_ready(carry)
+    else:
+        q: queue.Queue = queue.Queue(maxsize=prefetch_depth)
+        stop = threading.Event()
+
+        def worker():
+            try:
+                for item in chunks:
+                    # consumer died (fold error / Ctrl-C): stop parsing
+                    # and transferring chunks nobody will fold
+                    if stop.is_set():
+                        return
+                    # device_put here is the overlapped H2D copy: it
+                    # returns as soon as the transfer is enqueued, and
+                    # the bounded queue keeps at most `depth` chunks live
+                    q.put(transfer(item))
+                q.put(_DONE)
+            except BaseException as exc:  # noqa: BLE001 — relayed to caller
+                q.put(_PrefetchError(exc))
+
+        t = threading.Thread(target=worker, daemon=True,
+                             name="avenir-ingest-prefetch")
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _DONE:
+                    break
+                if isinstance(item, _PrefetchError):
+                    raise item.exc
+                fold(item)
+        finally:
+            # signal the producer to quit, then drain (a blocking get
+            # with timeout, not a busy spin) until any put it is stuck
+            # on has been freed and the loop's stop check fired
+            stop.set()
+            while t.is_alive():
+                try:
+                    q.get(timeout=0.05)
+                except queue.Empty:
+                    pass
+            t.join()
+
+    if carry is None:
+        return None
+    return jax.tree_util.tree_map(np.asarray, carry)

@@ -56,7 +56,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from ..parallel.mesh import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..core.config import JobConfig
@@ -99,6 +99,28 @@ class ItemSetList:
 
     def get_item_set_list(self) -> List[ItemSet]:
         return self.item_sets
+
+
+def _apriori_chunk_support_local(inc, mask, sets_idx):
+    """Streaming-fold twin of ``_apriori_support_local``: one transaction
+    ROW CHUNK's contribution to the candidate-support matrix, summed
+    across chunks by ``core.pipeline``'s donated accumulator.  f32 sums
+    of 0/1 products are exact below 2^24 supporting rows, so the folded
+    counts are bit-identical to the monolithic matmul after rounding."""
+    incb = inc.astype(jnp.bfloat16) * mask[:, None].astype(jnp.bfloat16)
+    km1 = sets_idx.shape[2]
+
+    def step(_, idx_chunk):                          # [S, k-1]
+        v = incb[:, idx_chunk[:, 0]]                 # [nt, S]
+        for i in range(1, km1):
+            v = v * incb[:, idx_chunk[:, i]]
+        co = jax.lax.dot_general(
+            v, incb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)      # [S, V]
+        return None, co
+
+    _, cos = jax.lax.scan(step, None, sets_idx)      # [n_chunks, S, V]
+    return cos.reshape(-1, incb.shape[1])
 
 
 def _apriori_support_local(inc, sets_idx, mask):
@@ -339,6 +361,32 @@ class FrequentItemsApriori:
             return m
 
         sets_idx = col_of[sets_idx_full].astype(np.int32)
+        n_s = sets_idx.shape[0]
+
+        # out-of-core chunked support counting (pipeline.chunk.rows /
+        # pipeline.device.budget.bytes): incidence rows stream through
+        # core.pipeline in bounded chunks instead of one resident array —
+        # the path for transaction sets larger than device memory
+        chunk_rows = self.config.pipeline_chunk_rows(
+            row_bytes=max(V_eff, 1))
+        if chunk_rows is not None and chunk_rows < n_rows:
+            def inc_chunk(start, stop, dtype=np.uint8):
+                lo, hi = np.searchsorted(prows, [start, stop])
+                pr, pi = prows[lo:hi], pitems[lo:hi]
+                s = sel[lo:hi]
+                m = np.zeros((stop - start, V_eff), dtype=dtype)
+                m[pr[s] - start, col_of[pi[s]]] = 1
+                return m
+
+            co = self._support_streamed(
+                inc_chunk, n_rows, V_eff, sets_idx, k, mesh, chunk_rows,
+                self.config.pipeline_prefetch_depth())
+            return self._emit_pass_k(
+                enc, prev_sets, sets_idx, co, k, emit_trans_id, threshold,
+                total_trans, trans_id_output, delim, col_of, kept, V_eff,
+                vocab_index,
+                tid_rows_fn=lambda cands: self._tid_rows_chunked(
+                    inc_chunk, n_rows, chunk_rows, cands))
 
         d = mesh.shape["data"]
         # device-resident incidence across k passes: the pruned vocabulary
@@ -372,7 +420,6 @@ class FrequentItemsApriori:
             _, inc_dev, mask_dev = cached
         # candidate-axis chunking: keep the [nt, S] indicator block under
         # ~2^28 bf16 elements per shard
-        n_s = sets_idx.shape[0]
         nt_local = max(-(-n_rows // d), 1)
         S = max(min(n_s, (1 << 28) // max(nt_local, 1)), 16)
         C = -(-n_s // S)
@@ -383,9 +430,73 @@ class FrequentItemsApriori:
             inc_dev, sets_idx_p.reshape(C, S, k - 1),
             mask_dev))[:n_s]                            # [n_s, V_eff]
 
-        # threshold BEFORE materializing candidates: only survivors get
-        # Python tuples (the reference shuffles every candidate and filters
-        # in the reducer, FrequentItemsApriori.java:306-342 — same output)
+        def tid_rows_full(cand_cols):
+            inc_bool = (inc if inc is not None else build_inc()).astype(bool)
+            return {cand: np.nonzero(inc_bool[:, cols].all(axis=1))[0]
+                    for cand, cols in cand_cols.items()}
+
+        return self._emit_pass_k(
+            enc, prev_sets, sets_idx, co, k, emit_trans_id, threshold,
+            total_trans, trans_id_output, delim, col_of, kept, V_eff,
+            vocab_index, tid_rows_fn=tid_rows_full)
+
+    def _support_streamed(self, inc_chunk, n_rows, V_eff, sets_idx, k,
+                          mesh, chunk_rows, depth):
+        """Candidate supports by streaming incidence ROW chunks through
+        ``core.pipeline``: chunk c+1's build + H2D copy overlap chunk c's
+        MXU contraction, and only (depth + 2) chunks are ever resident —
+        the out-of-core form of the device-resident support matmul."""
+        from ..core import pipeline
+        from ..parallel.mesh import get_mesh as _get_mesh
+
+        mesh = mesh or _get_mesh()
+        d = int(mesh.devices.size)
+        n_s = sets_idx.shape[0]
+        nt_loc = max(-(-min(chunk_rows, max(n_rows, 1)) // d), 1)
+        S = max(min(n_s, (1 << 28) // nt_loc), 16)
+        C = -(-n_s // S)
+        pad_s = C * S - n_s
+        sets_p = sets_idx if not pad_s else np.concatenate(
+            [sets_idx, np.zeros((pad_s, k - 1), np.int32)])
+
+        def chunks():
+            for start in range(0, n_rows, chunk_rows):
+                yield (inc_chunk(start, min(start + chunk_rows, n_rows)),)
+
+        co = pipeline.streaming_fold(
+            chunks(), _apriori_chunk_support_local,
+            broadcast_args=(sets_p.reshape(C, S, k - 1),),
+            mesh=mesh, prefetch_depth=depth, capacity=chunk_rows)
+        if co is None:
+            return np.zeros((n_s, V_eff), dtype=np.float32)
+        return np.asarray(co)[:n_s]
+
+    @staticmethod
+    def _tid_rows_chunked(inc_chunk, n_rows, chunk_rows, cand_cols):
+        """Per-candidate supporting row codes without materializing the
+        full incidence: one more chunked host pass (ascending starts keep
+        the sorted-tid emission order)."""
+        out = {cand: [] for cand in cand_cols}
+        for start in range(0, n_rows, chunk_rows):
+            m = inc_chunk(start, min(start + chunk_rows, n_rows),
+                          dtype=bool)
+            for cand, cols in cand_cols.items():
+                r = np.nonzero(m[:, cols].all(axis=1))[0]
+                if r.size:
+                    out[cand].append(r + start)
+        return {cand: (np.concatenate(rs) if rs
+                       else np.zeros(0, dtype=np.int64))
+                for cand, rs in out.items()}
+
+    def _emit_pass_k(self, enc, prev_sets, sets_idx, co, k, emit_trans_id,
+                     threshold, total_trans, trans_id_output, delim,
+                     col_of, kept, V_eff, vocab_index,
+                     tid_rows_fn) -> List[str]:
+        """Threshold + line emission shared by the resident and streamed
+        support paths (the reference shuffles every candidate and filters
+        in the reducer, FrequentItemsApriori.java:306-342 — same output).
+        Thresholding happens BEFORE materializing candidates: only
+        survivors get Python tuples."""
         cnt_mat = np.rint(co).astype(np.int64)
         member = np.zeros((len(prev_sets), V_eff), dtype=bool)
         member[np.arange(len(prev_sets))[:, None], sets_idx] = True
@@ -404,9 +515,13 @@ class FrequentItemsApriori:
             distinct[cand] = int(cnt_mat[si, x])
 
         lines = []
-        inc_bool = None
+        tid_rows = None
         if emit_trans_id and trans_id_output and distinct:
-            inc_bool = (inc if inc is not None else build_inc()).astype(bool)
+            # incidence rows are tid codes; tid_vocab is sorted and row
+            # codes ascend, so the emission order is sorted-tid order
+            tid_rows = tid_rows_fn(
+                {cand: [col_of[vocab_index[it]] for it in cand]
+                 for cand in distinct})
         for cand in sorted(distinct):
             cnt = distinct[cand]
             if not emit_trans_id:
@@ -417,11 +532,7 @@ class FrequentItemsApriori:
             if support > threshold:
                 if emit_trans_id:
                     if trans_id_output:
-                        cols = [col_of[vocab_index[it]] for it in cand]
-                        selr = inc_bool[:, cols].all(axis=1)
-                        # incidence rows are tid codes here; tid_vocab is
-                        # sorted so nonzero order is sorted-tid order
-                        tids = list(enc.tid_vocab[np.nonzero(selr)[0]])
+                        tids = list(enc.tid_vocab[tid_rows[cand]])
                         lines.append(delim.join(list(cand) + tids +
                                                 [_fmt_support(support)]))
                     else:

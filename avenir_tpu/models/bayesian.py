@@ -215,29 +215,37 @@ class BayesianDistribution:
 
     def _train_streamed(self, in_path: str, delim_in: str, delim: str,
                         counters: Counters, mesh=None) -> Optional[List[str]]:
-        """Double-buffered ingest: the C encode of chunk c+1 runs while
-        chunk c's count dispatch is in flight on device (the async jax
-        dispatch returns before the TPU finishes) and its host moments
-        accumulate — encode, transfer, and counting overlap instead of
-        running serially (the streaming-record-reader role of Hadoop
-        input splits, SURVEY §2.0 L5).  Count/class extents are capped
-        from the declared schema + the first chunk (+headroom); data that
-        overflows a cap — late-appearing categories, negative or
-        beyond-declared bins — returns None and the caller re-runs the
-        one-shot ``encode_path`` path, so results are always identical
-        to the serial encode."""
+        """Chunked streaming training through ``core.pipeline``: the C
+        encode + host-moment pass of chunk c+1 runs on the prefetch
+        worker while chunk c's H2D copy and jitted, donated count fold
+        are in flight on device (``pipeline.prefetch.depth`` deep;
+        depth 0 = strict serial).  Chunks are ``pipeline.chunk.rows``
+        rows (or derived from ``pipeline.device.budget.bytes``, or the
+        legacy ``ingest.chunk.bytes``), so inputs larger than device
+        memory stream through with bounded residency.  Count/class
+        extents are capped from the declared schema + the first chunk
+        (+headroom); data that overflows a cap — late-appearing
+        categories, negative or beyond-declared bins — returns None and
+        the caller re-runs the one-shot ``encode_path`` path, so results
+        are always identical to the serial encode."""
+        from ..core import pipeline
         from ..core.binning import ChunkedEncodeUnsupported
 
         enc = DatasetEncoder(self.schema)
+        ffields = enc.feature_fields
+        F = len(ffields)
         chunk_bytes = self.config.get_int("ingest.chunk.bytes", 48 << 20)
+        # budget row estimate: un-narrowed int32 x row + y (conservative —
+        # int8 narrowing only shrinks the live set under the budget)
+        chunk_rows = self.config.pipeline_chunk_rows(row_bytes=4 * (F + 1))
+        depth = self.config.pipeline_prefetch_depth()
         try:
             gen = enc.encode_path_chunks(in_path, delim_in,
-                                         chunk_bytes=chunk_bytes)
-            first = next(gen, None)
+                                         chunk_bytes=chunk_bytes,
+                                         chunk_rows=chunk_rows)
+            first, gen = pipeline.peek(gen)
             if first is None:
                 return None
-            ffields = enc.feature_fields
-            F = len(ffields)
             binned = [j for j, f in enumerate(ffields)
                       if f.is_categorical() or f.is_bucket_width_defined()]
             cont_cols = [j for j in range(F) if j not in binned]
@@ -263,56 +271,48 @@ class BayesianDistribution:
             # falls back — cheaper than paying a wider moments GEMV and
             # count table on every run
             n_class_cap = max(len(enc.class_vocab), 1)
-            row_bucket = 1 << 16      # pad chunks to few distinct shapes
 
-            handles = []
             mom_acc: Dict[int, np.ndarray] = {}
             num_bins_seen = np.zeros(F, dtype=np.int64)
+            n_chunks = [0]
 
-            def feed(x, values, y, n):
-                if n == 0:
-                    return
-                for j in bucket_cols:
-                    lo = int(x[:, j].min())
-                    if lo < 0:
-                        raise ChunkedEncodeUnsupported("negative bin")
-                mx = [int(x[:, j].max()) + 1 for j in binned]
-                for j, m in zip(binned, mx):
-                    num_bins_seen[j] = max(num_bins_seen[j], m)
-                if (max(mx, default=0) > bins_cap
-                        or int(y.max(initial=-1)) >= n_class_cap):
-                    raise ChunkedEncodeUnsupported("cap overflow")
-                pad = (-n) % row_bucket
-                xs, ys = x, y
-                if bins_cap <= 127 and F <= 127:
-                    xs = xs.astype(np.int8)
-                if n_class_cap <= 127:
-                    ys = ys.astype(np.int8)
-                if pad:
-                    xs = np.concatenate(
-                        [xs, np.full((pad, F), -1, xs.dtype)])
-                    ys = np.concatenate([ys, np.full(pad, -1, ys.dtype)])
-                # async: the device count is dispatched, NOT materialized —
-                # the next chunk's C encode overlaps it
-                handles.append(sharded_reduce(
-                    _nb_local, xs, ys, mesh=mesh,
-                    static_args=(n_class_cap, bins_cap)))
-                mom = _host_moments(values, y, n_class_cap, cont_cols)
-                for j, m in mom.items():
-                    acc = mom_acc.get(j)
-                    mom_acc[j] = m.copy() if acc is None else acc + m
+            def chunks():
+                # guards + dtype narrowing + host moments run HERE — on
+                # the prefetch worker when depth >= 1, overlapping the
+                # device fold of the previous chunk
+                for x, values, y, n in gen:
+                    if n == 0:
+                        continue
+                    for j in bucket_cols:
+                        if int(x[:, j].min()) < 0:
+                            raise ChunkedEncodeUnsupported("negative bin")
+                    mx = [int(x[:, j].max()) + 1 for j in binned]
+                    for j, m in zip(binned, mx):
+                        num_bins_seen[j] = max(num_bins_seen[j], m)
+                    if (max(mx, default=0) > bins_cap
+                            or int(y.max(initial=-1)) >= n_class_cap):
+                        raise ChunkedEncodeUnsupported("cap overflow")
+                    xs, ys = x, y
+                    if bins_cap <= 127 and F <= 127:
+                        xs = xs.astype(np.int8)
+                    if n_class_cap <= 127:
+                        ys = ys.astype(np.int8)
+                    mom = _host_moments(values, y, n_class_cap, cont_cols)
+                    for j, m in mom.items():
+                        acc = mom_acc.get(j)
+                        mom_acc[j] = m.copy() if acc is None else acc + m
+                    n_chunks[0] += 1
+                    yield xs, ys
 
-            feed(*first)
-            for chunk in gen:
-                feed(*chunk)
+            total = pipeline.streaming_fold(
+                chunks(), _nb_local, static_args=(n_class_cap, bins_cap),
+                mesh=mesh, prefetch_depth=depth, capacity=chunk_rows)
         except ChunkedEncodeUnsupported:
             return None
-        if not handles:
+        if total is None:
             return None
 
-        total = handles[0]
-        for h in handles[1:]:
-            total = total + h
+        counters.set("Ingest", "Chunks", n_chunks[0])
         n_class = len(enc.class_vocab)
         counts = np.asarray(total)[:n_class]
         moments = {j: m[:, :n_class] for j, m in mom_acc.items()}
@@ -896,7 +896,7 @@ class BayesianPredictor:
                     else self._score_batch)
         n = ds.x.shape[0]
         if mesh is not None and mesh.shape["data"] > 1:
-            from jax import shard_map
+            from ..parallel.mesh import shard_map
             from jax.sharding import PartitionSpec as P
 
             from ..parallel.mesh import pad_rows

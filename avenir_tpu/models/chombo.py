@@ -48,6 +48,18 @@ class TemporalFilter:
     Calendar.DAY_OF_WEEK order minus one), ``weekDayOrWeekEnd`` 0 =
     weekday / 1 = weekend, ``monthOfYear`` 0-11 (UTC).  Unknown types
     still fail fast.  Rows inside any window pass through unchanged.
+
+    DOCUMENTED DIVERGENCE (timezone semantics, ADVICE r5): every cycle
+    index here is computed in UTC plus the FIXED ``time.zone.shift.hours``
+    offset, whereas chombo's SeasonalAnalyzer goes through
+    ``java.util.Calendar`` in the JVM's DEFAULT timezone.  For non-UTC
+    deployments the day/week/month boundaries can differ — in particular
+    a DST transition moves Calendar-local boundaries by an hour twice a
+    year, which no fixed shift can express (a row stamped inside the DST
+    gap lands in the previous ``dayOfWeek``/``monthOfYear`` cell here).
+    Operators needing Calendar-local parity must run with a UTC JVM
+    default on the reference side or pre-shift timestamps; re-verify
+    against chombo upstream if its source becomes available.
     """
 
     CYCLES = ("anyTimeRange", "quarterHourOfDay", "halfHourOfDay",

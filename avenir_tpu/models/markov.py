@@ -80,6 +80,17 @@ def _markov_local(frm, to, cls, mask, n_class, n_states):
     return count_table((n_states, n_states), (frm, to), mask=m)
 
 
+def _markov_pair_local(frm, to, cls, mask, n_class, n_states):
+    """Streaming-fold twin of ``_markov_local`` over FLATTENED 1-D
+    transition-pair streams (row-major, so chunk shapes bucket by pair
+    count instead of recompiling per ragged sequence length); -1 padding
+    cells self-mask via the count_table range drop."""
+    if n_class > 0:
+        return count_table((n_class, n_states, n_states), (cls, frm, to),
+                           mask=mask)
+    return count_table((n_states, n_states), (frm, to), mask=mask)
+
+
 def _hmm_local(frm, to, obs_s, obs_o, init_s, mask, S, O):
     m = mask[:, None]
     return {
@@ -100,6 +111,10 @@ class MarkovStateTransitionModel:
     def __init__(self, config: JobConfig):
         self.config = config.with_prefix("mst") if not config.prefix else config
 
+    # rough pair-stream bytes per input row for device-budget chunk sizing
+    # (3 int32 streams x ~8 transitions)
+    _BUDGET_ROW_BYTES = 96
+
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         cfg = self.config
@@ -111,31 +126,44 @@ class MarkovStateTransitionModel:
         class_ord = cfg.get_int("class.label.field.ord", -1)
         scale = cfg.get_int("trans.prob.scale", 1000)
         output_states = cfg.get_boolean("output.states", True)
-
-        records = [split_line(l, delim_regex) for l in read_lines(in_path)]
         # class label occupies one leading field when present (:107-109)
         eff_skip = skip + (1 if class_ord >= 0 else 0)
-        # reference mapper skips rows too short to hold a transition (:119)
-        records = [r for r in records if len(r) >= eff_skip + 2]
-        class_labels: List[str] = []
-        cls_idx = np.zeros(len(records), dtype=np.int32)
-        if class_ord >= 0:
-            seen: Dict[str, int] = {}
-            for i, r in enumerate(records):
-                lbl = r[class_ord]
-                if lbl not in seen:
-                    seen[lbl] = len(seen)
-                    class_labels.append(lbl)
-                cls_idx[i] = seen[lbl]
-        seq, _ = encode_sequences(records, eff_skip, vocab)
-        if seq.shape[1] < 2:
-            counts = (np.zeros((len(class_labels), S, S), dtype=np.int64)
-                      if class_ord >= 0 else np.zeros((S, S), dtype=np.int64))
+
+        chunk_rows = cfg.pipeline_chunk_rows(row_bytes=self._BUDGET_ROW_BYTES)
+        counted = None
+        if chunk_rows is not None:
+            counted = self._count_streamed(
+                in_path, delim_regex, vocab, S, eff_skip, class_ord,
+                chunk_rows, cfg.pipeline_prefetch_depth(), mesh)
+        if counted is not None:
+            counts, class_labels = counted
         else:
-            frm, to = _transition_pairs(seq)
-            counts = np.asarray(sharded_reduce(
-                _markov_local, frm, to, cls_idx, mesh=mesh,
-                static_args=(len(class_labels) if class_ord >= 0 else 0, S)))
+            records = [split_line(l, delim_regex)
+                       for l in read_lines(in_path)]
+            # reference mapper skips rows too short to hold a transition
+            # (:119)
+            records = [r for r in records if len(r) >= eff_skip + 2]
+            class_labels = []
+            cls_idx = np.zeros(len(records), dtype=np.int32)
+            if class_ord >= 0:
+                seen: Dict[str, int] = {}
+                for i, r in enumerate(records):
+                    lbl = r[class_ord]
+                    if lbl not in seen:
+                        seen[lbl] = len(seen)
+                        class_labels.append(lbl)
+                    cls_idx[i] = seen[lbl]
+            seq, _ = encode_sequences(records, eff_skip, vocab)
+            if seq.shape[1] < 2:
+                counts = (np.zeros((len(class_labels), S, S), dtype=np.int64)
+                          if class_ord >= 0
+                          else np.zeros((S, S), dtype=np.int64))
+            else:
+                frm, to = _transition_pairs(seq)
+                counts = np.asarray(sharded_reduce(
+                    _markov_local, frm, to, cls_idx, mesh=mesh,
+                    static_args=(len(class_labels) if class_ord >= 0 else 0,
+                                 S)))
 
         lines: List[str] = []
         if output_states:
@@ -149,6 +177,66 @@ class MarkovStateTransitionModel:
         write_output(out_path, lines)
         counters.set("Markov", "Transitions", int(counts.sum()))
         return counters
+
+    def _count_streamed(self, in_path, delim_regex, vocab, S, eff_skip,
+                        class_ord, chunk_rows, depth, mesh):
+        """One streaming pass over row chunks: per chunk the trailing
+        state sequences encode and flatten to 1-D (from, to, class) pair
+        streams, folded through ``core.pipeline`` with a donated
+        accumulator.  Class labels are discovered in input order exactly
+        like the monolithic path (chunks are consumed sequentially); the
+        class extent is capped after the first chunk — a label first
+        appearing later overflows the cap and returns None, and the
+        caller re-runs the monolithic path for identical output."""
+        from ..core import pipeline
+        from ..core.binning import ChunkedEncodeUnsupported
+
+        class_labels: List[str] = []
+        seen: Dict[str, int] = {}
+        cap = [None]          # set after the first chunk is parsed
+
+        def parsed():
+            for lines in pipeline.iter_line_chunks(in_path, chunk_rows):
+                records = [split_line(l, delim_regex) for l in lines]
+                records = [r for r in records if len(r) >= eff_skip + 2]
+                if not records:
+                    continue
+                cls_idx = np.zeros(len(records), dtype=np.int32)
+                if class_ord >= 0:
+                    for i, r in enumerate(records):
+                        lbl = r[class_ord]
+                        if lbl not in seen:
+                            seen[lbl] = len(seen)
+                            class_labels.append(lbl)
+                        cls_idx[i] = seen[lbl]
+                    if cap[0] is not None and len(class_labels) > cap[0]:
+                        raise ChunkedEncodeUnsupported("late class label")
+                seq, _ = encode_sequences(records, eff_skip, vocab)
+                if seq.shape[1] < 2:
+                    continue
+                frm, to = _transition_pairs(seq)
+                cls = np.repeat(cls_idx, frm.shape[1])
+                yield frm.ravel(), to.ravel(), cls
+
+        try:
+            first, stream = pipeline.peek(parsed())
+            n_class_cap = 0
+            if class_ord >= 0:
+                # headroom covers stragglers; a genuinely late-appearing
+                # label beyond it falls back
+                cap[0] = n_class_cap = max(len(class_labels), 1) + 2
+            counts = pipeline.streaming_fold(
+                stream, _markov_pair_local, static_args=(n_class_cap, S),
+                mesh=mesh, prefetch_depth=depth)
+        except ChunkedEncodeUnsupported:
+            return None
+        n_class = len(class_labels)
+        if counts is None:
+            counts = (np.zeros((n_class, S, S), dtype=np.int64)
+                      if class_ord >= 0 else np.zeros((S, S), np.int64))
+        elif class_ord >= 0:
+            counts = counts[:n_class]
+        return counts, class_labels
 
 
 # ---------------------------------------------------------------------------

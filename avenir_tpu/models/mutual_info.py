@@ -169,6 +169,16 @@ class MutualInformation:
         cfg = self.config
         delim = cfg.field_delim_out()
         enc = DatasetEncoder(self.schema)
+        chunk_rows = cfg.pipeline_chunk_rows(
+            row_bytes=4 * (len(enc.feature_fields) + 1))
+        if chunk_rows is not None:
+            res = self._run_streamed(
+                enc, in_path, out_path, cfg, delim, counters, mesh,
+                chunk_rows, cfg.pipeline_prefetch_depth())
+            if res is not None:
+                return res
+            enc = DatasetEncoder(self.schema)   # fresh vocabs for fallback
+            counters = Counters()
         ds = enc.encode_path(in_path, cfg.field_delim_regex())
         counters.set("Basic", "Records", ds.n_rows)
 
@@ -182,6 +192,87 @@ class MutualInformation:
         pc = np.asarray(res["pc"], dtype=np.int64)       # [P, B, B, C]
 
         lines = self._emit(ds, fc, pc, pair_i, pair_j, delim, cfg)
+        write_output(out_path, lines)
+        return counters
+
+    def _run_streamed(self, enc: DatasetEncoder, in_path, out_path, cfg,
+                      delim, counters: Counters, mesh, chunk_rows: int,
+                      depth: int) -> Optional[Counters]:
+        """Chunked streaming MI: row chunks bulk-parse + encode on the
+        prefetch worker (vocabularies grow in input order, identical to
+        the one-shot encode) and both distribution tables fold on device
+        through ``core.pipeline`` with a donated accumulator.  Bin/class
+        extents cap from the declared schema + first chunk (+headroom);
+        an overflow — late class value, beyond-cap bin, or a
+        negative-bin column (whose shift is global) — returns None and
+        the caller re-runs the monolithic path for identical output."""
+        from ..core import pipeline
+        from ..core.binning import ChunkedEncodeUnsupported
+
+        ffields = enc.feature_fields
+        F = len(ffields)
+        delim_regex = cfg.field_delim_regex()
+        n_rows = [0]
+        num_bins_seen = np.zeros(F, dtype=np.int64)
+        caps = {}
+
+        def encoded():
+            for arr in pipeline.iter_field_chunks(in_path, delim_regex,
+                                                  chunk_rows):
+                dsc = enc.encode(arr)
+                if dsc.n_rows == 0:
+                    continue
+                if (dsc.bin_offset != 0).any():
+                    raise ChunkedEncodeUnsupported("negative bin")
+                mx = dsc.x.max(axis=0) + 1
+                np.maximum(num_bins_seen, mx, out=num_bins_seen)
+                if caps and (int(mx.max()) > caps["B"]
+                             or int(dsc.y.max()) >= caps["C"]):
+                    raise ChunkedEncodeUnsupported("cap overflow")
+                n_rows[0] += dsc.n_rows
+                yield dsc.x, dsc.y
+
+        try:
+            first, stream = pipeline.peek(encoded())
+            if first is None:
+                return None
+            declared = [f.num_bins() if (f.is_bucket_width_defined()
+                                         and f.max is not None) else 0
+                        for f in ffields]
+            cat_card = [len(enc.vocabs[f.ordinal])
+                        for f in ffields if f.is_categorical()]
+            caps["B"] = int(max([1] + declared + cat_card
+                                + list(num_bins_seen))) + 4
+            caps["C"] = max(len(enc.class_vocab), 1) + 2
+            pair_i, pair_j = map(tuple, np.triu_indices(F, k=1))
+            res = pipeline.streaming_fold(
+                stream, _mi_local,
+                static_args=(caps["C"], caps["B"], pair_i, pair_j),
+                mesh=mesh, prefetch_depth=depth, capacity=chunk_rows)
+        except ChunkedEncodeUnsupported:
+            return None
+        if res is None:
+            return None
+        counters.set("Basic", "Records", n_rows[0])
+
+        num_bins = []
+        for j, f in enumerate(ffields):
+            if f.is_categorical():
+                num_bins.append(len(enc.vocabs[f.ordinal]))
+            else:
+                num_bins.append(max(declared[j], int(num_bins_seen[j])))
+        C = len(enc.class_vocab)
+        B = max(num_bins)
+        fc = np.asarray(res["fc"], dtype=np.int64)[:C, :, :B]
+        pc = np.asarray(res["pc"], dtype=np.int64)[:, :B, :B, :C]
+        ds_meta = EncodedDataset(
+            schema=enc.schema, feature_fields=ffields,
+            x=np.zeros((0, F), np.int32), values=np.zeros((0, F)),
+            y=np.zeros(0, np.int32), num_bins=num_bins,
+            bin_offset=np.zeros(F, np.int32),
+            binned_mask=np.ones(F, dtype=bool),
+            vocabs=enc.vocabs, class_vocab=enc.class_vocab)
+        lines = self._emit(ds_meta, fc, pc, pair_i, pair_j, delim, cfg)
         write_output(out_path, lines)
         return counters
 

@@ -39,7 +39,7 @@ from typing import List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from ..parallel.mesh import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..core.config import JobConfig

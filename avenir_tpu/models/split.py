@@ -304,6 +304,22 @@ class AttributePredicate:
                    integer=False)
 
 
+def predicate_matrix(preds: Sequence[AttributePredicate],
+                     col_by_attr: Dict[int, np.ndarray]) -> np.ndarray:
+    """Vectorized evaluation of a predicate list over one record batch:
+    bool ``B[n, len(preds)]`` with one column extraction per distinct
+    attribute (``col_by_attr[attr]`` is the attribute's value column).
+    This is the whole BuilderMapper predicate loop
+    (DecisionTreeBuilder.java:275-320) for a batch — shared by the
+    monolithic level pass and the chunked streaming pass, which calls it
+    once per row chunk."""
+    n = len(next(iter(col_by_attr.values()))) if col_by_attr else 0
+    if not preds:
+        return np.zeros((n, 0), dtype=bool)
+    return np.stack([p.evaluate(col_by_attr[p.attr]) for p in preds],
+                    axis=1)
+
+
 def segment_predicates(split: Split, field: FeatureField) -> List[AttributePredicate]:
     """Predicates for each split segment, replicating
     SplitManager.createIntAttrPredicates / createDoubleAttrPredicates /

@@ -255,6 +255,18 @@ class GroupedStreamingLearnerLoop:
         self.event_count = 0
         self.reward_count = 0
         self.malformed_count = 0
+        # action-latency knob: how many dispatched waves may backlog
+        # before their selections are read back and emitted.  1 restores
+        # the reference bolt's immediate per-wave emit
+        # (ReinforcementLearnerBolt.java:103-117) for latency-sensitive
+        # transports; the default keeps the throughput pipelining.
+        pending = _get(config, "streaming.max.pending.batches")
+        if pending is not None:
+            pending = int(pending)
+            if pending < 1:
+                raise ValueError(
+                    f"streaming.max.pending.batches must be >= 1: {pending}")
+            self.max_pending_batches = pending
 
     def _parse_rewards(self):
         """Drain and validate ``entityID,actionID,reward`` messages;
@@ -408,17 +420,29 @@ class GroupedStreamingLearnerLoop:
 
     # dispatched batches whose selections are still device futures;
     # bounding the backlog bounds action latency while amortizing the
-    # blocking device read (a full tunnel round trip) across waves
+    # blocking device read (a full tunnel round trip) across waves.
+    # Class default; ``streaming.max.pending.batches`` overrides per
+    # instance (1 = the reference bolt's immediate per-wave emit).
     MAX_PENDING_BATCHES = 4
+
+    @property
+    def max_pending_batches(self) -> int:
+        return getattr(self, "_max_pending_batches", self.MAX_PENDING_BATCHES)
+
+    @max_pending_batches.setter
+    def max_pending_batches(self, value: int) -> None:
+        self._max_pending_batches = value
 
     def run(self, max_events: Optional[int] = None,
             idle_timeout: Optional[float] = 1.0,
             poll_interval: float = 0.01, batch: int = 1024) -> int:
         """Pipelined pull loop: subsequent waves' drain/parse/dispatch
         run while earlier device steps are still in flight; actions are
-        emitted (the blocking device read) once ``MAX_PENDING_BATCHES``
-        waves are queued, on idle, and before returning — so the queue
-        drains at dispatch speed and every action is flushed by exit."""
+        emitted (the blocking device read) once ``max_pending_batches``
+        waves are queued (``streaming.max.pending.batches``; 1 = the
+        reference bolt's immediate per-wave emit), on idle, and before
+        returning — so the queue drains at dispatch speed and every
+        action is flushed by exit."""
         processed = 0
         idle_since = None
         prev: List = []
@@ -430,7 +454,7 @@ class GroupedStreamingLearnerLoop:
                 if n:
                     processed += n
                     prev.extend(pending)
-                    if len(prev) >= self.MAX_PENDING_BATCHES:
+                    if len(prev) >= self.max_pending_batches:
                         self._emit(prev)
                         prev = []
                     idle_since = None

@@ -66,14 +66,15 @@ import numpy as np
 
 from ..core.binning import Vocab
 from ..core.config import JobConfig
-from ..core.io import read_lines, split_line, write_output
+from ..core.io import OutputWriter, read_lines, split_line, write_output
 from ..core.metrics import Counters
 from ..core.schema import FeatureField, FeatureSchema
 from ..ops.counting import (count_on_mxu, count_table, masked_onehot,
                             onehot_dtype, sharded_reduce)
 from .split import (ALG_ENTROPY, ALG_GINI_INDEX, AttributePredicate, Split,
                     class_probabilities, enumerate_attr_splits, info_content,
-                    segment_predicates, split_info_content, split_stat)
+                    predicate_matrix, segment_predicates, split_info_content,
+                    split_stat)
 
 ROOT_PATH = "$root"
 CHILD_PATH = "$child"
@@ -496,12 +497,90 @@ class DecisionTreeBuilder:
         return (os.path.exists(self.decision_file)
                 and os.path.getsize(self.decision_file) > 0)
 
+    # rough per-record device bytes (pid + class + predicate booleans) for
+    # pipeline.device.budget.bytes chunk sizing
+    _BUDGET_ROW_BYTES = 128
+
     # -- one level ---------------------------------------------------------
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         if not self.tree_available():
             return self._run_root(in_path, out_path, counters, mesh=mesh)
+        chunk_rows = self.config.pipeline_chunk_rows(
+            row_bytes=self._BUDGET_ROW_BYTES)
+        if chunk_rows is not None:
+            res = self._run_level_streamed(
+                in_path, out_path, counters, mesh, chunk_rows,
+                self.config.pipeline_prefetch_depth())
+            if res is not None:
+                return res
+            counters = Counters()     # fallback re-runs from scratch
         return self._run_level(in_path, out_path, counters, mesh=mesh)
+
+    def _enum_preds(self, all_attrs: Sequence[int]
+                    ) -> Tuple[List[AttributePredicate], List[int]]:
+        """Schema-only candidate predicate enumeration for a level pass
+        (data-independent, so the streamed pass can fix its count extents
+        before any record is read)."""
+        preds: List[AttributePredicate] = []
+        pred_attr: List[int] = []
+        for attr in all_attrs:
+            field = self.schema.field_by_ordinal(attr)
+            for sp in enumerate_attr_splits(field, use_bucket_grid=False):
+                for pred in segment_predicates(sp, field):
+                    preds.append(pred)
+                    pred_attr.append(attr)
+        return preds, pred_attr
+
+    def _level_cleanup(self, path_objs, active, passthrough, cand_attrs,
+                       preds, pred_attr, counts, stopping
+                       ) -> Tuple[DecisionPathList, Dict[int, int]]:
+        """Reducer cleanup (generateTree, DecisionTreeBuilder.java:423-538):
+        per parent, group predicate stats by attribute, min weighted stat —
+        shared verbatim by the monolithic and streamed level passes."""
+        new_dpl = DecisionPathList()
+        selected_attr: Dict[int, int] = {}
+        n_paths = len(path_objs)
+        for pid in range(n_paths):
+            parent = path_objs[pid]
+            if parent is None or not active[pid]:
+                if parent is not None and passthrough[pid]:
+                    new_dpl.add(parent)
+                continue
+            pred_tot = counts[pid].sum(axis=1)            # [K]
+            pred_stat = info_content(counts[pid], self.algorithm)
+            best_attr = None
+            min_info = 1000.0
+            for attr in cand_attrs[pid]:
+                sel = np.asarray([a == attr for a in pred_attr]) & (pred_tot > 0)
+                tot = pred_tot[sel].sum()
+                if tot == 0:
+                    continue
+                av = float((pred_stat[sel] * pred_tot[sel]).sum() / tot)
+                if av < min_info:
+                    min_info = av
+                    best_attr = attr
+            if best_attr is None:
+                parent.stopped = True
+                new_dpl.add(parent)
+                continue
+            selected_attr[pid] = best_attr
+            parent_preds = [p for p in path_objs[pid].predicate_strs
+                            if p != ROOT_PATH]
+            parent_stat = path_objs[pid].info_content
+            for k, pred in enumerate(preds):
+                if pred_attr[k] != best_attr or pred_tot[k] == 0:
+                    continue
+                stat_k = float(pred_stat[k])
+                # depth = the child path's own predicate count (the "$root"
+                # sentinel never counts — DecisionPath.depth() parity)
+                stop = stopping.should_stop(int(pred_tot[k]), stat_k,
+                                            parent_stat,
+                                            len(parent_preds) + 1)
+                new_dpl.add(DecisionPath(
+                    parent_preds + [pred.to_string()],
+                    int(pred_tot[k]), stat_k, stop))
+        return new_dpl, selected_attr
 
     def _run_root(self, in_path: str, out_path: str, counters: Counters,
                   mesh=None) -> Counters:
@@ -578,17 +657,7 @@ class DecisionTreeBuilder:
                       for pid in range(n_paths)]
         all_attrs = sorted({a for attrs in cand_attrs for a in attrs})
 
-        preds: List[AttributePredicate] = []
-        pred_attr: List[int] = []
-        bcols: List[np.ndarray] = []
-        for attr in all_attrs:
-            field = self.schema.field_by_ordinal(attr)
-            col = _column(records, field)
-            for sp in enumerate_attr_splits(field, use_bucket_grid=False):
-                for pred in segment_predicates(sp, field):
-                    preds.append(pred)
-                    pred_attr.append(attr)
-                    bcols.append(pred.evaluate(col))
+        preds, pred_attr = self._enum_preds(all_attrs)
         if not preds:
             # nothing left to split on: mark all active paths stopped
             for p in path_objs:
@@ -602,7 +671,9 @@ class DecisionTreeBuilder:
                                     if path_objs[path_id[i]] is not None))
             return counters
 
-        bmat = np.stack(bcols, axis=1)
+        col_by_attr = {attr: _column(records, self.schema.field_by_ordinal(attr))
+                       for attr in all_attrs}
+        bmat = predicate_matrix(preds, col_by_attr)
         allowed = np.zeros((n_paths, len(preds)), dtype=bool)
         for pid in range(n_paths):
             cset = set(cand_attrs[pid])
@@ -620,49 +691,9 @@ class DecisionTreeBuilder:
             static_args=(n_paths, len(preds), n_class)))
         counts = counts * allowed[:, :, None]
 
-        # reducer cleanup (generateTree, DecisionTreeBuilder.java:423-538):
-        # per parent, group predicate stats by attribute, min weighted stat
-        new_dpl = DecisionPathList()
-        selected_attr: Dict[int, int] = {}
-        for pid in range(n_paths):
-            parent = path_objs[pid]
-            if parent is None or not active[pid]:
-                if parent is not None and passthrough[pid]:
-                    new_dpl.add(parent)
-                continue
-            pred_tot = counts[pid].sum(axis=1)            # [K]
-            pred_stat = info_content(counts[pid], self.algorithm)
-            best_attr = None
-            min_info = 1000.0
-            for attr in cand_attrs[pid]:
-                sel = np.asarray([a == attr for a in pred_attr]) & (pred_tot > 0)
-                tot = pred_tot[sel].sum()
-                if tot == 0:
-                    continue
-                av = float((pred_stat[sel] * pred_tot[sel]).sum() / tot)
-                if av < min_info:
-                    min_info = av
-                    best_attr = attr
-            if best_attr is None:
-                parent.stopped = True
-                new_dpl.add(parent)
-                continue
-            selected_attr[pid] = best_attr
-            parent_preds = [p for p in path_objs[pid].predicate_strs
-                            if p != ROOT_PATH]
-            parent_stat = path_objs[pid].info_content
-            for k, pred in enumerate(preds):
-                if pred_attr[k] != best_attr or pred_tot[k] == 0:
-                    continue
-                stat_k = float(pred_stat[k])
-                # depth = the child path's own predicate count (the "$root"
-                # sentinel never counts — DecisionPath.depth() parity)
-                stop = stopping.should_stop(int(pred_tot[k]), stat_k,
-                                            parent_stat,
-                                            len(parent_preds) + 1)
-                new_dpl.add(DecisionPath(
-                    parent_preds + [pred.to_string()],
-                    int(pred_tot[k]), stat_k, stop))
+        new_dpl, selected_attr = self._level_cleanup(
+            path_objs, active, passthrough, cand_attrs, preds, pred_attr,
+            counts, stopping)
 
         with open(self.decision_file, "w") as fh:
             fh.write(new_dpl.to_json(self.schema))
@@ -693,6 +724,215 @@ class DecisionTreeBuilder:
                 out_lines.append(f"{prefix}{delim}{rests[i]}")
         counters.set("Stats", "output records", len(out_lines))
         write_output(out_path, out_lines)
+        return counters
+
+    def _run_level_streamed(self, in_path: str, out_path: str,
+                            counters: Counters, mesh, chunk_rows: int,
+                            depth: int) -> Optional[Counters]:
+        """Out-of-core level pass: two streaming passes over row chunks.
+
+        Pass 1 folds the C[path, predicate, class] histogram through
+        ``core.pipeline`` (double-buffered, donated accumulator) while
+        discovering the path/class vocabularies in input order; pass 2
+        re-streams the input and emits the routed records chunk by chunk,
+        so peak memory is O(chunk) regardless of input size.  The count
+        extents are fixed BEFORE reading any data: active paths and their
+        candidate attributes come from the decision file, predicates from
+        the schema (``_enum_preds``).  Output — decision-file JSON and
+        routed records — is bit-identical to ``_run_level``; cases whose
+        parity cannot be guaranteed return None and the caller falls back:
+        random attribute-selection strategies (the RNG draw order follows
+        path DISCOVERY order, unknowable before reading the data) and
+        class values first appearing after the first chunk beyond the
+        declared cardinality + headroom."""
+        from ..core import pipeline
+        from ..core.binning import ChunkedEncodeUnsupported
+
+        if self.attr_select_strategy in (self.ATTR_SEL_RANDOM_ALL,
+                                         self.ATTR_SEL_RANDOM_NOT_USED_YET):
+            return None
+        delim_regex = self.config.field_delim_regex()
+        delim = self.config.field_delim_out()
+        dpl = DecisionPathList.from_file(self.decision_file)
+        stopping = DecisionPathStoppingStrategy.from_config(self.config)
+        class_field = self.schema.class_attr_field()
+
+        # static extents from the decision file + schema (data-free)
+        active_dpl = [p for p in dpl.paths if not p.stopped]
+        akey = {tuple(p.predicate_strs): i for i, p in enumerate(active_dpl)}
+        cand_by_aid = []
+        for p in active_dpl:
+            used = [int(ps.split()[0]) for ps in p.predicate_strs
+                    if ps != ROOT_PATH]
+            cand_by_aid.append(self._candidate_attrs(used))
+        sup_attrs = sorted({a for attrs in cand_by_aid for a in attrs})
+        preds_sup, pred_attr_sup = self._enum_preds(sup_attrs)
+        K = len(preds_sup)
+        a_cap = max(len(active_dpl), 1)
+
+        # streaming discovery state (chunks are consumed sequentially, so
+        # discovery order == the monolithic pass's record order)
+        path_vocab: Dict[str, int] = {}
+        aid_of_ps: Dict[str, int] = {}
+        class_vocab = Vocab(class_field.cardinality or ())
+        cap = [None]
+        n_records = [0]
+
+        def parse_chunk(lines):
+            path_c: List[str] = []
+            rests: List[str] = []
+            recs: List[List[str]] = []
+            for line in lines:
+                pos = line.find(delim)
+                path_c.append(line[:pos])
+                rest = line[pos + len(delim):]
+                rests.append(rest)
+                recs.append(split_line(rest, delim_regex))
+            return path_c, rests, recs
+
+        def encode_chunk(lines):
+            path_c, _, recs = parse_chunk(lines)
+            apid = np.empty(len(lines), dtype=np.int32)
+            for i, ps in enumerate(path_c):
+                aid = aid_of_ps.get(ps)
+                if aid is None:
+                    path_vocab.setdefault(ps, len(path_vocab))
+                    aid = akey.get(tuple(ps.split(self.dec_path_delim)), -1)
+                    aid_of_ps[ps] = aid
+                apid[i] = aid
+            y = np.asarray([class_vocab.add(r[class_field.ordinal])
+                            for r in recs], dtype=np.int32)
+            if cap[0] is not None and len(class_vocab) > cap[0]:
+                raise ChunkedEncodeUnsupported("late class value")
+            col_by_attr = {a: _column(recs, self.schema.field_by_ordinal(a))
+                           for a in sup_attrs}
+            return apid, y, predicate_matrix(preds_sup, col_by_attr)
+
+        def chunks():
+            for lines in pipeline.iter_line_chunks(in_path, chunk_rows):
+                n_records[0] += len(lines)
+                yield encode_chunk(lines)
+
+        try:
+            first, stream = pipeline.peek(chunks())
+            cap[0] = n_class_cap = max(len(class_vocab), 1) + 2
+            if K:
+                counts_sup = pipeline.streaming_fold(
+                    stream, _path_pred_class_count_local,
+                    static_args=(a_cap, K, n_class_cap), mesh=mesh,
+                    prefetch_depth=depth, capacity=chunk_rows)
+            else:
+                for _ in stream:      # discovery only; nothing to count
+                    pass
+                counts_sup = None
+        except ChunkedEncodeUnsupported:
+            return None
+        counters.set("Basic", "Records", n_records[0])
+
+        # reconstruct the monolithic pass's discovery-order state
+        n_paths = len(path_vocab)
+        path_objs: List[Optional[DecisionPath]] = [None] * n_paths
+        for ps, pid in path_vocab.items():
+            path_objs[pid] = dpl.find_str(ps, self.dec_path_delim)
+        active = np.asarray(
+            [p is not None and not p.stopped for p in path_objs], dtype=bool)
+        passthrough = np.asarray(
+            [p is not None and p.stopped for p in path_objs], dtype=bool)
+        used_by_path = []
+        for p in path_objs:
+            used = []
+            if p is not None:
+                for ps in p.predicate_strs:
+                    if ps != ROOT_PATH:
+                        used.append(int(ps.split()[0]))
+            used_by_path.append(used)
+        cand_attrs = [self._candidate_attrs(used_by_path[pid])
+                      if active[pid] else [] for pid in range(n_paths)]
+        all_attrs = sorted({a for attrs in cand_attrs for a in attrs})
+        # the predicate list the monolithic pass would have built (the
+        # superset pass counted extra attributes of non-appearing paths;
+        # selecting the appearing-attr columns restores exact parity,
+        # including the all-paths-exhausted early branch below)
+        attr_set = set(all_attrs)
+        sel_cols = [k for k in range(K) if pred_attr_sup[k] in attr_set]
+        preds = [preds_sup[k] for k in sel_cols]
+        pred_attr = [pred_attr_sup[k] for k in sel_cols]
+
+        if not preds:
+            for p in path_objs:
+                if p is not None:
+                    p.stopped = True
+            with open(self.decision_file, "w") as fh:
+                fh.write(DecisionPathList(
+                    [p for p in path_objs if p is not None]
+                ).to_json(self.schema))
+            with OutputWriter(out_path) as w:
+                for lines in pipeline.iter_line_chunks(in_path, chunk_rows):
+                    path_c, _, _ = parse_chunk(lines)
+                    for i, line in enumerate(lines):
+                        if path_objs[path_vocab[path_c[i]]] is not None:
+                            w.write(line)
+            return counters
+
+        n_class = len(class_vocab)
+        counts = np.zeros((n_paths, len(preds), n_class), dtype=np.int32)
+        if counts_sup is not None:
+            for ps, pid in path_vocab.items():
+                aid = aid_of_ps[ps]
+                if aid >= 0 and active[pid]:
+                    counts[pid] = counts_sup[aid][sel_cols][:, :n_class]
+        allowed = np.zeros((n_paths, len(preds)), dtype=bool)
+        for pid in range(n_paths):
+            cset = set(cand_attrs[pid])
+            allowed[pid] = np.asarray([a in cset for a in pred_attr])
+        counts = counts * allowed[:, :, None]
+
+        new_dpl, selected_attr = self._level_cleanup(
+            path_objs, active, passthrough, cand_attrs, preds, pred_attr,
+            counts, stopping)
+        with open(self.decision_file, "w") as fh:
+            fh.write(new_dpl.to_json(self.schema))
+
+        # pass 2: re-stream the input and emit routed records per chunk.
+        # Only predicates of SELECTED attributes are ever consulted here
+        # (sel_mask), so the per-chunk evaluation is restricted to them —
+        # the emission order over the reduced list matches the monolithic
+        # full-list scan because both ascend in preds order.
+        sel_attr_set = set(selected_attr.values())
+        emit_cols = [k for k in range(len(preds))
+                     if pred_attr[k] in sel_attr_set]
+        emit_preds = [preds[k] for k in emit_cols]
+        emit_strs = [preds[k].to_string() for k in emit_cols]
+        sel_mask = np.zeros((n_paths, len(emit_cols)), dtype=bool)
+        for pid, attr in selected_attr.items():
+            sel_mask[pid] = np.asarray([pred_attr[k] == attr
+                                        for k in emit_cols])
+        n_out = 0
+        with OutputWriter(out_path) as w:
+            for lines in pipeline.iter_line_chunks(in_path, chunk_rows):
+                path_c, rests, recs = parse_chunk(lines)
+                col_by_attr = {
+                    a: _column(recs, self.schema.field_by_ordinal(a))
+                    for a in sorted(sel_attr_set)}
+                bmat = predicate_matrix(emit_preds, col_by_attr) \
+                    if emit_cols else np.zeros((len(lines), 0), bool)
+                for i, line in enumerate(lines):
+                    pid = path_vocab[path_c[i]]
+                    if passthrough[pid]:
+                        w.write(line)
+                        n_out += 1
+                        continue
+                    if not active[pid] or pid not in selected_attr:
+                        continue
+                    base = path_c[i]
+                    if base == ROOT_PATH:
+                        base = ""
+                    for k in np.nonzero(bmat[i] & sel_mask[pid])[0]:
+                        prefix = ((base + self.dec_path_delim if base else "")
+                                  + emit_strs[k])
+                        w.write(f"{prefix}{delim}{rests[i]}")
+                        n_out += 1
+        counters.set("Stats", "output records", n_out)
         return counters
 
     # -- host-side multi-level loop (TPU-native convenience; the reference

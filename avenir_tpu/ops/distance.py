@@ -29,7 +29,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from ..parallel.mesh import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import get_mesh, pad_rows

@@ -103,7 +103,8 @@ def wide_feature_class_counts(x, y, n_class: int, max_bins: int, mask=None,
     # trace under 32-bit semantics: with the global x64 flag on (the CLI's
     # enable_x64), literal index-map constants become i64 and Mosaic
     # rejects the kernel; everything here is int32 by construction
-    with jax.enable_x64(False):
+    from .pallas_topk import _x64_disabled
+    with _x64_disabled():
         out = pl.pallas_call(
             _make_kernel(F, C, B),
             grid=((n + pad) // _ROW_BLOCK,),

@@ -70,7 +70,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from ..parallel.mesh import shard_map
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
@@ -93,6 +93,16 @@ _SEG = 1 << 18     # candidate-axis segment: packing budget is per-segment
 _SENT = np.int32(np.iinfo(np.int32).max)
 
 _fused_cache: dict = {}
+
+
+def _x64_disabled():
+    """Version-stable x64-off scope (jax.enable_x64(False) is only a
+    context manager from jax 0.6; older jax spells it
+    jax.experimental.disable_x64())."""
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(False)
+    from jax.experimental import disable_x64
+    return disable_x64()
 
 
 def _seg_extent(nt_loc: int) -> int:
@@ -289,8 +299,16 @@ def _make_kernel(F: int, Ccat: int, cat_w: tuple, wsum: float, scale: int,
                 (di.shape[0], _L))
             real = g < nv
             v = di[:, s * _L:(s + 1) * _L]
-            p = jnp.where(real & (v < val_max), (v << bits) | g, _SENT)
-            oflow[:] |= jnp.where(real & (v >= val_max),
+            packed = (v << bits) | g
+            # the all-ones code is RESERVED for the sentinel: a real
+            # candidate at v == val_max-1 whose segment-local index is
+            # all-ones packs to exactly _SENT and would silently read as
+            # an empty register in both the select_and_check and ring
+            # unpack paths — treat it as a packing-budget overflow so an
+            # under-filled selection flags suspect and falls back exact
+            ok = real & (v < val_max) & (packed != _SENT)
+            p = jnp.where(ok, packed, _SENT)
+            oflow[:] |= jnp.where(real & ~ok,
                                   jnp.int32(1), jnp.int32(0))
             regs = [binp[:, r * _L:(r + 1) * _L] for r in range(_R)]
             # sorted-insert on packed values: strict < is a total order
@@ -336,7 +354,7 @@ def _bins_pallas_call(kernel, nv, qn, qc, tn, tc, F: int, Ccat: int,
                      pl.BlockSpec((_TB, Ccat), lambda i, j: (j, 0),
                                   memory_space=pltpu.VMEM)]
         args += [qc, tc]
-    with jax.enable_x64(False):
+    with _x64_disabled():
         return pl.pallas_call(
             kernel, grid=(ni, nj),
             in_specs=in_specs,

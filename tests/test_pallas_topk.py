@@ -188,6 +188,53 @@ def test_fused_gates():
         "euclidean", 16, 16384, 8, 2, 1000, backend="cpu")
 
 
+def test_pack_sentinel_boundary_sets_overflow(mesh1):
+    """The all-ones packed code is reserved (ADVICE r5): a REAL candidate
+    whose clamped int distance is exactly val_max-1 and whose
+    segment-local index is all-ones packs to 0x7FFFFFFF == _SENT.  It
+    must set the row's overflow bit (it previously read as an empty
+    register with no flag), while the selection of genuinely smaller
+    candidates stays exact and unflagged."""
+    import jax.numpy as jnp
+
+    pt = pallas_topk
+    nt = 512                                   # one tile; extent 512
+    bits = pt._seg_bits(pt._seg_extent(nt))    # 9 -> val budget 2^22
+    val_max = 1 << (31 - bits)
+    # manhattan with one unit-weight column and scale 1: di == |q - t|
+    tn = np.arange(1, nt + 1, dtype=np.float32)[:, None]
+    tn[nt - 1, 0] = float(val_max - 1)   # g = 511 = all-ones index bits
+    qn = np.zeros((pt._QB, 1), np.float32)
+    kernel = pt._make_kernel(1, 0, (), 1.0, 1, nj=nt // pt._TB, bits=bits,
+                             reduce_out=True, algorithm="manhattan")
+    main, flags = pt._bins_pallas_call(
+        kernel, np.asarray([nt], np.int32), jnp.asarray(qn), None,
+        jnp.asarray(tn), None, 1, 0, ni=1, nj=nt // pt._TB,
+        nq_loc=pt._QB, W=pt._WRED, interpret=True)
+    flags = np.asarray(flags)
+    # every query row saw the boundary candidate -> overflow bit set
+    assert (flags < 0).any(axis=1).all(), \
+        "real candidate packed to _SENT without setting overflow"
+    k = 8
+    sel_v, sel_i, suspect = pt.select_and_check(
+        jnp.asarray(main), jnp.asarray(flags), k, bits)
+    # selection is full (511 packable candidates), so the reserved-code
+    # candidate cannot belong to the top-k and no fallback is needed
+    np.testing.assert_array_equal(np.asarray(sel_v)[0], np.arange(1, k + 1))
+    np.testing.assert_array_equal(np.asarray(sel_i)[0], np.arange(k))
+    assert not np.asarray(suspect).any()
+
+    # control: with the boundary candidate one unit cheaper (no longer
+    # the reserved code) the overflow bit must NOT fire
+    tn2 = tn.copy()
+    tn2[nt - 1, 0] = float(val_max - 2)
+    _, flags2 = pt._bins_pallas_call(
+        kernel, np.asarray([nt], np.int32), jnp.asarray(qn), None,
+        jnp.asarray(tn2), None, 1, 0, ni=1, nj=nt // pt._TB,
+        nq_loc=pt._QB, W=pt._WRED, interpret=True)
+    assert not (np.asarray(flags2) < 0).any()
+
+
 def test_merge_networks_zero_one_principle():
     """The in-kernel reduce uses Batcher odd-even merges + bitonic
     keep-16; verify them exhaustively by the 0-1 principle (a merge

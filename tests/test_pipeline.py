@@ -1,0 +1,369 @@
+"""Out-of-core chunked ingest engine (core/pipeline) smoke: fast CPU
+`-m 'not slow'` coverage proving chunked streaming results are
+BIT-IDENTICAL to the monolithic paths for every ported consumer — NB,
+Markov transitions, tree level passes, Apriori support counting, mutual
+information — at multiple small chunk sizes (including a ragged final
+chunk) and prefetch depths 0/1/2, plus the engine's own contracts
+(donated-accumulator parity, error propagation, device-budget chunk
+sizing)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from avenir_tpu import native
+from avenir_tpu.core import DatasetEncoder, FeatureSchema, JobConfig
+from avenir_tpu.core import pipeline
+from avenir_tpu.core.metrics import Counters
+
+
+@pytest.fixture
+def have_native():
+    if native.get_lib() is None:
+        pytest.skip("C toolchain unavailable")
+
+
+# ---------------------------------------------------------------------------
+# engine contracts
+# ---------------------------------------------------------------------------
+
+def test_streaming_fold_depth_and_tail_parity(mesh8):
+    """Depths 0/1/2, fixed-capacity and pow2 bucketing, ragged final
+    chunk: all fold to the same tables as one monolithic reduce."""
+    from avenir_tpu.models.bayesian import _nb_local
+    from avenir_tpu.ops.counting import sharded_reduce
+
+    rng = np.random.default_rng(0)
+    n, F, B, C = 997, 4, 6, 3                  # odd n -> ragged tail
+    x = rng.integers(0, B, (n, F)).astype(np.int32)
+    y = rng.integers(0, C, n).astype(np.int32)
+    want = np.asarray(sharded_reduce(_nb_local, x, y, mesh=mesh8,
+                                     static_args=(C, B)))
+    for depth in (0, 1, 2):
+        for cap in (None, 128):
+            def chunks():
+                for s in range(0, n, 101):
+                    yield x[s:s + 101], y[s:s + 101]
+            got = pipeline.streaming_fold(
+                chunks(), _nb_local, static_args=(C, B), mesh=mesh8,
+                prefetch_depth=depth, capacity=cap)
+            np.testing.assert_array_equal(got, want, err_msg=f"{depth}/{cap}")
+
+
+def test_streaming_fold_error_propagation_and_empty(mesh8):
+    from avenir_tpu.models.bayesian import _nb_local
+
+    x = np.zeros((8, 2), np.int32)
+    y = np.zeros(8, np.int32)
+
+    def bad():
+        yield x, y
+        raise RuntimeError("boom")
+
+    for depth in (0, 2):
+        with pytest.raises(RuntimeError, match="boom"):
+            pipeline.streaming_fold(bad(), _nb_local, static_args=(1, 1),
+                                    mesh=mesh8, prefetch_depth=depth)
+    assert pipeline.streaming_fold(iter(()), _nb_local, static_args=(1, 1),
+                                   mesh=mesh8) is None
+
+
+def test_rows_for_budget_and_config():
+    assert pipeline.rows_for_budget(4000, 10, prefetch_depth=2) == 100
+    assert pipeline.rows_for_budget(1, 10) == 1          # never 0
+    cfg = JobConfig({"pipeline.chunk.rows": "500"})
+    assert pipeline.chunk_rows_from_config(cfg) == 500
+    cfg2 = JobConfig({"pipeline.device.budget.bytes": "4000",
+                      "pipeline.prefetch.depth": "2"})
+    assert pipeline.chunk_rows_from_config(cfg2, row_bytes=10) == 100
+    assert pipeline.chunk_rows_from_config(JobConfig({})) is None
+    assert pipeline.prefetch_depth_from_config(JobConfig({})) == 2
+    with pytest.raises(ValueError):
+        pipeline.prefetch_depth_from_config(
+            JobConfig({"pipeline.prefetch.depth": "-1"}))
+    with pytest.raises(ValueError):
+        pipeline.chunk_rows_from_config(
+            JobConfig({"pipeline.chunk.rows": "0"}))
+
+
+def test_iter_field_chunks_bulk_and_ragged(tmp_path):
+    p = tmp_path / "in.txt"
+    p.write_text("a,1\nb,2\n\nc,3\nd,4,5\ne,6\n")   # blank + ragged chunk
+    chunks = list(pipeline.iter_field_chunks(str(p), ",", 3))
+    # first chunk rectangular -> one bulk ndarray (blank lines skipped);
+    # second chunk internally ragged -> per-line field lists
+    assert isinstance(chunks[0], np.ndarray)
+    assert chunks[0].tolist() == [["a", "1"], ["b", "2"], ["c", "3"]]
+    assert chunks[1] == [["d", "4", "5"], ["e", "6"]]
+
+
+# ---------------------------------------------------------------------------
+# consumer parity (chunked == monolithic, multiple chunk sizes + tail)
+# ---------------------------------------------------------------------------
+
+NB_SCHEMA = {"fields": [
+    {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+    {"name": "color", "ordinal": 1, "dataType": "categorical",
+     "feature": True, "cardinality": ["red", "green"]},
+    {"name": "amount", "ordinal": 2, "dataType": "int", "feature": True,
+     "min": 0, "max": 100, "bucketWidth": 7},
+    {"name": "score", "ordinal": 3, "dataType": "double", "feature": True},
+    {"name": "label", "ordinal": 4, "dataType": "categorical",
+     "cardinality": ["N", "Y"]},
+]}
+
+
+def _nb_rows(n=313, seed=3):
+    rng = np.random.default_rng(seed)
+    colors = ["blue", "red", "grey", "green", "teal"]
+    return [[f"id{i:04d}", colors[rng.integers(len(colors))],
+             str(int(rng.integers(0, 100))), f"{rng.uniform(-5, 5):.4f}",
+             "NYYN"[int(rng.integers(4))]] for i in range(n)]
+
+
+def _write_nb(tmp_path, rows):
+    sp = tmp_path / "schema.json"
+    sp.write_text(json.dumps(NB_SCHEMA))
+    ip = tmp_path / "in"
+    ip.mkdir(exist_ok=True)
+    (ip / "part-00000").write_text(
+        "\n".join(",".join(r) for r in rows) + "\n")
+    return str(sp), str(ip)
+
+
+def test_nb_chunk_rows_depths_bit_identical(tmp_path, have_native, mesh8):
+    from avenir_tpu.models.bayesian import BayesianDistribution
+
+    rows = _nb_rows()
+    sp, ip = _write_nb(tmp_path, rows)
+    serial = DatasetEncoder(FeatureSchema.from_json(json.dumps(NB_SCHEMA)))
+    job0 = BayesianDistribution(JobConfig({"feature.schema.file.path": sp}))
+    ds = serial.encode_path(ip)
+    want = job0.train_lines(ds, ",", Counters())
+    for chunk_rows in (50, 128, 1000):         # 313 rows -> ragged tails
+        for depth in (0, 1, 2):
+            job = BayesianDistribution(JobConfig({
+                "feature.schema.file.path": sp,
+                "pipeline.chunk.rows": str(chunk_rows),
+                "pipeline.prefetch.depth": str(depth)}))
+            got = job._train_streamed(ip, ",", ",", Counters())
+            assert got == want, (chunk_rows, depth)
+
+
+def test_nb_trains_within_device_budget(tmp_path, have_native, mesh8):
+    """A dataset LARGER than the configured device-memory budget trains
+    through the chunked path: residency is bounded by (depth + 2) chunks
+    sized from the budget, and the model is bit-identical."""
+    from avenir_tpu.models.bayesian import BayesianDistribution
+
+    rows = _nb_rows(600, seed=9)
+    sp, ip = _write_nb(tmp_path, rows)
+    # ~20 bytes/row estimate -> dataset "footprint" 600 rows x 4 cols x
+    # 4B = ~10 KB; budget 2 KB forces many chunks
+    budget = 2048
+    job = BayesianDistribution(JobConfig({
+        "feature.schema.file.path": sp,
+        "pipeline.device.budget.bytes": str(budget),
+        "pipeline.prefetch.depth": "2"}))
+    counters = Counters()
+    got = job._train_streamed(ip, ",", ",", counters)
+    assert got is not None
+    n_chunks = counters.get("Ingest", "Chunks")
+    assert n_chunks > 1, "budget did not force chunking"
+    # the derived chunk is a small fraction of the dataset, and all
+    # (depth + 2) concurrently-live chunks fit the budget at the
+    # conservative un-narrowed row estimate the trainer uses
+    F = 4
+    chunk_rows = pipeline.rows_for_budget(budget, 4 * (F + 1), 2)
+    assert chunk_rows < len(rows)
+    assert chunk_rows * 4 * (F + 1) * (2 + 2) <= budget
+    serial = DatasetEncoder(FeatureSchema.from_json(json.dumps(NB_SCHEMA)))
+    want = BayesianDistribution(
+        JobConfig({"feature.schema.file.path": sp})).train_lines(
+            serial.encode_path(ip), ",", Counters())
+    assert got == want
+
+
+def test_markov_chunked_bit_identical(tmp_path, mesh8):
+    from avenir_tpu.models.markov import (MARKETING_STATES,
+                                          MarkovStateTransitionModel)
+
+    rng = np.random.default_rng(0)
+    lines = []
+    for i in range(157):
+        seq = [MARKETING_STATES[j]
+               for j in rng.integers(0, 9, rng.integers(2, 9))]
+        lines.append(",".join([f"c{i}"] + seq))
+    (tmp_path / "in.txt").write_text("\n".join(lines) + "\n")
+    base = {"mst.model.states": ",".join(MARKETING_STATES),
+            "skip.field.count": "1"}
+    MarkovStateTransitionModel(JobConfig(dict(base))).run(
+        str(tmp_path / "in.txt"), str(tmp_path / "mono"))
+    want = (tmp_path / "mono" / "part-r-00000").read_text()
+    for chunk_rows in (13, 1000):              # 157 rows -> ragged tail
+        for depth in (0, 2):
+            out = tmp_path / f"s{chunk_rows}_{depth}"
+            MarkovStateTransitionModel(JobConfig(dict(
+                base, **{"pipeline.chunk.rows": str(chunk_rows),
+                         "pipeline.prefetch.depth": str(depth)}))).run(
+                str(tmp_path / "in.txt"), str(out))
+            assert (out / "part-r-00000").read_text() == want, \
+                (chunk_rows, depth)
+
+
+TREE_SCHEMA = {"fields": [
+    {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+    {"name": "color", "ordinal": 1, "dataType": "categorical",
+     "feature": True, "cardinality": ["red", "green", "blue"],
+     "maxSplit": 2},
+    {"name": "size", "ordinal": 2, "dataType": "int", "feature": True,
+     "min": 0, "max": 100, "bucketWidth": 25, "splitScanInterval": 25,
+     "maxSplit": 3},
+    {"name": "label", "ordinal": 3, "dataType": "categorical",
+     "cardinality": ["N", "Y"]},
+]}
+
+
+def test_tree_level_chunked_bit_identical(tmp_path, mesh8):
+    """Full multi-level growth: decision-file JSON and every level's
+    routed records identical between monolithic and chunked passes."""
+    from avenir_tpu.models.tree import DecisionTreeBuilder
+
+    rng = np.random.default_rng(1)
+    rows = []
+    for i in range(211):
+        c = ["red", "green", "blue"][rng.integers(3)]
+        s = int(rng.integers(0, 100))
+        lbl = "Y" if (c == "red") ^ (s > 55) ^ (rng.random() < 0.15) else "N"
+        rows.append(f"id{i},{c},{s},{lbl}")
+
+    def grow(tag, extra):
+        d = tmp_path / tag
+        d.mkdir()
+        (d / "schema.json").write_text(json.dumps(TREE_SCHEMA))
+        (d / "in.txt").write_text("\n".join(rows) + "\n")
+        props = {"feature.schema.file.path": str(d / "schema.json"),
+                 "decision.file.path": str(d / "dec.json"),
+                 "path.stopping.strategy": "maxDepth",
+                 "max.depth.limit": "2", "sub.sampling.strategy": "none"}
+        props.update(extra)
+        DecisionTreeBuilder(JobConfig(props)).run_loop(
+            str(d / "in.txt"), str(d / "work"), max_levels=3)
+        out = {"dec": (d / "dec.json").read_text()}
+        for lvl in range(3):
+            p = d / "work" / f"level_{lvl}" / "part-r-00000"
+            out[f"l{lvl}"] = p.read_text() if p.exists() else None
+        return out
+
+    want = grow("mono", {})
+    for chunk_rows, depth in ((23, 0), (23, 2), (5000, 1)):
+        got = grow(f"s{chunk_rows}_{depth}",
+                   {"pipeline.chunk.rows": str(chunk_rows),
+                    "pipeline.prefetch.depth": str(depth)})
+        assert got == want, (chunk_rows, depth)
+
+
+def test_apriori_chunked_bit_identical(tmp_path, mesh8):
+    from avenir_tpu.models.association import FrequentItemsApriori
+
+    rng = np.random.default_rng(3)
+    items = [f"I{i:03d}" for i in range(40)]
+    lines = []
+    for t in range(331):
+        blk = int(rng.integers(0, 5))
+        picks = rng.choice(8, 4, replace=False) + blk * 8
+        lines.append(",".join([f"T{t:05d}"] + [items[p] for p in picks]))
+    (tmp_path / "in.txt").write_text("\n".join(lines) + "\n")
+
+    def run_ks(tag, extra, emit_tid):
+        base = {"fia.skip.field.count": "1", "fia.tans.id.ord": "0",
+                "fia.support.threshold": "0.01",
+                "fia.total.tans.count": "331",
+                "fia.emit.trans.id": str(emit_tid).lower()}
+        base.update(extra)
+        outs = []
+        for k in (1, 2, 3):
+            props = dict(base, **{"fia.item.set.length": str(k)})
+            if k > 1:
+                props["fia.item.set.file.path"] = str(
+                    tmp_path / f"{tag}k{k - 1}")
+            FrequentItemsApriori(JobConfig(props)).run(
+                str(tmp_path / "in.txt"), str(tmp_path / f"{tag}k{k}"))
+            outs.append(
+                (tmp_path / f"{tag}k{k}" / "part-r-00000").read_text())
+        return outs
+
+    for emit_tid in (False, True):             # count + distinct/tid modes
+        want = run_ks(f"m{emit_tid}", {}, emit_tid)
+        got = run_ks(f"s{emit_tid}",
+                     {"pipeline.chunk.rows": "100",
+                      "pipeline.prefetch.depth": "2"}, emit_tid)
+        assert got == want, emit_tid
+
+
+MI_SCHEMA = {"fields": [
+    {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+    {"name": "color", "ordinal": 1, "dataType": "categorical",
+     "feature": True, "cardinality": ["red", "green", "blue"]},
+    {"name": "size", "ordinal": 2, "dataType": "int", "feature": True,
+     "min": 0, "max": 60, "bucketWidth": 10},
+    {"name": "label", "ordinal": 3, "dataType": "categorical",
+     "cardinality": ["N", "Y"]},
+]}
+
+
+def test_mutual_info_chunked_bit_identical(tmp_path, mesh8):
+    from avenir_tpu.models.mutual_info import MutualInformation
+
+    rng = np.random.default_rng(5)
+    (tmp_path / "schema.json").write_text(json.dumps(MI_SCHEMA))
+    rows = []
+    for i in range(219):
+        c = ["red", "green", "blue"][rng.integers(3)]
+        s = int(rng.integers(0, 60))
+        lbl = "Y" if (c == "red") ^ (s > 30) ^ (rng.random() < 0.2) else "N"
+        rows.append(f"id{i},{c},{s},{lbl}")
+    (tmp_path / "in.txt").write_text("\n".join(rows) + "\n")
+
+    def run(tag, extra):
+        props = {"feature.schema.file.path": str(tmp_path / "schema.json")}
+        props.update(extra)
+        MutualInformation(JobConfig(props)).run(
+            str(tmp_path / "in.txt"), str(tmp_path / tag))
+        return (tmp_path / tag / "part-r-00000").read_text()
+
+    want = run("mono", {})
+    for chunk_rows, depth in ((40, 0), (40, 2), (3000, 1)):
+        got = run(f"s{chunk_rows}_{depth}",
+                  {"pipeline.chunk.rows": str(chunk_rows),
+                   "pipeline.prefetch.depth": str(depth)})
+        assert got == want, (chunk_rows, depth)
+
+
+def test_mi_chunked_falls_back_identically_on_negative_bins(tmp_path,
+                                                            mesh8):
+    """A negative-bin column needs a GLOBAL shift, so the chunked path
+    must fall back — and the public run() output stays identical."""
+    from avenir_tpu.models.mutual_info import MutualInformation
+
+    schema = {"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "delta", "ordinal": 1, "dataType": "int", "feature": True,
+         "min": -50, "max": 50, "bucketWidth": 10},
+        {"name": "label", "ordinal": 2, "dataType": "categorical",
+         "cardinality": ["N", "Y"]},
+    ]}
+    (tmp_path / "schema.json").write_text(json.dumps(schema))
+    rng = np.random.default_rng(7)
+    rows = [f"id{i},{int(rng.integers(-50, 50))},{'NY'[i % 2]}"
+            for i in range(90)]
+    (tmp_path / "in.txt").write_text("\n".join(rows) + "\n")
+    props = {"feature.schema.file.path": str(tmp_path / "schema.json")}
+    MutualInformation(JobConfig(props)).run(
+        str(tmp_path / "in.txt"), str(tmp_path / "mono"))
+    MutualInformation(JobConfig(dict(
+        props, **{"pipeline.chunk.rows": "20"}))).run(
+        str(tmp_path / "in.txt"), str(tmp_path / "chunked"))
+    assert ((tmp_path / "chunked" / "part-r-00000").read_text()
+            == (tmp_path / "mono" / "part-r-00000").read_text())

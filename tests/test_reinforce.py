@@ -570,6 +570,64 @@ def test_grouped_streaming_loop_parity_and_convergence():
     assert int(loop2.group.total[loop2.group.rows_for(["dup"])[0]]) == 4
 
 
+def test_grouped_loop_max_pending_batches_config():
+    """``streaming.max.pending.batches`` bounds the emit backlog: 1
+    restores the reference bolt's immediate per-wave emit (every wave's
+    actions are flushed before the next wave dispatches), the default (4)
+    keeps the throughput pipelining — identical actions either way."""
+    from avenir_tpu.models.streaming import (GroupedStreamingLearnerLoop,
+                                             InMemoryTransport)
+
+    actions = ["p1", "p2", "p3"]
+    base = {"reinforcement.learner.type": "upperConfidenceBoundOne",
+            "reinforcement.learner.actions": ",".join(actions),
+            "min.trial": "1", "reward.scale": "1"}
+
+    class WatchedTransport(InMemoryTransport):
+        """Records the action-queue length observed at every event pop —
+        immediate emit keeps the actions queue caught up with processed
+        waves; the pipelined default lets it lag."""
+
+        def __init__(self):
+            super().__init__()
+            self.lag = []
+
+        def next_event(self):
+            msg = super().next_event()
+            if msg is not None:
+                self.lag.append(len(self.actions))
+            return msg
+
+    def drive(cfg):
+        t = WatchedTransport()
+        loop = GroupedStreamingLearnerLoop(cfg, t)
+        for w in range(6):
+            for e in range(3):
+                t.push_event(f"e{e}", w)
+        n = loop.run(max_events=18, idle_timeout=0.0, batch=3)
+        assert n == 18 and len(t.actions) == 18
+        return loop, t
+
+    loop_imm, t_imm = drive(dict(base, **{
+        "streaming.max.pending.batches": "1"}))
+    assert loop_imm.max_pending_batches == 1
+    loop_def, t_def = drive(dict(base))
+    assert loop_def.max_pending_batches == 4
+    assert t_imm.actions == t_def.actions      # semantics identical
+    # immediate mode: by the time wave w's first event pops, every prior
+    # wave's 3 actions are already emitted
+    assert all(lag % 3 == 0 for lag in t_imm.lag[::3])
+    assert t_imm.lag[-1] >= 15                 # waves 1..5 saw prior emits
+    # pipelined mode lags behind immediate mode somewhere in the run
+    assert min(l_d - l_i for l_d, l_i
+               in zip(t_def.lag, t_imm.lag)) <= -3 or t_def.lag != t_imm.lag
+
+    import pytest
+    with pytest.raises(ValueError):
+        GroupedStreamingLearnerLoop(dict(base, **{
+            "streaming.max.pending.batches": "0"}), InMemoryTransport())
+
+
 def test_grouped_loop_batch_size_and_enroll_dedup():
     """batch.size emits that many actions per event (scalar-loop parity for
     the eventID,action[,action...] format), and enrolling a brand-new
