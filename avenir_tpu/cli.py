@@ -100,10 +100,24 @@ def main(argv=None) -> int:
     if not argv:
         print("usage: python -m avenir_tpu <JobClass> -Dconf.path=<props> <in> <out>",
               file=sys.stderr)
+        print("       python -m avenir_tpu serve -Dconf.path=<serve.properties>",
+              file=sys.stderr)
         print("known jobs:\n  " + "\n  ".join(sorted(JOBS)), file=sys.stderr)
         return 2
 
     job_name, rest = argv[0], argv[1:]
+    if job_name == "serve":
+        # online prediction service (model registry + micro-batching
+        # frontend) — net-new surface, no reference driver class
+        import os
+        plat = os.environ.get("AVENIR_PLATFORM")
+        if plat:
+            import jax
+            jax.config.update("jax_platforms", plat)
+        import avenir_tpu
+        avenir_tpu.enable_x64()
+        from .serve.server import serve_main
+        return serve_main(rest)
     # --profile-dir=<dir>: capture a jax.profiler trace of the whole job
     # (SURVEY §5 tracing rebuild note); view with TensorBoard or Perfetto
     profile_dir = None

@@ -550,7 +550,7 @@ class AssociationRuleMiner:
     def __init__(self, config: JobConfig):
         self.config = config.with_prefix("arm") if not config.prefix else config
 
-    def run(self, in_path: str, out_path: str) -> Counters:
+    def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         cfg = self.config
         delim_regex = cfg.field_delim_regex()
@@ -591,7 +591,7 @@ class InfrequentItemMarker:
     def __init__(self, config: JobConfig):
         self.config = config.with_prefix("iim") if not config.prefix else config
 
-    def run(self, in_path: str, out_path: str) -> Counters:
+    def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         cfg = self.config
         delim_regex = cfg.field_delim_regex()

@@ -157,7 +157,7 @@ class GreedyRandomBandit(_BanditJobBase):
     PROB_RED_LOG_LINEAR = "logLinear"
     AUER_GREEDY = "AuerGreedy"
 
-    def run(self, in_path: str, out_path: str) -> Counters:
+    def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         cfg = self.config
         (delim_regex, delim, round_num, count_ord, reward_ord,
@@ -263,7 +263,7 @@ class GreedyRandomBandit(_BanditJobBase):
 class AuerDeterministic(_BanditJobBase):
     """Deterministic UCB1 batch bandit (AuerDeterministic.java:74-233)."""
 
-    def run(self, in_path: str, out_path: str) -> Counters:
+    def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         cfg = self.config
         (delim_regex, delim, round_num, count_ord, reward_ord,
@@ -312,7 +312,7 @@ class SoftMaxBandit(_BanditJobBase):
 
     DISTR_SCALE = 1000
 
-    def run(self, in_path: str, out_path: str) -> Counters:
+    def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         cfg = self.config
         (delim_regex, delim, round_num, count_ord, reward_ord,
@@ -394,7 +394,7 @@ class RandomFirstGreedyBandit(_BanditJobBase):
 
     RANK_MAX = 1000
 
-    def run(self, in_path: str, out_path: str) -> Counters:
+    def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         cfg = self.config
         delim_regex = cfg.field_delim_regex()

@@ -928,6 +928,20 @@ class BayesianPredictor:
     def _emit(self, raw_lines, records, actuals, probs, feat_prior, feat_post,
               delim, counters, out_path) -> Counters:
         """Shared arbitration + output emission (tabular and text modes)."""
+        out = self.emit_lines(raw_lines, records, actuals, probs, feat_prior,
+                              feat_post, delim, counters)
+        write_output(out_path, out)
+        return counters
+
+    def emit_lines(self, raw_lines, records, actuals, probs, feat_prior,
+                   feat_post, delim, counters,
+                   with_confusion: bool = True) -> List[str]:
+        """Arbitration + output-line formatting without the file write —
+        the piece the serving engine reuses so online responses are
+        byte-identical to the batch job's output lines.
+        ``with_confusion=False`` skips the confusion-matrix percentage
+        counters (whose integer divisions require both classes present —
+        guaranteed for a whole validation run, not for one micro-batch)."""
         conf = ConfusionMatrix(self.predicting_classes[0], self.predicting_classes[1])
         out: List[str] = []
         for i, line in enumerate(raw_lines):
@@ -962,7 +976,6 @@ class BayesianPredictor:
                 counters.incr("Validation", "Incorrect")
             out.append(f"{line}{delim}{pred}{delim}{prob}{suffix}")
 
-        if not self.output_feature_prob_only:
+        if not self.output_feature_prob_only and with_confusion:
             conf.to_counters(counters)
-        write_output(out_path, out)
-        return counters
+        return out

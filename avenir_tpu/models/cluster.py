@@ -130,7 +130,7 @@ class AgglomerativeGraphical:
     def _new_id(self) -> str:
         return "%032x" % self.rng.getrandbits(128)
 
-    def run(self, in_path: str, out_path: str) -> Counters:
+    def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         delim_regex = self.config.field_delim_regex()
         delim = self.config.field_delim_out()

@@ -58,7 +58,7 @@ class NumericalAttrStats:
     def __init__(self, config: JobConfig):
         self.config = config
 
-    def run(self, in_path: str, out_path: str) -> Counters:
+    def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         cfg = self.config
         delim = cfg.field_delim_out()
@@ -87,7 +87,7 @@ class FisherDiscriminant:
     def __init__(self, config: JobConfig):
         self.config = config
 
-    def run(self, in_path: str, out_path: str) -> Counters:
+    def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         cfg = self.config
         delim = cfg.field_delim_out()

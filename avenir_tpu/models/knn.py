@@ -207,7 +207,7 @@ class FeatureCondProbJoiner:
     def __init__(self, config: JobConfig):
         self.config = config
 
-    def run(self, in_path: str, out_path: str) -> Counters:
+    def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         delim_regex = self.config.field_delim_regex()
         delim = self.config.field_delim_out()
@@ -387,10 +387,49 @@ class NearestNeighbor:
         raise ValueError(
             f"unsupported regression method {self.regression_method}")
 
-    def run(self, in_path: str, out_path: str) -> Counters:
+    def classify_group(self, neighbors: List[Tuple], test_id: str,
+                       test_class_val: str = "",
+                       test_regr_val: float = 0.0) -> Tuple[str, str]:
+        """One neighborhood decision: ``neighbors`` are (dist, trainId,
+        trainClass, postProb, regrIn) tuples in arrival order.  Returns
+        (output line, predicted) — the per-reducer-group body of ``run``,
+        shared verbatim with the serving engine's kNN adapter so online
+        responses are byte-identical to the batch job's lines."""
+        delim = self.config.field_delim_out()
+        ccw = self.class_cond_weighted
+        neighbors = sorted(neighbors, key=lambda t: t[0])
+        top = neighbors[:self.top_match_count]
+        dists = np.asarray([t[0] for t in top])
+        cvals = [t[2] for t in top]
+        posts = np.asarray([t[3] for t in top])
+        scores = self.neighborhood.scores(dists)
+        if ccw:
+            scores = self.neighborhood.weighted_scores(scores, dists, posts)
+
+        distr = self._distribution(cvals, scores)
+        parts = [test_id]
+        if self.output_class_distr \
+                and self.prediction_mode == "classification":
+            for cv, s in distr.items():
+                parts += [cv, str(s if ccw else int(s))]
+        if self.validation:
+            parts.append(test_class_val)
+
+        if self.prediction_mode == "classification":
+            if self.use_cost_based:
+                pos_prob = self._class_prob(distr, self.pos_class)
+                predicted = self.arbitrator.classify(pos_prob)
+            else:
+                predicted = self._classify(distr)
+        else:
+            predicted = str(self._regress(
+                cvals, [t[4] for t in top], test_regr_val))
+        parts.append(predicted)
+        return delim.join(parts), predicted
+
+    def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         delim_regex = self.config.field_delim_regex()
-        delim = self.config.field_delim_out()
         ccw = self.class_cond_weighted
         is_linreg = (self.prediction_mode == "regression"
                      and self.regression_method == "linearRegression")
@@ -425,37 +464,10 @@ class NearestNeighbor:
 
         out: List[str] = []
         for test_id, neighbors in groups.items():
-            neighbors.sort(key=lambda t: t[0])   # secondary-sort by distance
-            top = neighbors[:self.top_match_count]
-            dists = np.asarray([t[0] for t in top])
-            cvals = [t[2] for t in top]
-            posts = np.asarray([t[3] for t in top])
-            scores = self.neighborhood.scores(dists)
-            if ccw:
-                scores = self.neighborhood.weighted_scores(
-                    scores, dists, posts)
-
-            distr = self._distribution(cvals, scores)
-            parts = [test_id]
-            if self.output_class_distr \
-                    and self.prediction_mode == "classification":
-                for cv, s in distr.items():
-                    parts += [cv, str(s if ccw else int(s))]
-            if self.validation:
-                parts.append(test_class.get(test_id, ""))
-
-            if self.prediction_mode == "classification":
-                if self.use_cost_based:
-                    pos_prob = self._class_prob(distr, self.pos_class)
-                    predicted = self.arbitrator.classify(pos_prob)
-                else:
-                    predicted = self._classify(distr)
-            else:
-                predicted = str(self._regress(
-                    cvals, [t[4] for t in top], test_regr.get(test_id, 0.0)))
-            parts.append(predicted)
-            out.append(delim.join(parts))
-
+            line, predicted = self.classify_group(
+                neighbors, test_id, test_class.get(test_id, ""),
+                test_regr.get(test_id, 0.0))
+            out.append(line)
             if self.conf_matrix is not None:
                 self.conf_matrix.report(predicted, test_class.get(test_id, ""))
 

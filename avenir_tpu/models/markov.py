@@ -91,6 +91,33 @@ def _markov_pair_local(frm, to, cls, mask, n_class, n_states):
     return count_table((n_states, n_states), (frm, to), mask=mask)
 
 
+def _mmc_pair_log_odds(frm, to, valid, t0, t1):
+    """Per-row log-odds ``sum log(P_c0[from,to] / P_c1[from,to])`` over a
+    sequence batch, with invalid (-1 padded) cells contributing exact 0 —
+    module-level so the jitted scorer is shared (and compile-cached)
+    between the batch classifier job and the serving engine's bucketed
+    scorer.
+
+    The row sum runs as an ORDERED left-to-right ``lax.scan`` rather than
+    an axis reduction: a reduction's association (and therefore its
+    rounding) may change with the padded extent, while the scan's
+    sequential order means appended padding terms — exact +0.0 — can
+    never perturb a score.  That padding invariance is what lets the
+    serving batcher pad rows/lengths to power-of-two buckets and still
+    return byte-identical lines to the batch job (tests/test_serve.py),
+    while only n floats (not the [n, L] pair matrix) leave the device."""
+    f = jnp.where(valid, frm, 0)
+    t = jnp.where(valid, to, 0)
+    lo = jnp.where(valid, jnp.log(t0[f, t] / t1[f, t]), 0.0)
+
+    def step(acc, col):
+        return acc + col, None
+
+    total, _ = jax.lax.scan(
+        step, jnp.zeros(lo.shape[0], lo.dtype), lo.T)
+    return total
+
+
 def _hmm_local(frm, to, obs_s, obs_o, init_s, mask, S, O):
     m = mask[:, None]
     return {
@@ -372,60 +399,108 @@ def marketing_next_dates_from_histories(histories: Dict[str, list],
 
 
 class MarkovModelClassifier:
-    """Map-only log-odds classifier, vectorized over the sequence batch."""
+    """Map-only log-odds classifier, vectorized over the sequence batch.
+
+    The scoring core is exposed as :meth:`classify_records` so the online
+    serving engine (``avenir_tpu.serve``) runs the IDENTICAL code path the
+    batch job does: the jitted scorer is the module-level
+    ``_mmc_pair_log_odds`` (one compile per padded shape, shareable through
+    a caller-supplied compiled function), whose ordered row sum makes
+    scores invariant to the serving engine's bucket padding."""
 
     def __init__(self, config: JobConfig):
         self.config = config
+        self._prepared = False
 
-    def run(self, in_path: str, out_path: str) -> Counters:
-        counters = Counters()
+    def _prepare(self) -> None:
+        """Parse config + load the model once (idempotent) — the serving
+        registry constructs the classifier at model-load time and calls
+        ``classify_records`` per micro-batch."""
+        if self._prepared:
+            return
         cfg = self.config
-        delim_regex = cfg.field_delim_regex()
-        delim = cfg.field_delim_out()
-        skip = cfg.get_int("skip.field.count", 1)
-        id_ord = cfg.get_int("id.field.ord", 0)
+        self.skip = cfg.get_int("skip.field.count", 1)
+        self.id_ord = cfg.get_int("id.field.ord", 0)
         class_based = cfg.get_boolean("class.label.based.model", False)
-        validation = cfg.get_boolean("validation.mode", False)
-        class_ord = -1
-        if validation:
-            skip += 1
-            class_ord = cfg.get_int("class.label.field.ord", -1)
-            if class_ord < 0:
+        self.validation = cfg.get_boolean("validation.mode", False)
+        self.class_ord = -1
+        if self.validation:
+            self.skip += 1
+            self.class_ord = cfg.get_int("class.label.field.ord", -1)
+            if self.class_ord < 0:
                 raise ValueError(
                     "In validation mode actual class labels must be provided")
-        model = MarkovModel.load(cfg.must("mm.model.path"), class_based)
-        class_labels = cfg.must("class.labels").split(",")
-        threshold = cfg.get_float("log.odds.threshold", 0.0)
+        self.model = MarkovModel.load(cfg.must("mm.model.path"), class_based)
+        self.class_labels = cfg.must("class.labels").split(",")
+        self.threshold = cfg.get_float("log.odds.threshold", 0.0)
+        self._t0 = jnp.asarray(self.model.class_trans[self.class_labels[0]])
+        self._t1 = jnp.asarray(self.model.class_trans[self.class_labels[1]])
+        self._prepared = True
 
-        records = [split_line(l, delim_regex) for l in read_lines(in_path)]
-        usable = [r for r in records if len(r) >= skip + 2]
-        seq, _ = encode_sequences(usable, skip, model.index)
+    def min_fields(self) -> int:
+        """Shortest record the classifier can score (shorter rows are
+        dropped by the batch job / rejected per-request by serving)."""
+        self._prepare()
+        return self.skip + 2
+
+    def log_odds_scores(self, usable: List[List[str]], score_fn=None,
+                        pad_rows_to: Optional[int] = None,
+                        pad_len_to: Optional[int] = None) -> List[float]:
+        """Log-odds per usable record.  ``pad_rows_to``/``pad_len_to`` pad
+        the encoded [n, Lmax] sequence matrix with -1 (self-masking) up to
+        a serving bucket so the jitted scorer hits a fixed set of compiled
+        shapes; padding is score-invariant (masked cells contribute exact
+        0.0 to the ordered scan sum — see ``_mmc_pair_log_odds``)."""
+        self._prepare()
+        if not usable:
+            return []
+        seq, _ = encode_sequences(usable, self.skip, self.model.index)
+        n, L = seq.shape
+        if pad_len_to is not None and pad_len_to > L:
+            seq = np.concatenate(
+                [seq, np.full((n, pad_len_to - L), -1, np.int32)], axis=1)
+        if pad_rows_to is not None and pad_rows_to > n:
+            seq = np.concatenate(
+                [seq, np.full((pad_rows_to - n, seq.shape[1]), -1, np.int32)],
+                axis=0)
         frm, to = _transition_pairs(seq)
         valid = (frm >= 0) & (to >= 0)
+        fn = score_fn if score_fn is not None else jax.jit(_mmc_pair_log_odds)
+        total = np.asarray(fn(frm, to, valid, self._t0, self._t1))
+        return [float(v) for v in total[:n]]
 
-        t0 = jnp.asarray(model.class_trans[class_labels[0]])
-        t1 = jnp.asarray(model.class_trans[class_labels[1]])
-
-        def score(frm, to, valid):
-            f = jnp.where(valid, frm, 0)
-            t = jnp.where(valid, to, 0)
-            lo = jnp.log(t0[f, t] / t1[f, t])
-            return jnp.sum(jnp.where(valid, lo, 0.0), axis=1)
-
-        log_odds = np.asarray(jax.jit(score)(frm, to, valid))
-
+    def classify_records(self, records: List[List[str]], counters: Counters,
+                         score_fn=None, pad_rows_to: Optional[int] = None,
+                         pad_len_to: Optional[int] = None) -> List[str]:
+        """Classify pre-split records; returns output lines (records too
+        short to hold a transition are dropped, as the reference mapper
+        does)."""
+        self._prepare()
+        delim = self.config.field_delim_out()
+        usable = [r for r in records if len(r) >= self.skip + 2]
+        log_odds = self.log_odds_scores(usable, score_fn=score_fn,
+                                        pad_rows_to=pad_rows_to,
+                                        pad_len_to=pad_len_to)
         out: List[str] = []
         for i, r in enumerate(usable):
-            pred = class_labels[0] if log_odds[i] > threshold else class_labels[1]
-            parts = [r[id_ord]]
-            if validation:
-                parts.append(r[class_ord])
-                if r[class_ord] == pred:
+            pred = (self.class_labels[0] if log_odds[i] > self.threshold
+                    else self.class_labels[1])
+            parts = [r[self.id_ord]]
+            if self.validation:
+                parts.append(r[self.class_ord])
+                if r[self.class_ord] == pred:
                     counters.incr("Validation", "Correct")
                 else:
                     counters.incr("Validation", "Incorrect")
             parts += [pred, repr(float(log_odds[i]))]
             out.append(delim.join(parts))
+        return out
+
+    def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
+        counters = Counters()
+        records = [split_line(l, self.config.field_delim_regex())
+                   for l in read_lines(in_path)]
+        out = self.classify_records(records, counters)
         write_output(out_path, out)
         return counters
 
@@ -630,7 +705,7 @@ class ViterbiStatePredictor:
     def __init__(self, config: JobConfig):
         self.config = config
 
-    def run(self, in_path: str, out_path: str) -> Counters:
+    def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         cfg = self.config
         delim_regex = cfg.field_delim_regex()

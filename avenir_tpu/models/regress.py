@@ -135,7 +135,7 @@ class LogisticRegressionJob:
                 f.write(line + "\n")
 
     # -- data ---------------------------------------------------------------
-    def _load(self, in_path: str):
+    def _load(self, in_path: str, mesh=None):
         if self._resident is not None and self._resident_path == in_path:
             return self._resident
         delim = self.config.field_delim_regex()
@@ -153,7 +153,7 @@ class LogisticRegressionJob:
         x = np.asarray(xs, dtype=np.float64)
         y = np.asarray(ys, dtype=np.float64)
 
-        mesh = get_mesh()
+        mesh = mesh or get_mesh()
         d = mesh.shape["data"]
         x, mask = pad_rows(x, d)
         y, _ = pad_rows(y, d)
@@ -163,7 +163,7 @@ class LogisticRegressionJob:
         return self._resident
 
     # -- one iteration ------------------------------------------------------
-    def run(self, in_path: str, out_path: str) -> int:
+    def run(self, in_path: str, out_path: str, mesh=None) -> int:
         cfg = self.config
         delim = cfg.field_delim_out()
         history = self._read_history()
@@ -173,7 +173,7 @@ class LogisticRegressionJob:
         coeff = np.asarray(
             [float(v) for v in split_line(history[-1], cfg.field_delim_regex())])
 
-        x, y, mask, mesh = self._load(in_path)
+        x, y, mask, mesh = self._load(in_path, mesh)
         if coeff.shape[0] != x.shape[1]:
             raise ValueError(
                 f"coefficient line has {coeff.shape[0]} values; expected "

@@ -29,7 +29,7 @@ class BaggingSampler:
     def __init__(self, config: JobConfig):
         self.config = config
 
-    def run(self, in_path: str, out_path: str) -> Counters:
+    def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         cfg = self.config
         batch_size = cfg.get_int("batch.size", 10000)
@@ -50,7 +50,7 @@ class UnderSamplingBalancer:
     def __init__(self, config: JobConfig):
         self.config = config
 
-    def run(self, in_path: str, out_path: str) -> Counters:
+    def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         cfg = self.config
         delim_regex = cfg.field_delim_regex()

@@ -995,7 +995,7 @@ class DataPartitioner:
         return attr, Split.from_key(attr, key, field), orig_index
 
     def run(self, in_path: Optional[str] = None,
-            out_path: Optional[str] = None) -> Counters:
+            out_path: Optional[str] = None, mesh=None) -> Counters:
         counters = Counters()
         delim_regex = self.config.field_delim_regex()
         # the reference derives both paths strictly from config

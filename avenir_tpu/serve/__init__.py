@@ -1,0 +1,32 @@
+"""Online serving subsystem: model registry + dynamic micro-batching server.
+
+The batch side of the framework turns trained artifacts into files via
+``run(in_path, out_path)`` jobs; this package is the online half — load an
+artifact ONCE into device-resident state and answer prediction requests at
+low latency (the Clipper-style adaptive micro-batching architecture; see
+PAPERS.md "Online serving").
+
+- ``engine``   — per-model scorer adapters wrapping the existing predict
+  paths (NB f32 log-space scorer, Markov log-odds classifier, decision-path
+  evaluation, fused kNN) behind one ``predict_lines(lines) -> lines``
+  surface, with a compile-counted bounded cache of jitted scorers keyed on
+  power-of-two batch buckets.
+- ``registry`` — loads artifacts from their reference text/JSON formats,
+  keyed by model name + version, with explicit warmup (pre-compile at the
+  configured buckets) and atomic hot-swap reload.
+- ``batcher``  — the dynamic micro-batching queue: requests accumulate up
+  to ``serve.batch.max.size`` or ``serve.batch.max.delay.ms``, score as one
+  padded bucket, and scatter back to per-request futures; admission control
+  (``serve.queue.max.depth``) sheds on overflow instead of OOMing.
+- ``server``   — stdlib JSON-lines TCP frontend + the ``python -m
+  avenir_tpu serve`` CLI entry, exporting per-model counters (requests,
+  batches, shed, batch-fill, p50/p95/p99 latency) through ``Counters``.
+"""
+
+from .batcher import MicroBatcher, ShedError                    # noqa: F401
+from .engine import ADAPTER_KINDS, pow2_bucket                  # noqa: F401
+from .registry import ModelRegistry                             # noqa: F401
+from .server import PredictionServer, serve_main                # noqa: F401
+
+__all__ = ["ADAPTER_KINDS", "MicroBatcher", "ModelRegistry",
+           "PredictionServer", "ShedError", "pow2_bucket", "serve_main"]

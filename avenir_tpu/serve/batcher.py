@@ -1,0 +1,196 @@
+"""Dynamic micro-batching queue with admission control.
+
+Requests accumulate until ``serve.batch.max.size`` are waiting or the
+OLDEST enqueued request has waited ``serve.batch.max.delay.ms`` — the
+Clipper-style adaptive batching trade: the delay bounds worst-case queue
+latency, the size bounds device memory, and the engine pads whatever
+arrived to a power-of-two bucket so the jitted scorer hits a warmed
+compiled shape (see engine.py).
+
+Admission control: a queue deeper than ``serve.queue.max.depth`` SHEDS new
+requests (``ShedError`` + the ``Serve / Shed`` counter) so overload
+degrades to fast-fail instead of growing an unbounded queue — the
+graceful-degradation half of the adaptive-batching literature.
+
+Each model gets one batcher (and one worker thread): per-model scorer
+state — the encoder vocabularies, the compiled-function cache, the device
+tables — is therefore only ever touched by one thread at a time, while
+the shared bounded caches underneath stay lock-protected for the
+warmup/reload paths (utils.caches).
+"""
+
+from __future__ import annotations
+
+import statistics
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Callable, List, Optional
+
+from ..core.metrics import Counters
+
+SERVE_GROUP = "Serve"
+
+
+class ShedError(RuntimeError):
+    """Raised by submit() when the queue is at ``serve.queue.max.depth``."""
+
+
+class _Request:
+    __slots__ = ("line", "future", "t_enqueue")
+
+    def __init__(self, line: str):
+        self.line = line
+        self.future: Future = Future()
+        self.t_enqueue = time.perf_counter()
+
+
+class MicroBatcher:
+    """One model's request queue + dispatch worker."""
+
+    def __init__(self, name: str,
+                 predict_fn: Callable[[List[str]], List[Optional[str]]],
+                 counters: Counters,
+                 max_batch: int = 64,
+                 max_delay_ms: float = 2.0,
+                 max_queue_depth: int = 256,
+                 latency_window: int = 4096):
+        self.name = name
+        self.predict_fn = predict_fn
+        self.counters = counters
+        self.max_batch = max(1, int(max_batch))
+        self.max_delay = max(0.0, float(max_delay_ms)) / 1000.0
+        self.max_queue_depth = max(1, int(max_queue_depth))
+        self._q: deque = deque()
+        self._cv = threading.Condition()
+        self._closed = False
+        # appended by the worker, snapshotted by stats readers — guarded
+        # by its own lock (deque iteration raises if it races an append)
+        self._lat_lock = threading.Lock()
+        self._latencies: deque = deque(maxlen=latency_window)
+        self._worker = threading.Thread(
+            target=self._run, name=f"serve-batcher-{name}", daemon=True)
+        self._worker.start()
+
+    # -- client side -------------------------------------------------------
+    def submit(self, line: str) -> Future:
+        """Enqueue one request line; the Future resolves to the output
+        line (or raises).  Sheds with ShedError past the depth limit."""
+        req = _Request(line)
+        with self._cv:
+            if self._closed:
+                raise RuntimeError(f"batcher {self.name} is closed")
+            if len(self._q) >= self.max_queue_depth:
+                self.counters.incr(SERVE_GROUP, "Shed")
+                raise ShedError(
+                    f"queue depth {len(self._q)} at serve.queue.max.depth")
+            self._q.append(req)
+            self._cv.notify()
+        return req.future
+
+    # -- worker side -------------------------------------------------------
+    def _drain_batch(self) -> List[_Request]:
+        """Block until a batch is ready: max size reached, or the oldest
+        request aged past max delay (holding the lock only while
+        waiting/draining, never while scoring)."""
+        with self._cv:
+            while not self._q and not self._closed:
+                self._cv.wait()
+            if not self._q:
+                return []
+            deadline = self._q[0].t_enqueue + self.max_delay
+            while (len(self._q) < self.max_batch and not self._closed):
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                self._cv.wait(remaining)
+                if not self._q:       # closed+drained while waiting
+                    return []
+                deadline = self._q[0].t_enqueue + self.max_delay
+            batch = []
+            while self._q and len(batch) < self.max_batch:
+                batch.append(self._q.popleft())
+            return batch
+
+    def _run(self) -> None:
+        while True:
+            batch = self._drain_batch()
+            if not batch:
+                with self._cv:
+                    if self._closed and not self._q:
+                        return
+                continue
+            self.counters.incr(SERVE_GROUP, "Requests", len(batch))
+            self.counters.incr(SERVE_GROUP, "Batches")
+            try:
+                outputs = self.predict_fn([r.line for r in batch])
+            except Exception as e:                 # noqa: BLE001
+                self.counters.incr(SERVE_GROUP, "Batch errors")
+                for r in batch:
+                    if not r.future.set_running_or_notify_cancel():
+                        continue
+                    r.future.set_exception(e)
+                continue
+            done = time.perf_counter()
+            with self._lat_lock:
+                for r in batch:
+                    self._latencies.append(done - r.t_enqueue)
+            for r, out in zip(batch, outputs):
+                if not r.future.set_running_or_notify_cancel():
+                    continue
+                if out is None:
+                    self.counters.incr(SERVE_GROUP, "Unscorable")
+                    r.future.set_exception(
+                        ValueError("record not scorable by this model"))
+                else:
+                    r.future.set_result(out)
+
+    # -- metrics / lifecycle ----------------------------------------------
+    def latency_percentiles_ms(self) -> dict:
+        """p50/p95/p99 of recent request latencies, in milliseconds."""
+        with self._lat_lock:
+            lat = sorted(self._latencies)
+        if not lat:
+            return {"p50": None, "p95": None, "p99": None, "n": 0}
+
+        def pct(p):
+            i = min(len(lat) - 1, int(p * len(lat)))
+            return round(lat[i] * 1000.0, 3)
+
+        return {"p50": pct(0.50), "p95": pct(0.95), "p99": pct(0.99),
+                "mean": round(statistics.fmean(lat) * 1000.0, 3),
+                "n": len(lat)}
+
+    def fill_ratio(self) -> Optional[float]:
+        """Requests / padded (bucketed) rows — 1.0 means every scored slot
+        carried a real request."""
+        padded = self.counters.get(SERVE_GROUP, "Padded rows")
+        if not padded:
+            return None
+        return self.counters.get(SERVE_GROUP, "Requests") / padded
+
+    def clear_latency_window(self) -> None:
+        """Reset the percentile window (load sweeps measure each offered
+        load against a fresh window)."""
+        with self._lat_lock:
+            self._latencies.clear()
+
+    def depth(self) -> int:
+        with self._cv:
+            return len(self._q)
+
+    def close(self, drain: bool = True) -> None:
+        """Stop the worker; with ``drain`` pending requests are scored
+        first, otherwise they fail."""
+        with self._cv:
+            self._closed = True
+            if not drain:
+                pending = list(self._q)
+                self._q.clear()
+                for r in pending:
+                    if r.future.set_running_or_notify_cancel():
+                        r.future.set_exception(
+                            RuntimeError("server shutting down"))
+            self._cv.notify_all()
+        self._worker.join(timeout=30)

@@ -1,0 +1,156 @@
+"""Model registry: named+versioned online models with warmup and hot swap.
+
+Configuration surface (all in the one ``serve.properties`` the CLI loads;
+see resource/serving/ for a complete runbook):
+
+    serve.models=churn,segments            # models to load at startup
+    serve.model.<name>.kind=naiveBayes|markovClassifier|decisionTree|nearestNeighbor
+    serve.model.<name>.version=1           # optional, default "1"
+    serve.model.<name>.conf=<job.properties>   # the model's OWN job config
+    serve.model.<name>.<key>=<value>       # inline overrides of that config
+
+A model's scoring config is exactly the properties file its batch
+predictor job runs with (``bp.properties``, the Markov classifier's
+config, ...), so one artifact + one config serves both the batch and the
+online path.  Inline ``serve.model.<name>.*`` keys overlay the file —
+e.g. pointing ``bayesian.model.file.path`` at a re-trained artifact
+before a ``reload``.
+
+Entries are keyed (name, version); ``get(name)`` resolves the latest
+loaded version.  ``reload`` builds a complete new adapter OFF-lock (model
+files re-read, tables re-uploaded, nothing serves half-loaded state) and
+swaps it in atomically; in-flight batches finish on the old adapter.
+``warmup`` pre-compiles every scorer at the configured power-of-two batch
+buckets so steady-state traffic triggers zero new XLA compilations
+(asserted via the ``Serve / Scorer compilations`` counter).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..core.config import JobConfig, parse_properties
+from ..core.metrics import Counters
+from .engine import (ADAPTER_KINDS, ModelAdapter, ScorerCompileCache,
+                     pow2_bucket, pow2_buckets)
+
+
+class ModelEntry:
+    __slots__ = ("name", "version", "kind", "adapter", "counters")
+
+    def __init__(self, name: str, version: str, kind: str,
+                 adapter: ModelAdapter, counters: Counters):
+        self.name = name
+        self.version = version
+        self.kind = kind
+        self.adapter = adapter
+        self.counters = counters
+
+
+class ModelRegistry:
+    """Loads/holds the online models; thread-safe lookup + hot swap."""
+
+    def __init__(self, config: JobConfig, mesh=None):
+        self.config = config
+        self.mesh = mesh
+        self.max_batch = config.get_int("serve.batch.max.size", 64)
+        buckets = config.get("serve.warmup.buckets")
+        self.warmup_buckets = (
+            sorted({pow2_bucket(int(v)) for v in buckets.split(",")})
+            if buckets else pow2_buckets(self.max_batch))
+        self._lock = threading.Lock()
+        self._entries: Dict[Tuple[str, str], ModelEntry] = {}
+        self._latest: Dict[str, str] = {}
+
+    # -- configuration -----------------------------------------------------
+    def model_names(self) -> List[str]:
+        names = self.config.get("serve.models")
+        if not names:
+            return []
+        return [n.strip() for n in names.split(",") if n.strip()]
+
+    def _model_config(self, name: str) -> JobConfig:
+        prefix = f"serve.model.{name}."
+        inline = {k[len(prefix):]: v for k, v in self.config.props.items()
+                  if k.startswith(prefix)}
+        props: Dict[str, str] = {}
+        conf_path = inline.pop("conf", None)
+        if conf_path:
+            with open(conf_path, "r") as fh:
+                props.update(parse_properties(fh.read()))
+        props.update(inline)
+        return JobConfig(props)
+
+    # -- loading / lookup --------------------------------------------------
+    def _build(self, name: str,
+               counters: Optional[Counters] = None) -> ModelEntry:
+        mconf = self._model_config(name)
+        kind = mconf.must(
+            "kind", f"missing serve.model.{name}.kind")
+        cls = ADAPTER_KINDS.get(kind)
+        if cls is None:
+            raise ValueError(
+                f"unknown model kind {kind!r}; known: "
+                + ", ".join(sorted(ADAPTER_KINDS)))
+        version = mconf.get("version", "1")
+        counters = counters if counters is not None else Counters()
+        adapter = cls(mconf, counters,
+                      cache=ScorerCompileCache(counters),
+                      max_bucket=pow2_bucket(self.max_batch),
+                      mesh=self.mesh)
+        return ModelEntry(name, version, kind, adapter, counters)
+
+    def load(self, name: str, warmup: bool = False,
+             counters: Optional[Counters] = None) -> ModelEntry:
+        entry = self._build(name, counters)       # slow part, off-lock
+        if warmup:
+            self._warm(entry)
+        with self._lock:
+            self._entries[(name, entry.version)] = entry
+            self._latest[name] = entry.version
+        return entry
+
+    def load_all(self, warmup: bool = False) -> List[ModelEntry]:
+        return [self.load(n, warmup=warmup) for n in self.model_names()]
+
+    def reload(self, name: str) -> ModelEntry:
+        """Hot swap: rebuild from the (possibly updated) artifact files and
+        atomically replace the served entry.  The model's Counters carry
+        over (cumulative requests/shed/compile history survives the swap;
+        'Reloads' counts every swap)."""
+        try:
+            counters = self.get(name).counters
+        except KeyError:
+            counters = None
+        entry = self.load(name, warmup=True, counters=counters)
+        entry.counters.incr("Serve", "Reloads")
+        return entry
+
+    def get(self, name: str, version: Optional[str] = None) -> ModelEntry:
+        with self._lock:
+            v = version or self._latest.get(name)
+            if v is None or (name, v) not in self._entries:
+                raise KeyError(
+                    f"model {name!r}"
+                    + (f" version {version!r}" if version else "")
+                    + " is not loaded")
+            return self._entries[(name, v)]
+
+    def entries(self) -> List[ModelEntry]:
+        with self._lock:
+            return [self._entries[(n, v)] for n, v in self._latest.items()]
+
+    # -- warmup ------------------------------------------------------------
+    def _warm(self, entry: ModelEntry) -> None:
+        for b in self.warmup_buckets:
+            entry.adapter.warm(b)
+        entry.counters.set("Serve", "Warmup buckets",
+                           len(self.warmup_buckets))
+
+    def warmup(self, name: Optional[str] = None) -> None:
+        """Pre-compile scorers at every configured bucket (all models, or
+        one)."""
+        targets = [self.get(name)] if name else self.entries()
+        for entry in targets:
+            self._warm(entry)

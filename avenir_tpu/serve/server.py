@@ -1,0 +1,278 @@
+"""JSON-lines prediction frontend + the ``python -m avenir_tpu serve`` CLI.
+
+Wire protocol (one JSON object per line, one JSON response line each, in
+request order per connection; concurrency comes from concurrent
+connections — the stdlib threading server gives each connection its own
+handler thread, which parks on the micro-batcher future):
+
+    {"model": "churn", "row": "C001,planA,1210,505,8,11,3,Y"}
+      -> {"model": "churn", "version": "1", "output": "C001,...,Y,87"}
+    {"model": "churn", "rows": ["...", "..."]}          # client-side batch
+      -> {"model": "churn", "version": "1", "outputs": ["...", "..."]}
+    {"cmd": "stats"}            -> per-model counters + latency percentiles
+    {"cmd": "health"}           -> {"ok": true, "models": [...]}
+    {"cmd": "reload", "model": "churn"}   -> hot swap from updated artifacts
+
+Error responses carry {"error": "..."} (plus {"shed": true} when admission
+control rejected the request) and never tear down the connection.
+
+Config surface (serve.properties): ``serve.host`` (default 127.0.0.1),
+``serve.port`` (default 8650; 0 picks an ephemeral port, printed on
+stderr), ``serve.batch.max.size``, ``serve.batch.max.delay.ms``,
+``serve.queue.max.depth``, ``serve.request.timeout.sec``, plus the
+registry's ``serve.models`` / ``serve.model.<name>.*`` surface and
+``serve.warmup`` (default true) — see registry.py.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import sys
+import threading
+from typing import Dict, Optional
+
+from ..core.config import JobConfig, load_job_config, parse_cli_args
+from .batcher import MicroBatcher, ShedError
+from .registry import ModelEntry, ModelRegistry
+
+
+class PredictionServer:
+    """In-process serving stack: registry + per-model batchers + TCP
+    frontend.  Usable embedded (tests, bench) or via ``serve_main``."""
+
+    def __init__(self, config: JobConfig, mesh=None):
+        self.config = config
+        self.registry = ModelRegistry(config, mesh=mesh)
+        self.timeout = config.get_float("serve.request.timeout.sec", 30.0)
+        self._batch_kw = dict(
+            max_batch=config.get_int("serve.batch.max.size", 64),
+            max_delay_ms=config.get_float("serve.batch.max.delay.ms", 2.0),
+            max_queue_depth=config.get_int("serve.queue.max.depth", 256))
+        self._batchers: Dict[str, MicroBatcher] = {}
+        self._lock = threading.Lock()
+        self._tcp: Optional[socketserver.ThreadingTCPServer] = None
+        self._tcp_thread: Optional[threading.Thread] = None
+        warm = config.get_boolean("serve.warmup", True)
+        for entry in self.registry.load_all(warmup=warm):
+            self._attach(entry)
+
+    # -- model plumbing ----------------------------------------------------
+    def _attach(self, entry: ModelEntry) -> None:
+        """(Re)wire a model's batcher to the given entry's adapter."""
+        with self._lock:
+            old = self._batchers.get(entry.name)
+            self._batchers[entry.name] = MicroBatcher(
+                entry.name, entry.adapter.predict_lines, entry.counters,
+                **self._batch_kw)
+        if old is not None:
+            old.close(drain=True)
+
+    def batcher(self, name: str) -> MicroBatcher:
+        with self._lock:
+            b = self._batchers.get(name)
+        if b is None:
+            raise KeyError(f"model {name!r} is not loaded")
+        return b
+
+    def _default_model(self) -> str:
+        names = self.registry.model_names()
+        if len(names) == 1:
+            return names[0]
+        raise KeyError(
+            "request must name a model (\"model\": ...) when more than one "
+            "is served")
+
+    # -- request handling --------------------------------------------------
+    def handle_line(self, line: str) -> dict:
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError as e:
+            return {"error": f"bad request JSON: {e}"}
+        if not isinstance(obj, dict):
+            return {"error": "request must be a JSON object"}
+        cmd = obj.get("cmd")
+        try:
+            if cmd == "stats":
+                return self._stats()
+            if cmd == "health":
+                return {"ok": True,
+                        "models": [{"name": e.name, "version": e.version,
+                                    "kind": e.kind}
+                                   for e in self.registry.entries()]}
+            if cmd == "reload":
+                entry = self.registry.reload(
+                    obj.get("model") or self._default_model())
+                self._attach(entry)
+                return {"ok": True, "model": entry.name,
+                        "version": entry.version}
+            if cmd is not None:
+                return {"error": f"unknown cmd {cmd!r}"}
+            return self._predict(obj)
+        except (KeyError, ValueError) as e:
+            return {"error": str(e)}
+        except Exception as e:                      # noqa: BLE001
+            # a failed reload (missing artifact), a batcher racing a hot
+            # swap, ... — the connection must survive every request error
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    def _predict(self, obj: dict) -> dict:
+        name = obj.get("model") or self._default_model()
+        entry = self.registry.get(name, obj.get("version"))
+        batcher = self.batcher(name)
+        rows = obj.get("rows")
+        single = rows is None
+        if single:
+            row = obj.get("row")
+            if not isinstance(row, str):
+                return {"error": 'request needs "row" (string) or '
+                                 '"rows" (list of strings)'}
+            rows = [row]
+        elif (not isinstance(rows, list)
+              or not all(isinstance(r, str) for r in rows)):
+            # validate BEFORE submitting: one malformed entry must not
+            # poison a shared micro-batch with other clients' requests
+            return {"error": '"rows" must be a list of strings'}
+        futures, shed = [], 0
+        for row in rows:
+            try:
+                futures.append(batcher.submit(row))
+            except ShedError:
+                futures.append(None)
+                shed += 1
+            except RuntimeError:
+                # the batcher was closed by a concurrent hot-swap reload;
+                # re-fetch the freshly attached one and retry once
+                batcher = self.batcher(name)
+                futures.append(batcher.submit(row))
+        outputs, errors = [], 0
+        for f in futures:
+            if f is None:
+                outputs.append(None)
+                continue
+            try:
+                outputs.append(f.result(timeout=self.timeout))
+            except Exception as e:                  # noqa: BLE001
+                outputs.append(None)
+                errors += 1
+                last_err = str(e)
+        resp: dict = {"model": entry.name, "version": entry.version}
+        if single:
+            if shed:
+                return {"model": entry.name, "version": entry.version,
+                        "error": "request shed: queue at "
+                                 "serve.queue.max.depth", "shed": True}
+            if outputs[0] is None:
+                return {"model": entry.name, "version": entry.version,
+                        "error": last_err}
+            resp["output"] = outputs[0]
+            return resp
+        resp["outputs"] = outputs
+        if shed:
+            resp["shed"] = shed
+        if errors:
+            resp["errors"] = errors
+        return resp
+
+    def _stats(self) -> dict:
+        models = {}
+        for entry in self.registry.entries():
+            b = self._batchers.get(entry.name)
+            models[entry.name] = {
+                "version": entry.version,
+                "kind": entry.kind,
+                "counters": entry.counters.as_dict(),
+                "latency_ms": (b.latency_percentiles_ms() if b else None),
+                "batch_fill_ratio": (round(b.fill_ratio(), 4)
+                                     if b and b.fill_ratio() is not None
+                                     else None),
+                "queue_depth": b.depth() if b else 0,
+            }
+        return {"models": models}
+
+    # -- TCP frontend ------------------------------------------------------
+    def start(self) -> int:
+        """Bind + serve in a daemon thread; returns the bound port."""
+        host = self.config.get("serve.host", "127.0.0.1")
+        port = self.config.get_int("serve.port", 8650)
+        app = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                for raw in self.rfile:
+                    line = raw.decode("utf-8", errors="replace").strip()
+                    if not line:
+                        continue
+                    resp = app.handle_line(line)
+                    try:
+                        self.wfile.write(
+                            (json.dumps(resp) + "\n").encode())
+                        self.wfile.flush()
+                    except (BrokenPipeError, ConnectionResetError):
+                        return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._tcp = Server((host, port), Handler)
+        self.port = self._tcp.server_address[1]
+        self._tcp_thread = threading.Thread(
+            target=self._tcp.serve_forever, name="serve-frontend",
+            daemon=True)
+        self._tcp_thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._tcp is not None:
+            self._tcp.shutdown()
+            self._tcp.server_close()
+            self._tcp = None
+        with self._lock:
+            batchers = list(self._batchers.values())
+            self._batchers.clear()
+        for b in batchers:
+            b.close(drain=False)
+
+
+def request(host: str, port: int, obj: dict, timeout: float = 30.0) -> dict:
+    """One-shot client helper: send one JSON request line, read one
+    response line (used by tests, the bench, and the runbook client)."""
+    with socket.create_connection((host, port), timeout=timeout) as sock:
+        sock.sendall((json.dumps(obj) + "\n").encode())
+        buf = b""
+        while not buf.endswith(b"\n"):
+            chunk = sock.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    return json.loads(buf.decode())
+
+
+def serve_main(argv) -> int:
+    """``python -m avenir_tpu serve -Dconf.path=serve.properties``."""
+    defines, positional = parse_cli_args(list(argv))
+    if positional and positional[0] in ("-h", "--help"):
+        print("usage: python -m avenir_tpu serve -Dconf.path=<serve."
+              "properties> [-Dserve.port=N ...]", file=sys.stderr)
+        return 2
+    config = load_job_config(defines)
+    if not config.get("serve.models"):
+        print("serve: no models configured (serve.models=...)",
+              file=sys.stderr)
+        return 2
+    server = PredictionServer(config)
+    port = server.start()
+    names = ", ".join(
+        f"{e.name}:{e.version}({e.kind})" for e in server.registry.entries())
+    print(f"serving {names} on "
+          f"{config.get('serve.host', '127.0.0.1')}:{port}", file=sys.stderr,
+          flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0

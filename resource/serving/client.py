@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""JSON-lines client for the serving runbook: waits for the server's
+"serving ... on host:port" banner, fires concurrent single-row requests
+(so the micro-batcher actually coalesces), then prints the stats surface.
+
+Usage: client.py <server.log> <test.csv>
+"""
+
+import json
+import re
+import socket
+import sys
+import threading
+import time
+
+
+def wait_for_port(log_path: str, timeout: float = 60.0):
+    deadline = time.time() + timeout
+    pat = re.compile(r"serving .* on ([\w.]+):(\d+)")
+    while time.time() < deadline:
+        try:
+            m = pat.search(open(log_path).read())
+        except OSError:
+            m = None
+        if m:
+            return m.group(1), int(m.group(2))
+        time.sleep(0.2)
+    raise SystemExit(f"server did not come up (see {log_path})")
+
+
+def request(host, port, obj):
+    with socket.create_connection((host, port), timeout=30) as sock:
+        sock.sendall((json.dumps(obj) + "\n").encode())
+        buf = b""
+        while not buf.endswith(b"\n"):
+            chunk = sock.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    return json.loads(buf.decode())
+
+
+def main():
+    log_path, test_path = sys.argv[1], sys.argv[2]
+    host, port = wait_for_port(log_path)
+    rows = [l for l in open(test_path).read().splitlines() if l][:64]
+
+    health = request(host, port, {"cmd": "health"})
+    print("health:", json.dumps(health))
+
+    results = [None] * len(rows)
+
+    def go(i):
+        results[i] = request(host, port, {"model": "churn", "row": rows[i]})
+
+    threads = [threading.Thread(target=go, args=(i,)) for i in range(len(rows))]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+
+    errors = [r for r in results if r is None or "error" in r]
+    if errors:
+        raise SystemExit(f"{len(errors)} failed responses, e.g. {errors[0]}")
+    print(f"scored {len(rows)} concurrent rows in {dt * 1000:.0f} ms")
+    print("first responses:")
+    for r in results[:3]:
+        print(" ", r["output"])
+
+    stats = request(host, port, {"cmd": "stats"})["models"]["churn"]
+    serve = stats["counters"]["Serve"]
+    print(f"requests={serve['Requests']} batches={serve['Batches']} "
+          f"(coalesced), shed={serve.get('Shed', 0)}, "
+          f"fill={stats['batch_fill_ratio']}, "
+          f"latency_ms={stats['latency_ms']}")
+    assert serve["Batches"] < serve["Requests"], "batcher did not coalesce"
+
+
+if __name__ == "__main__":
+    main()

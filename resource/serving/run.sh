@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Online churn scoring: train a Naive Bayes artifact, serve it through the
+# micro-batching prediction server, query it with concurrent clients.
+# (Serving counterpart of the resource/churn_nb batch runbook.)
+set -euo pipefail
+cd "$(dirname "$0")"
+PY=${PYTHON:-python}
+rm -rf work && mkdir -p work/train work/test
+
+$PY -m avenir_tpu.datagen telecom_churn 3000 --seed 29 --out work/all.csv
+head -n 2400 work/all.csv > work/train/part-00000
+tail -n 600  work/all.csv > work/test/part-00000
+
+# 1. train the artifact (identical to the batch pipeline)
+$PY -m avenir_tpu BayesianDistribution -Dconf.path=nb.properties work/train work/model
+
+# 2. serve it: ephemeral port, banner + counters on stderr -> work/server.log
+$PY -m avenir_tpu serve -Dconf.path=serve.properties -Dserve.port=0 \
+    2> work/server.log &
+SERVER_PID=$!
+trap 'kill $SERVER_PID 2>/dev/null || true' EXIT
+
+# 3. concurrent single-row clients: byte-identical to batch predictions,
+#    coalesced by the micro-batcher; prints the stats surface
+$PY client.py work/server.log work/test/part-00000

@@ -1,0 +1,574 @@
+"""Online serving subsystem (avenir_tpu.serve): artifact round-trips
+(train -> write -> serve load -> predict parity vs the batch predictor),
+end-to-end micro-batching through the JSON-lines frontend (coalescing,
+admission control), warmup/bucketing compile accounting, hot-swap reload,
+and the thread-safety hammer for the shared bounded caches."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core import JobConfig
+from avenir_tpu.core.io import write_output
+from avenir_tpu.datagen import gen_state_sequences, gen_telecom_churn
+from avenir_tpu.models.bayesian import BayesianDistribution, BayesianPredictor
+from avenir_tpu.models.knn import NearestNeighbor, SameTypeSimilarity
+from avenir_tpu.models.markov import (MarkovModelClassifier,
+                                      MarkovStateTransitionModel)
+from avenir_tpu.models.tree import DecisionTreeBuilder
+from avenir_tpu.serve import MicroBatcher, PredictionServer, ShedError
+from avenir_tpu.serve.engine import SERVE_GROUP, pow2_bucket, pow2_buckets
+from avenir_tpu.serve.server import request
+
+# serving pins table extents at load time, so the schema declares every
+# feature extent (cardinality + [min, max]) — see engine._require_declared_schema
+CHURN_SCHEMA = {"fields": [
+    {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+    {"name": "plan", "ordinal": 1, "dataType": "categorical",
+     "feature": True, "cardinality": ["planA", "planB"]},
+    {"name": "minUsed", "ordinal": 2, "dataType": "int", "feature": True,
+     "min": 0, "max": 2200, "bucketWidth": 200},
+    {"name": "dataUsed", "ordinal": 3, "dataType": "int", "feature": True,
+     "min": 0, "max": 1000, "bucketWidth": 100},
+    {"name": "csCall", "ordinal": 4, "dataType": "int", "feature": True,
+     "min": 0, "max": 14, "bucketWidth": 2},
+    {"name": "csEmail", "ordinal": 5, "dataType": "int", "feature": True,
+     "min": 0, "max": 22, "bucketWidth": 4},
+    {"name": "network", "ordinal": 6, "dataType": "int", "feature": True},
+    {"name": "churned", "ordinal": 7, "dataType": "categorical",
+     "cardinality": ["N", "Y"]},
+]}
+
+MARKOV_STATES = ["LL", "LM", "LH", "ML", "MM", "MH", "HL", "HM", "HH"]
+
+
+def _chain(diag):
+    S = len(MARKOV_STATES)
+    T = np.full((S, S), (1 - diag) / (S - 1))
+    np.fill_diagonal(T, diag)
+    return T
+
+
+@pytest.fixture(scope="module")
+def artifacts(tmp_path_factory):
+    """Train every model family once; also run the batch predictors so
+    parity tests can compare byte-for-byte."""
+    tmp = tmp_path_factory.mktemp("serve_artifacts")
+    art = {"dir": tmp}
+
+    # -- Naive Bayes -------------------------------------------------------
+    schema_path = tmp / "churn_schema.json"
+    schema_path.write_text(json.dumps(CHURN_SCHEMA))
+    rows = gen_telecom_churn(800, seed=3)
+    train, test = rows[:600], rows[600:]
+    write_output(str(tmp / "nb_train"), [",".join(r) for r in train])
+    write_output(str(tmp / "nb_test"), [",".join(r) for r in test])
+    BayesianDistribution(JobConfig(
+        {"feature.schema.file.path": str(schema_path)})).run(
+        str(tmp / "nb_train"), str(tmp / "nb_model"))
+    bp_props = {"feature.schema.file.path": str(schema_path),
+                "bayesian.model.file.path": str(tmp / "nb_model")}
+    BayesianPredictor(JobConfig(dict(bp_props))).run(
+        str(tmp / "nb_test"), str(tmp / "nb_pred"))
+    art["nb_props"] = bp_props
+    art["nb_test_lines"] = [",".join(r) for r in test]
+    art["nb_batch_lines"] = (
+        tmp / "nb_pred" / "part-r-00000").read_text().splitlines()
+
+    # -- Markov classifier -------------------------------------------------
+    seqs = gen_state_sequences(
+        300, MARKOV_STATES, {"L": _chain(0.6), "C": _chain(0.15)},
+        seq_len=(15, 40), seed=9)
+    mtrain, mtest = seqs[:200], seqs[200:]
+    write_output(str(tmp / "mk_train"), [",".join(r) for r in mtrain])
+    write_output(str(tmp / "mk_test"), [",".join(r) for r in mtest])
+    MarkovStateTransitionModel(JobConfig({
+        "model.states": ",".join(MARKOV_STATES),
+        "class.label.field.ord": "1", "skip.field.count": "1",
+        "trans.prob.scale": "1000"})).run(
+        str(tmp / "mk_train"), str(tmp / "mk_model"))
+    mk_props = {"mm.model.path": str(tmp / "mk_model"),
+                "class.label.based.model": "true", "class.labels": "L,C",
+                "validation.mode": "true", "class.label.field.ord": "1",
+                "skip.field.count": "1"}
+    MarkovModelClassifier(JobConfig(dict(mk_props))).run(
+        str(tmp / "mk_test"), str(tmp / "mk_pred"))
+    art["mk_props"] = mk_props
+    art["mk_test_lines"] = [",".join(r) for r in mtest]
+    art["mk_batch_lines"] = (
+        tmp / "mk_pred" / "part-r-00000").read_text().splitlines()
+
+    # -- decision tree -----------------------------------------------------
+    tree_schema = tmp / "tree_schema.json"
+    tree_schema.write_text(json.dumps({"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "color", "ordinal": 1, "dataType": "categorical",
+         "feature": True, "cardinality": ["red", "green", "blue"],
+         "maxSplit": 2},
+        {"name": "size", "ordinal": 2, "dataType": "int", "feature": True,
+         "min": 0, "max": 100, "bucketWidth": 25, "splitScanInterval": 25,
+         "maxSplit": 3},
+        {"name": "label", "ordinal": 3, "dataType": "categorical",
+         "cardinality": ["N", "Y"]}]}))
+    rng = np.random.default_rng(7)
+    trows = []
+    for i in range(160):
+        color = str(rng.choice(["red", "green", "blue"]))
+        size = int(rng.integers(0, 100))
+        p = 0.15 + 0.5 * (size > 50) + 0.25 * (color == "red")
+        trows.append([f"R{i}", color, str(size),
+                      "Y" if rng.random() < p else "N"])
+    write_output(str(tmp / "tr_in"), [",".join(r) for r in trows])
+    DecisionTreeBuilder(JobConfig({
+        "feature.schema.file.path": str(tree_schema),
+        "decision.file.path": str(tmp / "decpath.json"),
+        "split.algorithm": "entropy", "path.stopping.strategy": "maxDepth",
+        "max.depth.limit": "2", "sub.sampling.strategy": "none",
+        "seed": "11"})).run_loop(str(tmp / "tr_in"), str(tmp / "tr_work"),
+                                 max_levels=4)
+    art["tree_schema"] = str(tree_schema)
+    art["tree_decfile"] = str(tmp / "decpath.json")
+    art["tree_rows"] = trows
+
+    # -- kNN ---------------------------------------------------------------
+    knn_schema = tmp / "knn_schema.json"
+    knn_schema.write_text(json.dumps({"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "a", "ordinal": 1, "dataType": "double", "feature": True,
+         "min": 0, "max": 10},
+        {"name": "b", "ordinal": 2, "dataType": "double", "feature": True,
+         "min": 0, "max": 10},
+        {"name": "cls", "ordinal": 3, "dataType": "categorical",
+         "cardinality": ["N", "Y"]}]}))
+    kr = []
+    for i in range(120):
+        y = i % 2
+        a = float(np.clip(rng.normal(3 + 4 * y, 1.0), 0, 10))
+        b = float(np.clip(rng.normal(7 - 4 * y, 1.0), 0, 10))
+        kr.append([f"K{i}", f"{a:.3f}", f"{b:.3f}", "Y" if y else "N"])
+    ktrain, ktest = kr[:90], kr[90:]
+    os.makedirs(tmp / "knn_in")
+    (tmp / "knn_in" / "tr-part").write_text(
+        "\n".join(",".join(r) for r in ktrain) + "\n")
+    (tmp / "knn_in" / "te-part").write_text(
+        "\n".join(",".join(r) for r in ktest) + "\n")
+    (tmp / "knn_train.csv").write_text(
+        "\n".join(",".join(r) for r in ktrain) + "\n")
+    SameTypeSimilarity(JobConfig({
+        "feature.schema.file.path": str(knn_schema),
+        "output.top.matches": "5"})).run(
+        str(tmp / "knn_in"), str(tmp / "knn_sim"))
+    knn_props = {"feature.schema.file.path": str(knn_schema),
+                 "top.match.count": "5", "kernel.function": "none",
+                 "validation.mode": "true"}
+    NearestNeighbor(JobConfig(dict(knn_props))).run(
+        str(tmp / "knn_sim"), str(tmp / "knn_pred"))
+    art["knn_props"] = knn_props
+    art["knn_train_path"] = str(tmp / "knn_train.csv")
+    art["knn_test_lines"] = [",".join(r) for r in ktest]
+    art["knn_batch_by_id"] = {
+        l.split(",")[0]: l for l in
+        (tmp / "knn_pred" / "part-r-00000").read_text().splitlines()}
+    return art
+
+
+def _serve_config(art, **overrides):
+    props = {
+        "serve.models": "churn,seg,paths,neighbors",
+        "serve.model.churn.kind": "naiveBayes",
+        "serve.model.seg.kind": "markovClassifier",
+        "serve.model.paths.kind": "decisionTree",
+        "serve.model.paths.feature.schema.file.path": art["tree_schema"],
+        "serve.model.paths.decision.file.path": art["tree_decfile"],
+        "serve.model.neighbors.kind": "nearestNeighbor",
+        "serve.model.neighbors.train.data.path": art["knn_train_path"],
+        "serve.batch.max.size": "16",
+        "serve.batch.max.delay.ms": "5",
+        "serve.queue.max.depth": "256",
+        "serve.port": "0",
+    }
+    for k, v in art["nb_props"].items():
+        props[f"serve.model.churn.{k}"] = v
+    for k, v in art["mk_props"].items():
+        props[f"serve.model.seg.{k}"] = v
+    for k, v in art["knn_props"].items():
+        props[f"serve.model.neighbors.{k}"] = v
+    props.update({k: str(v) for k, v in overrides.items()})
+    return JobConfig(props)
+
+
+@pytest.fixture(scope="module")
+def server(artifacts):
+    srv = PredictionServer(_serve_config(artifacts))
+    port = srv.start()
+    yield srv, port
+    srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# artifact round-trips: train -> write -> serve load -> predict parity
+# ---------------------------------------------------------------------------
+
+def test_nb_roundtrip_parity(server, artifacts):
+    srv, port = server
+    resp = request("127.0.0.1", port, {
+        "model": "churn", "rows": artifacts["nb_test_lines"]})
+    assert resp["outputs"] == artifacts["nb_batch_lines"]
+
+
+def test_markov_roundtrip_parity(server, artifacts):
+    srv, port = server
+    resp = request("127.0.0.1", port, {
+        "model": "seg", "rows": artifacts["mk_test_lines"]})
+    assert resp["outputs"] == artifacts["mk_batch_lines"]
+
+
+def test_knn_roundtrip_parity(server, artifacts):
+    srv, port = server
+    resp = request("127.0.0.1", port, {
+        "model": "neighbors", "rows": artifacts["knn_test_lines"]})
+    by_id = artifacts["knn_batch_by_id"]
+    for line, out in zip(artifacts["knn_test_lines"], resp["outputs"]):
+        assert out == by_id[line.split(",")[0]]
+
+
+def test_tree_paths_route_and_coalescing_invariance(server, artifacts):
+    """Every training row routes to a leaf, and per-row responses equal
+    the batched evaluation (micro-batch composition cannot change a
+    routing decision)."""
+    srv, port = server
+    rows = [",".join(r) for r in artifacts["tree_rows"][:24]]
+    batched = request("127.0.0.1", port,
+                      {"model": "paths", "rows": rows})["outputs"]
+    assert all(o is not None for o in batched)
+    assert all(o.split(",")[0] == r.split(",")[0]
+               for o, r in zip(batched, rows))
+    for i in (0, 7, 13):
+        single = request("127.0.0.1", port,
+                         {"model": "paths", "row": rows[i]})
+        assert single["output"] == batched[i]
+
+
+# ---------------------------------------------------------------------------
+# acceptance: end-to-end concurrent serving, coalescing, shedding
+# ---------------------------------------------------------------------------
+
+def test_e2e_concurrent_requests_parity_and_coalescing(artifacts):
+    """Concurrent single-row requests through the TCP frontend must (a)
+    return byte-identical lines to the batch predictor, (b) coalesce
+    (batches counter < requests counter)."""
+    cfg = _serve_config(artifacts, **{
+        "serve.models": "churn",
+        "serve.batch.max.size": "16",
+        "serve.batch.max.delay.ms": "60",   # wide window forces coalescing
+    })
+    srv = PredictionServer(cfg)
+    port = srv.start()
+    try:
+        n = 40
+        results = [None] * n
+        lines = artifacts["nb_test_lines"]
+
+        def go(i):
+            results[i] = request("127.0.0.1", port,
+                                 {"model": "churn", "row": lines[i]})
+
+        threads = [threading.Thread(target=go, args=(i,)) for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i in range(n):
+            assert results[i].get("output") == artifacts["nb_batch_lines"][i]
+        c = srv.registry.get("churn").counters
+        assert c.get(SERVE_GROUP, "Requests") == n
+        assert 0 < c.get(SERVE_GROUP, "Batches") < n
+    finally:
+        srv.stop()
+
+
+def test_e2e_burst_past_queue_depth_sheds(artifacts):
+    """A burst past serve.queue.max.depth is shed (counter + {"shed":
+    true} responses) instead of crashing; the server keeps serving.
+    The model's scorer is slowed (as a heavy model under load would be)
+    so the queue deterministically backs up past the depth limit."""
+    cfg = _serve_config(artifacts, **{
+        "serve.models": "churn",
+        "serve.batch.max.size": "2",
+        "serve.batch.max.delay.ms": "5",
+        "serve.queue.max.depth": "4",
+    })
+    srv = PredictionServer(cfg)
+    port = srv.start()
+    try:
+        batcher = srv.batcher("churn")
+        real_predict = batcher.predict_fn
+
+        def heavy_predict(lines):
+            time.sleep(0.08)
+            return real_predict(lines)
+
+        batcher.predict_fn = heavy_predict
+        n = 48
+        results = [None] * n
+        line = artifacts["nb_test_lines"][0]
+
+        def go(i):
+            results[i] = request("127.0.0.1", port,
+                                 {"model": "churn", "row": line})
+
+        threads = [threading.Thread(target=go, args=(i,)) for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        shed_resp = [r for r in results if r.get("shed")]
+        ok_resp = [r for r in results
+                   if r.get("output") == artifacts["nb_batch_lines"][0]]
+        assert len(shed_resp) + len(ok_resp) == n     # nothing crashed
+        c = srv.registry.get("churn").counters
+        assert c.get(SERVE_GROUP, "Shed") == len(shed_resp) > 0
+        # server still healthy after the burst
+        batcher.predict_fn = real_predict
+        after = request("127.0.0.1", port, {"model": "churn", "row": line})
+        assert after.get("output") == artifacts["nb_batch_lines"][0]
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# warmup + bucketing: zero new compilations in steady state
+# ---------------------------------------------------------------------------
+
+def test_warmup_then_mixed_sizes_zero_new_compiles(server, artifacts):
+    """After warmup, serving a mix of request sizes must trigger zero new
+    scorer compilations (every padded bucket was pre-compiled)."""
+    srv, port = server
+    for name, lines in (("churn", artifacts["nb_test_lines"]),
+                        ("seg", artifacts["mk_test_lines"])):
+        c = srv.registry.get(name).counters
+        assert c.get(SERVE_GROUP, "Warmup buckets") > 0
+        before = c.get(SERVE_GROUP, "Scorer compilations")
+        assert before > 0
+        for size in (1, 2, 3, 5, 8, 13, 16):
+            resp = request("127.0.0.1", port,
+                           {"model": name, "rows": lines[:size]})
+            assert all(o is not None for o in resp["outputs"])
+        assert c.get(SERVE_GROUP, "Scorer compilations") == before
+        assert c.get(SERVE_GROUP, "Scorer cache hits") > 0
+
+
+def test_bucket_helpers():
+    assert [pow2_bucket(n) for n in (1, 2, 3, 5, 9, 64)] == \
+        [1, 2, 4, 8, 16, 64]
+    assert pow2_bucket(100, cap=64) == 64
+    assert pow2_buckets(16) == [1, 2, 4, 8, 16]
+    assert pow2_buckets(12) == [1, 2, 4, 8, 16]
+
+
+# ---------------------------------------------------------------------------
+# registry: versioning, hot swap, validation
+# ---------------------------------------------------------------------------
+
+def test_registry_versioned_lookup_and_reload(server, artifacts):
+    srv, port = server
+    entry = srv.registry.get("churn")
+    assert (entry.name, entry.version) == ("churn", "1")
+    assert srv.registry.get("churn", "1") is entry
+    with pytest.raises(KeyError):
+        srv.registry.get("churn", "99")
+    with pytest.raises(KeyError):
+        srv.registry.get("nope")
+
+    old_adapter = entry.adapter
+    requests_before = entry.counters.get(SERVE_GROUP, "Requests")
+    resp = request("127.0.0.1", port, {"cmd": "reload", "model": "churn"})
+    assert resp.get("ok") is True
+    new_entry = srv.registry.get("churn")
+    assert new_entry.adapter is not old_adapter      # hot-swapped
+    # counters carry over the swap: cumulative history + reload count
+    assert new_entry.counters.get(SERVE_GROUP, "Reloads") == 1
+    assert new_entry.counters.get(SERVE_GROUP, "Requests") \
+        >= requests_before > 0
+    # swapped model still serves byte-identical responses
+    out = request("127.0.0.1", port, {
+        "model": "churn", "row": artifacts["nb_test_lines"][0]})
+    assert out["output"] == artifacts["nb_batch_lines"][0]
+
+
+def test_registry_rejects_undeclared_schema(artifacts, tmp_path):
+    sparse = {"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "plan", "ordinal": 1, "dataType": "categorical",
+         "feature": True},                       # no cardinality
+        {"name": "cls", "ordinal": 2, "dataType": "categorical",
+         "cardinality": ["N", "Y"]}]}
+    sp = tmp_path / "sparse.json"
+    sp.write_text(json.dumps(sparse))
+    cfg = _serve_config(artifacts, **{
+        "serve.models": "churn",
+        "serve.model.churn.feature.schema.file.path": str(sp)})
+    with pytest.raises(ValueError, match="cardinality"):
+        PredictionServer(cfg)
+
+
+def test_stats_and_health_surface(server):
+    srv, port = server
+    health = request("127.0.0.1", port, {"cmd": "health"})
+    assert health["ok"] and len(health["models"]) == 4
+    stats = request("127.0.0.1", port, {"cmd": "stats"})
+    churn = stats["models"]["churn"]
+    assert churn["counters"][SERVE_GROUP]["Requests"] > 0
+    assert churn["latency_ms"]["n"] > 0
+    assert 0 < churn["batch_fill_ratio"] <= 1.0
+
+
+def test_per_row_errors_do_not_fail_batch(server, artifacts):
+    srv, port = server
+    good = artifacts["nb_test_lines"][0]
+    resp = request("127.0.0.1", port, {
+        "model": "churn",
+        "rows": [good, "C1,planA,999999,5,5,5,1,N", good]})
+    assert resp["outputs"][0] == artifacts["nb_batch_lines"][0]
+    assert resp["outputs"][1] is None        # out of declared range
+    assert resp["outputs"][2] == artifacts["nb_batch_lines"][0]
+    bad_sym = request("127.0.0.1", port,
+                      {"model": "seg", "row": "E9,L,XX,YY"})
+    assert "error" in bad_sym
+
+
+def test_malformed_requests_get_error_responses(server, artifacts):
+    """Protocol abuse returns {"error": ...} without tearing down the
+    connection or poisoning other clients' micro-batches."""
+    srv, port = server
+    for bad in ("not json at all",
+                json.dumps([1, 2, 3]),
+                json.dumps({"model": "churn", "rows": [123]}),
+                json.dumps({"model": "churn", "rows": "x"}),
+                json.dumps({"model": "churn", "row": 5}),
+                json.dumps({"cmd": "bogus"}),
+                json.dumps({"model": "nope", "row": "a,b"})):
+        import socket as _socket
+        with _socket.create_connection(("127.0.0.1", port), timeout=30) as s:
+            s.sendall((bad if isinstance(bad, str) else bad).encode()
+                      + b"\n")
+            buf = b""
+            while not buf.endswith(b"\n"):
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+        assert "error" in json.loads(buf.decode()), bad
+    # server still serves correct responses afterwards
+    out = request("127.0.0.1", port, {
+        "model": "churn", "row": artifacts["nb_test_lines"][0]})
+    assert out["output"] == artifacts["nb_batch_lines"][0]
+
+
+# ---------------------------------------------------------------------------
+# micro-batcher unit behavior
+# ---------------------------------------------------------------------------
+
+def test_batcher_coalesces_and_sheds_directly():
+    from avenir_tpu.core.metrics import Counters
+
+    seen = []
+
+    def slow_predict(lines):
+        seen.append(len(lines))
+        time.sleep(0.05)
+        return [l.upper() for l in lines]
+
+    c = Counters()
+    b = MicroBatcher("t", slow_predict, c, max_batch=8, max_delay_ms=30,
+                     max_queue_depth=4)
+    try:
+        futures, shed = [], 0
+        for i in range(32):
+            try:
+                futures.append(b.submit(f"r{i}"))
+            except ShedError:
+                shed += 1
+        for f in futures:
+            assert f.result(timeout=10).startswith("R")
+        assert shed > 0 and c.get(SERVE_GROUP, "Shed") == shed
+        assert c.get(SERVE_GROUP, "Batches") < len(futures)
+        assert max(seen) > 1                     # actually coalesced
+        assert b.latency_percentiles_ms()["n"] == len(futures)
+    finally:
+        b.close()
+
+
+def test_batcher_close_drains():
+    from avenir_tpu.core.metrics import Counters
+
+    b = MicroBatcher("t", lambda ls: [l + "!" for l in ls], Counters(),
+                     max_batch=4, max_delay_ms=500, max_queue_depth=64)
+    fs = [b.submit(f"x{i}") for i in range(6)]
+    b.close(drain=True)
+    assert [f.result(timeout=5) for f in fs] == \
+        [f"x{i}!" for i in range(6)]
+
+
+# ---------------------------------------------------------------------------
+# bounded-cache thread-safety hammer (satellite: utils.caches lock)
+# ---------------------------------------------------------------------------
+
+def test_bounded_cache_concurrent_hammer():
+    from avenir_tpu.utils.caches import (bounded_cache_get,
+                                         bounded_cache_put)
+
+    cache: dict = {}
+    errors = []
+    CAP = 8
+
+    def hammer(seed):
+        rng = np.random.default_rng(seed)
+        try:
+            for _ in range(3000):
+                k = int(rng.integers(0, 32))
+                v = bounded_cache_get(cache, k)
+                if v is not None and v != k * 7:
+                    raise AssertionError(f"corrupt value for {k}: {v}")
+                bounded_cache_put(cache, k, k * 7, cap=CAP)
+        except BaseException as e:                # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(s,)) for s in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert len(cache) <= CAP
+    for k, v in cache.items():
+        assert v == k * 7
+
+
+# ---------------------------------------------------------------------------
+# unified run() driver surface (satellite: mesh kwarg everywhere)
+# ---------------------------------------------------------------------------
+
+def test_all_registered_jobs_accept_mesh_kwarg():
+    """Every registered batch driver accepts run(in, out, mesh=...) so the
+    CLI / orchestration layers can thread one mesh through any job."""
+    import importlib
+    import inspect
+
+    from avenir_tpu.cli import JOBS
+
+    missing = []
+    for fqcn, (modname, clsname, _) in JOBS.items():
+        cls = getattr(importlib.import_module(
+            f"avenir_tpu.models.{modname}"), clsname)
+        sig = inspect.signature(cls.run)
+        if "mesh" not in sig.parameters:
+            missing.append(fqcn)
+    # the streaming topology's run is a long-lived event loop with its own
+    # signature (topologyName, configFile), not a batch job
+    allowed = {"org.avenir.reinforce.ReinforcementLearnerTopology"}
+    assert set(missing) <= allowed, f"run() without mesh kwarg: {missing}"
