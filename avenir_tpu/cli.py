@@ -95,6 +95,29 @@ def register(fqcn: str, module: str, cls: str, prefix: str = "") -> None:
     JOBS[fqcn] = (module, cls, prefix)
 
 
+def extract_trace_flag(argv):
+    """Pull ``--trace <out.json>`` / ``--trace=<out.json>`` out of an arg
+    vector; returns (remaining argv, trace path or None)."""
+    out, trace_path, i = [], None, 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--trace":
+            if i + 1 >= len(argv):
+                raise SystemExit("--trace requires an output path")
+            trace_path = argv[i + 1]
+            i += 2
+            continue
+        if a.startswith("--trace="):
+            trace_path = a.partition("=")[2]
+            if not trace_path:
+                raise SystemExit("--trace requires an output path")
+            i += 1
+            continue
+        out.append(a)
+        i += 1
+    return out, trace_path
+
+
 def main(argv=None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
     if not argv:
@@ -118,6 +141,9 @@ def main(argv=None) -> int:
         avenir_tpu.enable_x64()
         from .serve.server import serve_main
         return serve_main(rest)
+    # --trace <out.json>: record core.obs spans for the whole job and
+    # export them as Chrome/Perfetto trace_event JSON on exit
+    rest, trace_path = extract_trace_flag(rest)
     # --profile-dir=<dir>: capture a jax.profiler trace of the whole job
     # (SURVEY §5 tracing rebuild note); view with TensorBoard or Perfetto
     profile_dir = None
@@ -150,14 +176,24 @@ def main(argv=None) -> int:
     avenir_tpu.enable_x64()
 
     config = load_job_config(defines, prefix)
+    from .core import obs
+    obs.configure_from_config(config, force_enable=bool(trace_path))
     job = _lazy(modname, clsname)(config)
-    if profile_dir:
-        import jax
-        with jax.profiler.trace(profile_dir):
+    try:
+        if profile_dir:
+            import jax
+            with jax.profiler.trace(profile_dir):
+                result = job.run(positional[0], positional[1])
+        else:
             result = job.run(positional[0], positional[1])
-    else:
-        result = job.run(positional[0], positional[1])
-
+    finally:
+        # export even when the job raises or is interrupted — a trace of
+        # the failing/slow run is the one the user most needs
+        if trace_path:
+            n = obs.get_tracer().export_chrome_trace(trace_path)
+            print(f"obs: wrote {n} trace events to {trace_path} "
+                  f"(open in chrome://tracing or ui.perfetto.dev)",
+                  file=sys.stderr)
     if isinstance(result, Counters):
         print(result.format(), file=sys.stderr)
         return 0

@@ -10,3 +10,4 @@ from .config import JobConfig, parse_properties, parse_cli_args, load_job_config
 from .io import read_lines, read_records, split_line, write_output, OutputWriter  # noqa: F401
 from .binning import DatasetEncoder, EncodedDataset, Vocab  # noqa: F401
 from .metrics import Counters, ConfusionMatrix, CostBasedArbitrator  # noqa: F401
+from .obs import LatencyHistogram, Metrics, Tracer, get_tracer, traced_run  # noqa: F401

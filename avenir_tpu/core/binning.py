@@ -346,8 +346,10 @@ class DatasetEncoder:
         declared-extent/negative-bin guards (see models.bayesian's
         streamed trainer)."""
         from .io import is_plain_delim
+        from .obs import get_tracer
         from .. import native
 
+        tracer = get_tracer()
         # the C path splits on a literal byte; a regex-metachar delimiter
         # must keep the serial path's regex semantics (encode_path gates
         # on the same predicate)
@@ -362,7 +364,8 @@ class DatasetEncoder:
         specs, n_cols, _ = sp
         id_ord = -1          # the training path never reads row ids;
         #                      skipping them drops the id-bytes copy pass
-        buf = native._read_buffer(path)
+        with tracer.span("ingest.read", path=path):
+            buf = native._read_buffer(path)
         row_ends = None
         if chunk_rows is not None:
             chunk_rows = max(int(chunk_rows), 1)
@@ -390,13 +393,14 @@ class DatasetEncoder:
                 n_hint = chunk.count(b"\n")
                 if not chunk.endswith(b"\n"):
                     n_hint += 1
-            res = native.encode_schema_buffer(
-                chunk, specs, n_cols, len(self.feature_fields),
-                self.class_field is not None, id_ordinal=id_ord,
-                delim=delim, n_rows_hint=n_hint)
-            if res is None:
-                raise ChunkedEncodeUnsupported("native encode failed")
-            n, x, values, y, _ = self._remap_native(res)
+            with tracer.span("ingest.parse", bytes=len(chunk)):
+                res = native.encode_schema_buffer(
+                    chunk, specs, n_cols, len(self.feature_fields),
+                    self.class_field is not None, id_ordinal=id_ord,
+                    delim=delim, n_rows_hint=n_hint)
+                if res is None:
+                    raise ChunkedEncodeUnsupported("native encode failed")
+                n, x, values, y, _ = self._remap_native(res)
             yield x, values, y, n
             pos = end
 

@@ -14,32 +14,46 @@ Also the validation arithmetic the reference keeps in util/:
 
 from __future__ import annotations
 
+import threading
 from collections import defaultdict
 from typing import Dict, Iterator, Tuple
 
 
 class Counters:
-    """Grouped named counters; the metrics dict every job returns."""
+    """Grouped named counters; the metrics dict every job returns.
+
+    Thread-safe: the serving subsystem shares one Counters between each
+    model's batcher worker and concurrent warmup/hot-swap reload threads,
+    so the read-modify-write in ``incr`` (and the defaultdict group
+    materialization underneath it) runs under a lock.  Readers snapshot
+    under the same lock; iteration never observes a torn update
+    (hammer-tested in tests/test_obs.py)."""
 
     def __init__(self):
         self._groups: Dict[str, Dict[str, int]] = defaultdict(lambda: defaultdict(int))
+        self._lock = threading.Lock()
 
     def incr(self, group: str, name: str, amount: int = 1) -> None:
-        self._groups[group][name] += int(amount)
+        with self._lock:
+            self._groups[group][name] += int(amount)
 
     def set(self, group: str, name: str, value: int) -> None:
-        self._groups[group][name] = int(value)
+        with self._lock:
+            self._groups[group][name] = int(value)
 
     def get(self, group: str, name: str) -> int:
-        return self._groups[group].get(name, 0)
+        with self._lock:
+            return self._groups[group].get(name, 0)
 
     def items(self) -> Iterator[Tuple[str, str, int]]:
-        for g in sorted(self._groups):
-            for n in sorted(self._groups[g]):
-                yield g, n, self._groups[g][n]
+        snap = self.as_dict()
+        for g in sorted(snap):
+            for n in sorted(snap[g]):
+                yield g, n, snap[g][n]
 
     def as_dict(self) -> Dict[str, Dict[str, int]]:
-        return {g: dict(names) for g, names in self._groups.items()}
+        with self._lock:
+            return {g: dict(names) for g, names in self._groups.items()}
 
     def format(self) -> str:
         return "\n".join(f"{g}\t{n}\t{v}" for g, n, v in self.items())

@@ -1,0 +1,557 @@
+"""Unified tracing + timing metrics: spans, histograms, exporters.
+
+The reference's only driver-visible metric channel is Hadoop counters
+(``core.metrics.Counters``) — integer-only, no notion of *where* a slow
+job spent its time.  This module adds the two missing representations,
+following the Clipper/INFaaS premise that per-stage latency visibility is
+the substrate batching and admission decisions ride on:
+
+- **Spans** (:class:`Tracer`): ``with tracer.span("stage", **attrs):``
+  produces nested, monotonic-clock span records with per-thread
+  parenting (an explicit ``parent=`` or :meth:`Tracer.adopt` carries
+  parentage across worker threads).  Finished records land in a bounded
+  in-memory ring buffer and export to JSON-lines or the Chrome/Perfetto
+  ``trace_event`` format (``--trace out.json`` on the CLI; open in
+  ``chrome://tracing`` or https://ui.perfetto.dev).
+- **Histograms** (:class:`LatencyHistogram`): fixed log-spaced bucket
+  boundaries (mergeable across instances/threads) with p50/p90/p95/p99
+  quantile estimation by log-linear interpolation inside the bucket.
+- **Registry** (:class:`Metrics`): counters + named histograms + gauges
+  behind one ``snapshot()`` — the job/serving stats surface.
+
+Pay-for-what-you-use: the module-level tracer starts DISABLED and
+``span()`` then returns a shared no-op context manager — a single
+attribute check on the hot path (bench.py ``obs_overhead_pct`` bounds the
+disabled-mode cost at < 2% of the NB and serving hot paths).
+
+Config surface (the .properties files every job loads):
+
+- ``obs.trace.enable``       — enable the global tracer (default false;
+  the CLI ``--trace <out.json>`` flag forces it on and exports on exit)
+- ``obs.trace.buffer.spans`` — ring-buffer capacity in records
+  (default 65536; oldest records drop first)
+- ``obs.histogram.buckets``  — log buckets across the 1µs..100s span
+  (default 96, i.e. 12/decade — ~21% worst-case quantile ratio error)
+"""
+
+from __future__ import annotations
+
+import bisect
+import functools
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from .metrics import Counters
+
+KEY_TRACE_ENABLE = "obs.trace.enable"
+KEY_TRACE_BUFFER = "obs.trace.buffer.spans"
+KEY_HIST_BUCKETS = "obs.histogram.buckets"
+
+DEFAULT_BUFFER_SPANS = 1 << 16
+DEFAULT_HIST_BUCKETS = 96
+HIST_LO_SEC = 1e-6            # smallest resolvable latency bucket edge
+HIST_HI_SEC = 100.0           # largest; beyond lands in the overflow bucket
+
+
+# ---------------------------------------------------------------------------
+# span records
+# ---------------------------------------------------------------------------
+
+class Span:
+    """One finished span: [t0_ns, t0_ns + dur_ns) on thread ``tid``."""
+
+    __slots__ = ("name", "span_id", "parent_id", "tid", "thread",
+                 "t0_ns", "dur_ns", "attrs")
+
+    def __init__(self, name, span_id, parent_id, tid, thread, t0_ns,
+                 dur_ns, attrs):
+        self.name = name
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.tid = tid
+        self.thread = thread
+        self.t0_ns = t0_ns
+        self.dur_ns = dur_ns
+        self.attrs = attrs
+
+    def overlaps(self, other: "Span") -> bool:
+        return (self.t0_ns < other.t0_ns + other.dur_ns
+                and other.t0_ns < self.t0_ns + self.dur_ns)
+
+    def __repr__(self):
+        return (f"Span({self.name!r}, id={self.span_id}, "
+                f"parent={self.parent_id}, dur_ns={self.dur_ns})")
+
+
+class Gauge:
+    """One gauge sample (a Chrome-trace counter event)."""
+
+    __slots__ = ("name", "tid", "t_ns", "value")
+
+    def __init__(self, name, tid, t_ns, value):
+        self.name = name
+        self.tid = tid
+        self.t_ns = t_ns
+        self.value = value
+
+
+class _NullSpan:
+    """The shared disabled-mode span: enter/exit do nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _SpanCtx:
+    """A live span context manager (enabled tracer only)."""
+
+    __slots__ = ("_tracer", "name", "attrs", "span_id", "parent_id", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str,
+                 parent: Optional[int], attrs: dict):
+        self._tracer = tracer
+        self.name = name
+        self.attrs = attrs
+        self.parent_id = parent
+        self.span_id = None
+        self._t0 = 0
+
+    def __enter__(self):
+        tr = self._tracer
+        stack = tr._stack()
+        if self.parent_id is None:
+            self.parent_id = (stack[-1] if stack
+                              else getattr(tr._tls, "base_parent", None))
+        self.span_id = next(tr._ids)
+        stack.append(self.span_id)
+        with tr._lock:
+            tr._active += 1
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        dur = time.perf_counter_ns() - self._t0
+        tr = self._tracer
+        stack = tr._stack()
+        if stack and stack[-1] == self.span_id:
+            stack.pop()
+        th = threading.current_thread()
+        tr._append(Span(self.name, self.span_id, self.parent_id,
+                        th.ident, th.name, self._t0, dur, self.attrs))
+        with tr._lock:
+            tr._active -= 1
+        return False
+
+
+# ---------------------------------------------------------------------------
+# the tracer
+# ---------------------------------------------------------------------------
+
+class Tracer:
+    """Thread-safe span recorder with a bounded ring buffer.
+
+    Spans parent to the innermost open span OF THEIR THREAD; a worker
+    thread inherits a parent either explicitly (``span(parent=...)``) or
+    by calling :meth:`adopt` once with the spawning thread's
+    ``current_span_id()``.
+    """
+
+    def __init__(self, enabled: bool = False,
+                 buffer_spans: int = DEFAULT_BUFFER_SPANS):
+        self.enabled = bool(enabled)
+        self._buf: deque = deque(maxlen=max(int(buffer_spans), 1))
+        self._ids = itertools.count(1)
+        self._tls = threading.local()
+        self._lock = threading.Lock()
+        self._active = 0
+        self._total = 0
+        self._epoch_ns = time.perf_counter_ns()
+
+    # -- recording ---------------------------------------------------------
+    def span(self, name: str, parent: Optional[int] = None, **attrs):
+        """Context manager timing the enclosed block.  Disabled-mode cost
+        is one attribute check + a shared no-op object."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _SpanCtx(self, name, parent, attrs)
+
+    def record_span(self, name: str, t0_ns: int, dur_ns: int,
+                    parent: Optional[int] = None, **attrs) -> None:
+        """Record an already-measured interval (e.g. queue wait computed
+        from an enqueue timestamp) without a with-block."""
+        if not self.enabled:
+            return
+        if parent is None:
+            parent = self.current_span_id()
+        th = threading.current_thread()
+        self._append(Span(name, next(self._ids), parent, th.ident,
+                          th.name, int(t0_ns), max(int(dur_ns), 0), attrs))
+
+    def gauge(self, name: str, value) -> None:
+        """Record one sample of a numeric time series (queue depth, pad
+        fraction, ...) — a Chrome-trace counter event."""
+        if not self.enabled:
+            return
+        self._append(Gauge(name, threading.get_ident(),
+                           time.perf_counter_ns(), float(value)))
+
+    def _append(self, rec) -> None:
+        # append under the lock: exporters/readers snapshot the deque by
+        # iterating it, and a concurrent append during that iteration
+        # would raise "deque mutated during iteration"
+        with self._lock:
+            self._buf.append(rec)
+            self._total += 1
+
+    # -- thread parenting --------------------------------------------------
+    def _stack(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def current_span_id(self) -> Optional[int]:
+        stack = getattr(self._tls, "stack", None)
+        if stack:
+            return stack[-1]
+        return getattr(self._tls, "base_parent", None)
+
+    def adopt(self, parent_id: Optional[int]) -> None:
+        """Seed this thread's root parent: subsequent top-level spans on
+        the calling thread parent to ``parent_id``."""
+        self._tls.base_parent = parent_id
+
+    # -- inspection --------------------------------------------------------
+    def records(self) -> List[object]:
+        with self._lock:
+            return list(self._buf)
+
+    def spans(self, name: Optional[str] = None) -> List[Span]:
+        return [r for r in self.records() if isinstance(r, Span)
+                and (name is None or r.name == name)]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+            self._active = 0
+            self._total = 0
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"enabled": self.enabled, "active_spans": self._active,
+                    "spans_recorded": self._total,
+                    "buffered": len(self._buf),
+                    "buffer_spans": self._buf.maxlen}
+
+    # -- exporters ---------------------------------------------------------
+    def export_jsonl(self, path: str) -> int:
+        """One JSON object per buffered record; returns the line count."""
+        recs = self.records()
+        with open(path, "w") as fh:
+            for r in recs:
+                if isinstance(r, Span):
+                    fh.write(json.dumps(
+                        {"type": "span", "name": r.name, "id": r.span_id,
+                         "parent": r.parent_id, "thread": r.thread,
+                         "t0_ns": r.t0_ns - self._epoch_ns,
+                         "dur_ns": r.dur_ns, "attrs": r.attrs}) + "\n")
+                else:
+                    fh.write(json.dumps(
+                        {"type": "gauge", "name": r.name,
+                         "t_ns": r.t_ns - self._epoch_ns,
+                         "value": r.value}) + "\n")
+        return len(recs)
+
+    def export_chrome_trace(self, path: str) -> int:
+        """Write the buffer as Chrome ``trace_event`` JSON (complete "X"
+        events + counter "C" events + thread-name metadata), loadable in
+        ``chrome://tracing`` / Perfetto.  Returns the event count."""
+        recs = self.records()
+        pid = os.getpid()
+        events: List[dict] = []
+        tid_map: Dict[int, int] = {}
+
+        def tid_of(ident, name=None):
+            t = tid_map.get(ident)
+            if t is None:
+                t = tid_map[ident] = len(tid_map) + 1
+                events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                               "tid": t,
+                               "args": {"name": name or f"thread-{ident}"}})
+            return t
+
+        for r in recs:
+            if isinstance(r, Span):
+                ev = {"name": r.name, "cat": "avenir", "ph": "X",
+                      "ts": (r.t0_ns - self._epoch_ns) / 1000.0,
+                      "dur": r.dur_ns / 1000.0,
+                      "pid": pid, "tid": tid_of(r.tid, r.thread),
+                      "args": {"id": r.span_id, "parent": r.parent_id,
+                               **r.attrs}}
+            else:
+                ev = {"name": r.name, "cat": "avenir", "ph": "C",
+                      "ts": (r.t_ns - self._epoch_ns) / 1000.0,
+                      "pid": pid, "args": {"value": r.value}}
+            events.append(ev)
+        events.sort(key=lambda e: e.get("ts", -1.0))
+        with open(path, "w") as fh:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, fh)
+        return len(events)
+
+
+# ---------------------------------------------------------------------------
+# latency histograms
+# ---------------------------------------------------------------------------
+
+def _log_bounds(n_buckets: int, lo: float, hi: float) -> List[float]:
+    ratio = (hi / lo) ** (1.0 / n_buckets)
+    return [lo * ratio ** i for i in range(n_buckets + 1)]
+
+
+class LatencyHistogram:
+    """Fixed-boundary log-bucketed latency histogram (seconds).
+
+    Boundaries are a geometric ladder ``lo..hi`` shared by every instance
+    constructed with the same parameters, so histograms MERGE exactly
+    (bucket-wise add) across threads, models, or processes.  Quantiles
+    are estimated by locating the target rank's bucket and log-linearly
+    interpolating between its edges, clamped to the observed min/max —
+    worst-case ratio error is one bucket's growth factor
+    (~21% at the default 12 buckets/decade, typically far less).
+    """
+
+    __slots__ = ("bounds", "counts", "n", "total", "vmin", "vmax", "_lock")
+
+    def __init__(self, n_buckets: int = DEFAULT_HIST_BUCKETS,
+                 lo: float = HIST_LO_SEC, hi: float = HIST_HI_SEC):
+        if n_buckets < 1 or not (0 < lo < hi):
+            raise ValueError(f"bad histogram shape: {n_buckets}, {lo}, {hi}")
+        self.bounds = _log_bounds(int(n_buckets), float(lo), float(hi))
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.n = 0
+        self.total = 0.0
+        self.vmin = float("inf")
+        self.vmax = float("-inf")
+        self._lock = threading.Lock()
+
+    # -- recording ---------------------------------------------------------
+    def record(self, seconds: float) -> None:
+        s = float(seconds)
+        i = bisect.bisect_right(self.bounds, s)
+        with self._lock:
+            self.counts[i] += 1
+            self.n += 1
+            self.total += s
+            if s < self.vmin:
+                self.vmin = s
+            if s > self.vmax:
+                self.vmax = s
+
+    def record_ns(self, ns: int) -> None:
+        self.record(ns * 1e-9)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.counts = [0] * (len(self.bounds) + 1)
+            self.n = 0
+            self.total = 0.0
+            self.vmin = float("inf")
+            self.vmax = float("-inf")
+
+    def merge(self, other: "LatencyHistogram") -> "LatencyHistogram":
+        """Fold ``other`` into this histogram (boundaries must match)."""
+        if self.bounds != other.bounds:
+            raise ValueError("cannot merge histograms with different "
+                             "bucket boundaries")
+        counts, n, total, vmin, vmax = other._state()
+        with self._lock:
+            for i, c in enumerate(counts):
+                self.counts[i] += c
+            self.n += n
+            self.total += total
+            self.vmin = min(self.vmin, vmin)
+            self.vmax = max(self.vmax, vmax)
+        return self
+
+    def _state(self):
+        with self._lock:
+            return list(self.counts), self.n, self.total, self.vmin, self.vmax
+
+    # -- quantiles ---------------------------------------------------------
+    def quantile(self, q: float) -> Optional[float]:
+        return self.quantiles([q])[0]
+
+    def quantiles(self, qs: Sequence[float]) -> List[Optional[float]]:
+        """Estimate several quantiles from ONE consistent snapshot."""
+        counts, n, _total, vmin, vmax = self._state()
+        return [self._quantile_from(counts, n, vmin, vmax, q) for q in qs]
+
+    def _quantile_from(self, counts, n, vmin, vmax, q: float):
+        if n == 0:
+            return None
+        target = max(q, 0.0) * n
+        if target <= 1.0:
+            return vmin
+        cum = 0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            if cum + c >= target:
+                lo_e = self.bounds[i - 1] if i >= 1 else vmin
+                hi_e = self.bounds[i] if i < len(self.bounds) else vmax
+                lo_e = max(lo_e, vmin)
+                hi_e = min(hi_e, vmax)
+                if hi_e <= lo_e or lo_e <= 0:
+                    return min(max(hi_e, vmin), vmax)
+                frac = (target - cum) / c
+                return lo_e * (hi_e / lo_e) ** frac
+            cum += c
+        return vmax
+
+    # -- surfaces ----------------------------------------------------------
+    def percentiles_ms(self) -> dict:
+        """The serving stats latency dict (field names byte-compatible
+        with the original hand-rolled sample-sort implementation)."""
+        counts, n, total, vmin, vmax = self._state()
+        if n == 0:
+            return {"p50": None, "p95": None, "p99": None, "n": 0}
+
+        def pct(q):
+            return round(
+                self._quantile_from(counts, n, vmin, vmax, q) * 1000.0, 3)
+
+        return {"p50": pct(0.50), "p95": pct(0.95), "p99": pct(0.99),
+                "mean": round(total / n * 1000.0, 3), "n": n}
+
+    def snapshot(self) -> dict:
+        """Full histogram state for the stats surface / JSON export."""
+        counts, n, total, vmin, vmax = self._state()
+        if n == 0:
+            return {"n": 0}
+
+        def pct(q):
+            return round(
+                self._quantile_from(counts, n, vmin, vmax, q) * 1000.0, 4)
+
+        return {"n": n,
+                "mean_ms": round(total / n * 1000.0, 4),
+                "min_ms": round(vmin * 1000.0, 4),
+                "max_ms": round(vmax * 1000.0, 4),
+                "p50_ms": pct(0.50), "p90_ms": pct(0.90),
+                "p95_ms": pct(0.95), "p99_ms": pct(0.99)}
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+class Metrics:
+    """Counters + named latency histograms + gauges behind one snapshot.
+
+    Extends (does not replace) :class:`core.metrics.Counters`: jobs keep
+    returning Counters; a Metrics registry groups that Counters with the
+    timing distributions the integer channel cannot carry.
+    """
+
+    def __init__(self, counters: Optional[Counters] = None,
+                 hist_buckets: int = DEFAULT_HIST_BUCKETS):
+        self.counters = counters if counters is not None else Counters()
+        self.hist_buckets = int(hist_buckets)
+        self._hists: Dict[str, LatencyHistogram] = {}
+        self._gauges: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def histogram(self, name: str) -> LatencyHistogram:
+        """Get-or-create the named histogram (shared boundaries)."""
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = LatencyHistogram(self.hist_buckets)
+            return h
+
+    def set_gauge(self, name: str, value) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            hists = dict(self._hists)
+            gauges = dict(self._gauges)
+        return {"counters": self.counters.as_dict(),
+                "histograms": {k: h.snapshot() for k, h in
+                               sorted(hists.items())},
+                "gauges": gauges}
+
+
+# ---------------------------------------------------------------------------
+# global tracer + config plumbing
+# ---------------------------------------------------------------------------
+
+_GLOBAL_TRACER = Tracer(enabled=False)
+
+
+def get_tracer() -> Tracer:
+    """The process-global tracer (disabled until configured)."""
+    return _GLOBAL_TRACER
+
+
+def set_tracer(tracer: Tracer) -> Tracer:
+    global _GLOBAL_TRACER
+    _GLOBAL_TRACER = tracer
+    return tracer
+
+
+def configure(enabled: Optional[bool] = None,
+              buffer_spans: Optional[int] = None) -> Tracer:
+    """Reconfigure the global tracer IN PLACE (every call site that
+    already fetched it sees the change)."""
+    tr = _GLOBAL_TRACER
+    with tr._lock:
+        if buffer_spans is not None and int(buffer_spans) != tr._buf.maxlen:
+            tr._buf = deque(tr._buf, maxlen=max(int(buffer_spans), 1))
+        if enabled is not None:
+            tr.enabled = bool(enabled)
+    return tr
+
+
+def configure_from_config(config, force_enable: bool = False) -> Tracer:
+    """Apply the ``obs.*`` properties surface to the global tracer."""
+    return configure(
+        enabled=force_enable or config.get_boolean(KEY_TRACE_ENABLE, False),
+        buffer_spans=config.get_int(KEY_TRACE_BUFFER, DEFAULT_BUFFER_SPANS))
+
+
+def histogram_buckets_from_config(config) -> int:
+    n = config.get_int(KEY_HIST_BUCKETS, DEFAULT_HIST_BUCKETS)
+    if n < 1:
+        raise ValueError(f"{KEY_HIST_BUCKETS} must be positive: {n}")
+    return n
+
+
+def traced_run(fn: Callable) -> Callable:
+    """Decorator for job drivers' ``run()``: wraps the call in one
+    top-level ``job:<ClassName>`` span (a no-op while tracing is
+    disabled).  ``tests/test_obs_coverage.py`` asserts every registered
+    driver carries it, so new drivers cannot silently opt out."""
+    @functools.wraps(fn)
+    def run(self, *args, **kwargs):
+        tracer = _GLOBAL_TRACER
+        if not tracer.enabled:
+            return fn(self, *args, **kwargs)
+        with tracer.span("job:" + type(self).__name__):
+            return fn(self, *args, **kwargs)
+    run.__obs_traced__ = True
+    return run

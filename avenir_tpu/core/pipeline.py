@@ -46,9 +46,12 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from .obs import get_tracer
 
 # config keys (the .properties surface; JobConfig prefix fallback applies)
 KEY_CHUNK_ROWS = "pipeline.chunk.rows"
@@ -104,7 +107,9 @@ def iter_line_chunks(path: str, chunk_rows: int) -> Iterator[List[str]]:
 
     if chunk_rows <= 0:
         raise ValueError(f"chunk_rows must be positive: {chunk_rows}")
+    tracer = get_tracer()
     buf: List[str] = []
+    t0 = time.perf_counter_ns()
     for fp in _input_files(path):
         with open(fp, "r") as fh:
             for line in fh:
@@ -112,9 +117,17 @@ def iter_line_chunks(path: str, chunk_rows: int) -> Iterator[List[str]]:
                 if line:
                     buf.append(line)
                     if len(buf) >= chunk_rows:
+                        tracer.record_span("ingest.read", t0,
+                                           time.perf_counter_ns() - t0,
+                                           rows=len(buf))
                         yield buf
                         buf = []
+                        # restart the clock AFTER the consumer resumes us,
+                        # so a read span times file I/O, not consumer work
+                        t0 = time.perf_counter_ns()
     if buf:
+        tracer.record_span("ingest.read", t0,
+                           time.perf_counter_ns() - t0, rows=len(buf))
         yield buf
 
 
@@ -128,16 +141,26 @@ def iter_field_chunks(path: str, delim_regex: str,
     behind ``DatasetEncoder.encode``)."""
     from .io import is_plain_delim, split_line
 
+    tracer = get_tracer()
     plain = is_plain_delim(delim_regex)
     for lines in iter_line_chunks(path, chunk_rows):
+        t0 = time.perf_counter_ns()
         if plain:
             n_delim = lines[0].count(delim_regex)
             if all(l.count(delim_regex) == n_delim for l in lines):
                 flat = delim_regex.join(lines).split(delim_regex)
-                yield np.asarray(flat, dtype=str).reshape(
+                arr = np.asarray(flat, dtype=str).reshape(
                     len(lines), n_delim + 1)
+                tracer.record_span("ingest.parse", t0,
+                                   time.perf_counter_ns() - t0,
+                                   rows=len(lines), bulk=True)
+                yield arr
                 continue
-        yield [split_line(l, delim_regex) for l in lines]
+        recs = [split_line(l, delim_regex) for l in lines]
+        tracer.record_span("ingest.parse", t0,
+                           time.perf_counter_ns() - t0,
+                           rows=len(lines), bulk=False)
+        yield recs
 
 
 def peek(it: Iterable):
@@ -272,6 +295,10 @@ def streaming_fold(chunks: Iterable[Tuple[np.ndarray, ...]],
     mesh = mesh or get_mesh()
     d = int(mesh.devices.size)
     axes = tuple(mesh.axis_names)
+    tracer = get_tracer()
+    # worker-thread spans (H2D copies + the read/parse work the chunk
+    # generator does on that thread) parent under the caller's open span
+    parent = tracer.current_span_id()
 
     def row_sharding(ndim):
         return NamedSharding(mesh, P(axes, *([None] * (ndim - 1))))
@@ -281,35 +308,38 @@ def streaming_fold(chunks: Iterable[Tuple[np.ndarray, ...]],
         for b in broadcast_args)
 
     def transfer(arrs):
-        arrs = tuple(np.asarray(a) for a in arrs)
-        n = arrs[0].shape[0]
-        for a in arrs:
-            if a.shape[0] != n:
-                raise ValueError("chunk arrays disagree on row count")
-        target = _bucket_rows(n, d, capacity)
-        mask = np.zeros(target, dtype=bool)
-        mask[:n] = True
-        out = []
-        for a in arrs:
-            if target != n:
-                pad = np.zeros((target - n,) + a.shape[1:], dtype=a.dtype)
-                a = np.concatenate([a, pad])
-            out.append(jax.device_put(a, row_sharding(a.ndim)))
-        out.append(jax.device_put(mask, row_sharding(1)))
-        return tuple(out)
+        with tracer.span("ingest.h2d"):
+            arrs = tuple(np.asarray(a) for a in arrs)
+            n = arrs[0].shape[0]
+            for a in arrs:
+                if a.shape[0] != n:
+                    raise ValueError("chunk arrays disagree on row count")
+            target = _bucket_rows(n, d, capacity)
+            mask = np.zeros(target, dtype=bool)
+            mask[:n] = True
+            out = []
+            for a in arrs:
+                if target != n:
+                    pad = np.zeros((target - n,) + a.shape[1:], dtype=a.dtype)
+                    a = np.concatenate([a, pad])
+                out.append(jax.device_put(a, row_sharding(a.ndim)))
+            out.append(jax.device_put(mask, row_sharding(1)))
+            return tuple(out)
 
     carry = None
     fns = None
 
     def fold(dev):
         nonlocal carry, fns
-        if fns is None:
-            fns = _fold_fns(local_fn, mesh, static_args,
-                            tuple(a.ndim for a in dev[:-1]), len(bcast_dev))
-        if carry is None:
-            carry = fns[0](*dev, *bcast_dev)
-        else:
-            carry = fns[1](carry, *dev, *bcast_dev)
+        with tracer.span("ingest.fold", parent=parent):
+            if fns is None:
+                fns = _fold_fns(local_fn, mesh, static_args,
+                                tuple(a.ndim for a in dev[:-1]),
+                                len(bcast_dev))
+            if carry is None:
+                carry = fns[0](*dev, *bcast_dev)
+            else:
+                carry = fns[1](carry, *dev, *bcast_dev)
 
     if prefetch_depth <= 0:
         # strict serial: parse -> transfer -> fold -> BLOCK, per chunk
@@ -321,6 +351,7 @@ def streaming_fold(chunks: Iterable[Tuple[np.ndarray, ...]],
         stop = threading.Event()
 
         def worker():
+            tracer.adopt(parent)
             try:
                 for item in chunks:
                     # consumer died (fold error / Ctrl-C): stop parsing
@@ -331,6 +362,7 @@ def streaming_fold(chunks: Iterable[Tuple[np.ndarray, ...]],
                     # returns as soon as the transfer is enqueued, and
                     # the bounded queue keeps at most `depth` chunks live
                     q.put(transfer(item))
+                    tracer.gauge("ingest.prefetch.queue.depth", q.qsize())
                 q.put(_DONE)
             except BaseException as exc:  # noqa: BLE001 — relayed to caller
                 q.put(_PrefetchError(exc))
@@ -345,6 +377,7 @@ def streaming_fold(chunks: Iterable[Tuple[np.ndarray, ...]],
                     break
                 if isinstance(item, _PrefetchError):
                     raise item.exc
+                tracer.gauge("ingest.prefetch.queue.depth", q.qsize())
                 fold(item)
         finally:
             # signal the producer to quit, then drain (a blocking get

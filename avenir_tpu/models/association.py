@@ -60,6 +60,7 @@ from ..parallel.mesh import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..core.config import JobConfig
+from ..core.obs import traced_run
 from ..core.io import read_lines, split_line, write_output
 from ..core.metrics import Counters
 from ..parallel.mesh import get_mesh, pad_rows
@@ -261,6 +262,7 @@ class FrequentItemsApriori:
     def __init__(self, config: JobConfig):
         self.config = config.with_prefix("fia") if not config.prefix else config
 
+    @traced_run
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         cfg = self.config
@@ -550,6 +552,7 @@ class AssociationRuleMiner:
     def __init__(self, config: JobConfig):
         self.config = config.with_prefix("arm") if not config.prefix else config
 
+    @traced_run
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         cfg = self.config
@@ -591,6 +594,7 @@ class InfrequentItemMarker:
     def __init__(self, config: JobConfig):
         self.config = config.with_prefix("iim") if not config.prefix else config
 
+    @traced_run
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         cfg = self.config

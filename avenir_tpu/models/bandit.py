@@ -47,6 +47,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..core.config import JobConfig
+from ..core.obs import traced_run
 from ..core.io import read_lines, split_line, write_output
 from ..core.metrics import Counters
 
@@ -157,6 +158,7 @@ class GreedyRandomBandit(_BanditJobBase):
     PROB_RED_LOG_LINEAR = "logLinear"
     AUER_GREEDY = "AuerGreedy"
 
+    @traced_run
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         cfg = self.config
@@ -263,6 +265,7 @@ class GreedyRandomBandit(_BanditJobBase):
 class AuerDeterministic(_BanditJobBase):
     """Deterministic UCB1 batch bandit (AuerDeterministic.java:74-233)."""
 
+    @traced_run
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         cfg = self.config
@@ -312,6 +315,7 @@ class SoftMaxBandit(_BanditJobBase):
 
     DISTR_SCALE = 1000
 
+    @traced_run
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         cfg = self.config
@@ -394,6 +398,7 @@ class RandomFirstGreedyBandit(_BanditJobBase):
 
     RANK_MAX = 1000
 
+    @traced_run
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         cfg = self.config

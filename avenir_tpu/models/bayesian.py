@@ -48,6 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.binning import DatasetEncoder, EncodedDataset
+from ..core.obs import get_tracer, traced_run
 from ..core.config import JobConfig
 from ..core.io import read_lines, split_line, write_output
 from ..core.metrics import ConfusionMatrix, CostBasedArbitrator, Counters
@@ -196,6 +197,7 @@ class BayesianDistribution:
         else:
             self.schema = schema      # text mode needs no feature schema
 
+    @traced_run
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         delim_in = self.config.field_delim_regex()
@@ -204,13 +206,17 @@ class BayesianDistribution:
             return self._run_text(in_path, out_path, counters, delim_in,
                                   delim, mesh)
 
-        lines = self._train_streamed(in_path, delim_in, delim, counters,
-                                     mesh)
-        if lines is None:
-            enc = DatasetEncoder(self.schema)
-            ds = enc.encode_path(in_path, delim_in)
-            lines = self.train_lines(ds, delim, counters, mesh=mesh)
-        write_output(out_path, lines)
+        tracer = get_tracer()
+        with tracer.span("phase:train"):
+            lines = self._train_streamed(in_path, delim_in, delim, counters,
+                                         mesh)
+            if lines is None:
+                with tracer.span("phase:load"):
+                    enc = DatasetEncoder(self.schema)
+                    ds = enc.encode_path(in_path, delim_in)
+                lines = self.train_lines(ds, delim, counters, mesh=mesh)
+        with tracer.span("phase:emit"):
+            write_output(out_path, lines)
         return counters
 
     def _train_streamed(self, in_path: str, delim_in: str, delim: str,
@@ -851,6 +857,7 @@ class BayesianPredictor:
                 "n_healthy": int(healthy.sum()),
                 "n_tail": int((~healthy).sum())}
 
+    @traced_run
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         """Score ``in_path`` (map-only).  With ``mesh``, rows shard over
         the ``data`` axis and the batch scores as one ``shard_map`` pass
@@ -861,8 +868,9 @@ class BayesianPredictor:
         delim_regex = self.config.field_delim_regex()
         delim = self.config.field_delim_out()
 
-        raw_lines = list(read_lines(in_path))
-        records = [split_line(l, delim_regex) for l in raw_lines]
+        with get_tracer().span("phase:load"):
+            raw_lines = list(read_lines(in_path))
+            records = [split_line(l, delim_regex) for l in raw_lines]
 
         if not self.tabular:
             # text mode: host-scored through the loaded model (token vocab
@@ -890,35 +898,36 @@ class BayesianPredictor:
         enc = DatasetEncoder(schema)
         ds = enc.encode(records)
 
-        tables = self._build_tables(ds)
-        score_fn = (self._score_batch_f32
-                    if self.score_precision == "float32"
-                    else self._score_batch)
-        n = ds.x.shape[0]
-        if mesh is not None and mesh.shape["data"] > 1:
-            from ..parallel.mesh import shard_map
-            from jax.sharding import PartitionSpec as P
+        with get_tracer().span("phase:score"):
+            tables = self._build_tables(ds)
+            score_fn = (self._score_batch_f32
+                        if self.score_precision == "float32"
+                        else self._score_batch)
+            n = ds.x.shape[0]
+            if mesh is not None and mesh.shape["data"] > 1:
+                from ..parallel.mesh import shard_map
+                from jax.sharding import PartitionSpec as P
 
-            from ..parallel.mesh import pad_rows
+                from ..parallel.mesh import pad_rows
 
-            d = mesh.shape["data"]
-            x_p, _ = pad_rows(ds.x, d)
-            v_p, _ = pad_rows(ds.values, d)
-            spec_t = tuple(P() for _ in tables)
-            fn = jax.jit(shard_map(
-                score_fn, mesh=mesh,
-                in_specs=(P("data"), P("data")) + spec_t,
-                out_specs=(P("data"), P("data"), P("data"))))
-            probs, feat_prior, feat_post = fn(
-                jnp.asarray(x_p), jnp.asarray(v_p),
-                *[jnp.asarray(t) for t in tables])
-        else:
-            probs, feat_prior, feat_post = jax.jit(score_fn)(
-                jnp.asarray(ds.x), jnp.asarray(ds.values),
-                *[jnp.asarray(t) for t in tables])
-        probs = np.asarray(probs)[:n]
-        feat_prior = np.asarray(feat_prior)[:n]
-        feat_post = np.asarray(feat_post)[:n]
+                d = mesh.shape["data"]
+                x_p, _ = pad_rows(ds.x, d)
+                v_p, _ = pad_rows(ds.values, d)
+                spec_t = tuple(P() for _ in tables)
+                fn = jax.jit(shard_map(
+                    score_fn, mesh=mesh,
+                    in_specs=(P("data"), P("data")) + spec_t,
+                    out_specs=(P("data"), P("data"), P("data"))))
+                probs, feat_prior, feat_post = fn(
+                    jnp.asarray(x_p), jnp.asarray(v_p),
+                    *[jnp.asarray(t) for t in tables])
+            else:
+                probs, feat_prior, feat_post = jax.jit(score_fn)(
+                    jnp.asarray(ds.x), jnp.asarray(ds.values),
+                    *[jnp.asarray(t) for t in tables])
+            probs = np.asarray(probs)[:n]
+            feat_prior = np.asarray(feat_prior)[:n]
+            feat_post = np.asarray(feat_post)[:n]
 
         cls_field = schema.class_attr_field()
         actuals = [records[i][cls_field.ordinal] for i in range(len(records))]
@@ -928,9 +937,10 @@ class BayesianPredictor:
     def _emit(self, raw_lines, records, actuals, probs, feat_prior, feat_post,
               delim, counters, out_path) -> Counters:
         """Shared arbitration + output emission (tabular and text modes)."""
-        out = self.emit_lines(raw_lines, records, actuals, probs, feat_prior,
-                              feat_post, delim, counters)
-        write_output(out_path, out)
+        with get_tracer().span("phase:emit"):
+            out = self.emit_lines(raw_lines, records, actuals, probs,
+                                  feat_prior, feat_post, delim, counters)
+            write_output(out_path, out)
         return counters
 
     def emit_lines(self, raw_lines, records, actuals, probs, feat_prior,

@@ -26,6 +26,7 @@ import os
 from typing import List
 
 from ..core.config import JobConfig
+from ..core.obs import traced_run
 from ..core.io import _input_files, read_lines, split_line, write_output
 from ..core.metrics import Counters
 
@@ -89,6 +90,7 @@ class TemporalFilter:
             return _time.gmtime(t).tm_mon - 1
         raise AssertionError(cycle)
 
+    @traced_run
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         cfg = self.config
         counters = Counters()
@@ -146,6 +148,7 @@ class Projection:
     def __init__(self, config: JobConfig):
         self.config = config
 
+    @traced_run
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         cfg = self.config
         counters = Counters()
@@ -226,6 +229,7 @@ class RunningAggregator:
     def __init__(self, config: JobConfig):
         self.config = config
 
+    @traced_run
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         from .bandit import aggregate_rewards
 

@@ -36,6 +36,7 @@ import random
 from typing import Dict, List, Optional
 
 from ..core.config import JobConfig
+from ..core.obs import traced_run
 from ..core.io import read_lines, split_line, write_output
 from ..core.metrics import Counters
 
@@ -130,6 +131,7 @@ class AgglomerativeGraphical:
     def _new_id(self) -> str:
         return "%032x" % self.rng.getrandbits(128)
 
+    @traced_run
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         delim_regex = self.config.field_delim_regex()

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.config import JobConfig
+from ..core.obs import traced_run
 from ..core.io import read_lines, split_line, write_output
 from ..core.metrics import Counters
 from ..core.schema import FeatureSchema
@@ -99,6 +100,7 @@ class CategoricalCorrelation:
     def statistic(self, table: np.ndarray) -> float:
         return cramer_index(table)
 
+    @traced_run
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         cfg = self.config
@@ -165,6 +167,7 @@ class NumericalCorrelation:
     def __init__(self, config: JobConfig):
         self.config = config.with_prefix("nco") if not config.prefix else config
 
+    @traced_run
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         cfg = self.config

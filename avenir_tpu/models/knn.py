@@ -47,6 +47,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..core.config import JobConfig
+from ..core.obs import get_tracer, traced_run
 from ..core.io import _input_files, read_lines, split_line, write_output
 from ..core.metrics import ConfusionMatrix, CostBasedArbitrator, Counters
 from ..core.schema import FeatureSchema
@@ -106,6 +107,7 @@ class SameTypeSimilarity:
                else np.zeros((len(records), 0), dtype=np.int32))
         return num, cat, np.asarray(num_w), np.asarray(cat_w)
 
+    @traced_run
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         delim_regex = self.config.field_delim_regex()
@@ -207,6 +209,7 @@ class FeatureCondProbJoiner:
     def __init__(self, config: JobConfig):
         self.config = config
 
+    @traced_run
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         delim_regex = self.config.field_delim_regex()
@@ -427,6 +430,7 @@ class NearestNeighbor:
         parts.append(predicted)
         return delim.join(parts), predicted
 
+    @traced_run
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         delim_regex = self.config.field_delim_regex()
@@ -435,43 +439,49 @@ class NearestNeighbor:
                      and self.regression_method == "linearRegression")
 
         # mapper parse (NearestNeighbor.java:130-180)
+        tracer = get_tracer()
         groups: Dict[str, List[Tuple]] = defaultdict(list)
         test_class: Dict[str, str] = {}
         test_regr: Dict[str, float] = {}
-        for line in read_lines(in_path):
-            items = split_line(line, delim_regex)
-            if ccw:
-                test_id, t_class, train_id = items[0], items[1], items[2]
-                dist = int(items[3])
-                train_class = items[4]
-                post = float(items[5]) if items[5] else -1.0
-                groups[test_id].append((dist, train_id, train_class, post, 0.0))
-                test_class[test_id] = t_class
-            else:
-                train_id, test_id = items[0], items[1]
-                dist = int(items[2])
-                train_class = items[3]
-                i = 4
-                if self.validation:
-                    test_class[test_id] = items[i]
-                    i += 1
-                r_in = 0.0
-                if is_linreg:
-                    r_in = float(items[i])
-                    test_regr[test_id] = float(items[i + 1])
-                groups[test_id].append(
-                    (dist, train_id, train_class, -1.0, r_in))
+        with tracer.span("phase:load"):
+            for line in read_lines(in_path):
+                items = split_line(line, delim_regex)
+                if ccw:
+                    test_id, t_class, train_id = items[0], items[1], items[2]
+                    dist = int(items[3])
+                    train_class = items[4]
+                    post = float(items[5]) if items[5] else -1.0
+                    groups[test_id].append(
+                        (dist, train_id, train_class, post, 0.0))
+                    test_class[test_id] = t_class
+                else:
+                    train_id, test_id = items[0], items[1]
+                    dist = int(items[2])
+                    train_class = items[3]
+                    i = 4
+                    if self.validation:
+                        test_class[test_id] = items[i]
+                        i += 1
+                    r_in = 0.0
+                    if is_linreg:
+                        r_in = float(items[i])
+                        test_regr[test_id] = float(items[i + 1])
+                    groups[test_id].append(
+                        (dist, train_id, train_class, -1.0, r_in))
 
         out: List[str] = []
-        for test_id, neighbors in groups.items():
-            line, predicted = self.classify_group(
-                neighbors, test_id, test_class.get(test_id, ""),
-                test_regr.get(test_id, 0.0))
-            out.append(line)
-            if self.conf_matrix is not None:
-                self.conf_matrix.report(predicted, test_class.get(test_id, ""))
+        with tracer.span("phase:score"):
+            for test_id, neighbors in groups.items():
+                line, predicted = self.classify_group(
+                    neighbors, test_id, test_class.get(test_id, ""),
+                    test_regr.get(test_id, 0.0))
+                out.append(line)
+                if self.conf_matrix is not None:
+                    self.conf_matrix.report(predicted,
+                                            test_class.get(test_id, ""))
 
-        if self.conf_matrix is not None:
-            self.conf_matrix.to_counters(counters)
-        write_output(out_path, out)
+        with tracer.span("phase:emit"):
+            if self.conf_matrix is not None:
+                self.conf_matrix.to_counters(counters)
+            write_output(out_path, out)
         return counters

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.config import JobConfig
+from ..core.obs import get_tracer, traced_run
 from ..core.io import read_lines, split_line, write_output
 from ..core.metrics import Counters
 from ..core.tabular import deserialize_matrix, normalize_rows, serialize_matrix
@@ -142,6 +143,7 @@ class MarkovStateTransitionModel:
     # (3 int32 streams x ~8 transitions)
     _BUDGET_ROW_BYTES = 96
 
+    @traced_run
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         cfg = self.config
@@ -156,52 +158,58 @@ class MarkovStateTransitionModel:
         # class label occupies one leading field when present (:107-109)
         eff_skip = skip + (1 if class_ord >= 0 else 0)
 
+        tracer = get_tracer()
         chunk_rows = cfg.pipeline_chunk_rows(row_bytes=self._BUDGET_ROW_BYTES)
         counted = None
         if chunk_rows is not None:
-            counted = self._count_streamed(
-                in_path, delim_regex, vocab, S, eff_skip, class_ord,
-                chunk_rows, cfg.pipeline_prefetch_depth(), mesh)
+            with tracer.span("phase:train"):
+                counted = self._count_streamed(
+                    in_path, delim_regex, vocab, S, eff_skip, class_ord,
+                    chunk_rows, cfg.pipeline_prefetch_depth(), mesh)
         if counted is not None:
             counts, class_labels = counted
         else:
-            records = [split_line(l, delim_regex)
-                       for l in read_lines(in_path)]
-            # reference mapper skips rows too short to hold a transition
-            # (:119)
-            records = [r for r in records if len(r) >= eff_skip + 2]
-            class_labels = []
-            cls_idx = np.zeros(len(records), dtype=np.int32)
-            if class_ord >= 0:
-                seen: Dict[str, int] = {}
-                for i, r in enumerate(records):
-                    lbl = r[class_ord]
-                    if lbl not in seen:
-                        seen[lbl] = len(seen)
-                        class_labels.append(lbl)
-                    cls_idx[i] = seen[lbl]
-            seq, _ = encode_sequences(records, eff_skip, vocab)
-            if seq.shape[1] < 2:
-                counts = (np.zeros((len(class_labels), S, S), dtype=np.int64)
-                          if class_ord >= 0
-                          else np.zeros((S, S), dtype=np.int64))
-            else:
-                frm, to = _transition_pairs(seq)
-                counts = np.asarray(sharded_reduce(
-                    _markov_local, frm, to, cls_idx, mesh=mesh,
-                    static_args=(len(class_labels) if class_ord >= 0 else 0,
-                                 S)))
+            with tracer.span("phase:train"):
+                records = [split_line(l, delim_regex)
+                           for l in read_lines(in_path)]
+                # reference mapper skips rows too short to hold a
+                # transition (:119)
+                records = [r for r in records if len(r) >= eff_skip + 2]
+                class_labels = []
+                cls_idx = np.zeros(len(records), dtype=np.int32)
+                if class_ord >= 0:
+                    seen: Dict[str, int] = {}
+                    for i, r in enumerate(records):
+                        lbl = r[class_ord]
+                        if lbl not in seen:
+                            seen[lbl] = len(seen)
+                            class_labels.append(lbl)
+                        cls_idx[i] = seen[lbl]
+                seq, _ = encode_sequences(records, eff_skip, vocab)
+                if seq.shape[1] < 2:
+                    counts = (np.zeros((len(class_labels), S, S),
+                                       dtype=np.int64)
+                              if class_ord >= 0
+                              else np.zeros((S, S), dtype=np.int64))
+                else:
+                    frm, to = _transition_pairs(seq)
+                    counts = np.asarray(sharded_reduce(
+                        _markov_local, frm, to, cls_idx, mesh=mesh,
+                        static_args=(len(class_labels)
+                                     if class_ord >= 0 else 0, S)))
 
-        lines: List[str] = []
-        if output_states:
-            lines.append(",".join(states))
-        if class_ord >= 0:
-            for ci, lbl in enumerate(class_labels):
-                lines.append(f"classLabel:{lbl}")
-                lines.extend(serialize_matrix(normalize_rows(counts[ci], scale)))
-        else:
-            lines.extend(serialize_matrix(normalize_rows(counts, scale)))
-        write_output(out_path, lines)
+        with tracer.span("phase:emit"):
+            lines: List[str] = []
+            if output_states:
+                lines.append(",".join(states))
+            if class_ord >= 0:
+                for ci, lbl in enumerate(class_labels):
+                    lines.append(f"classLabel:{lbl}")
+                    lines.extend(
+                        serialize_matrix(normalize_rows(counts[ci], scale)))
+            else:
+                lines.extend(serialize_matrix(normalize_rows(counts, scale)))
+            write_output(out_path, lines)
         counters.set("Markov", "Transitions", int(counts.sum()))
         return counters
 
@@ -496,6 +504,7 @@ class MarkovModelClassifier:
             out.append(delim.join(parts))
         return out
 
+    @traced_run
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         records = [split_line(l, self.config.field_delim_regex())
@@ -516,6 +525,7 @@ class HiddenMarkovModelBuilder:
     def __init__(self, config: JobConfig):
         self.config = config
 
+    @traced_run
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         cfg = self.config
@@ -705,6 +715,7 @@ class ViterbiStatePredictor:
     def __init__(self, config: JobConfig):
         self.config = config
 
+    @traced_run
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         cfg = self.config

@@ -31,6 +31,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..core.binning import DatasetEncoder, EncodedDataset
+from ..core.obs import traced_run
 from ..core.config import JobConfig
 from ..core.io import write_output
 from ..core.metrics import Counters
@@ -164,6 +165,7 @@ class MutualInformation:
                     f"MutualInformation requires bucketWidth on numeric "
                     f"feature {f.name!r} (reference has no unbinned path)")
 
+    @traced_run
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         cfg = self.config

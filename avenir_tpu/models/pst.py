@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..core.config import JobConfig
+from ..core.obs import traced_run
 from ..core.io import read_lines, split_line, write_output
 from ..core.metrics import Counters
 from ..ops.counting import (count_table, sharded_ngram_counts,
@@ -50,6 +51,7 @@ class ProbabilisticSuffixTreeGenerator:
     def __init__(self, config: JobConfig):
         self.config = config
 
+    @traced_run
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         cfg = self.config

@@ -43,6 +43,7 @@ from ..parallel.mesh import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..core.config import JobConfig
+from ..core.obs import traced_run
 from ..core.io import read_lines, split_line, write_output
 from ..core.metrics import Counters
 from ..core.schema import FeatureSchema
@@ -163,6 +164,7 @@ class LogisticRegressionJob:
         return self._resident
 
     # -- one iteration ------------------------------------------------------
+    @traced_run
     def run(self, in_path: str, out_path: str, mesh=None) -> int:
         cfg = self.config
         delim = cfg.field_delim_out()

@@ -21,6 +21,7 @@ from typing import List
 import numpy as np
 
 from ..core.config import JobConfig
+from ..core.obs import traced_run
 from ..core.io import read_lines, split_line, write_output
 from ..core.metrics import Counters
 
@@ -29,6 +30,7 @@ class BaggingSampler:
     def __init__(self, config: JobConfig):
         self.config = config
 
+    @traced_run
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         cfg = self.config
@@ -50,6 +52,7 @@ class UnderSamplingBalancer:
     def __init__(self, config: JobConfig):
         self.config = config
 
+    @traced_run
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         cfg = self.config

@@ -38,6 +38,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from .reinforce import Action, ReinforcementLearner, create_learner
+from ..core.obs import traced_run
 
 _INT_RE = re.compile(r"-?\d+", re.ASCII)
 
@@ -218,6 +219,7 @@ class StreamingLearnerLoop:
         self.event_count += 1
         return True
 
+    @traced_run
     def run(self, max_events: Optional[int] = None,
             idle_timeout: Optional[float] = 1.0,
             poll_interval: float = 0.01) -> int:
@@ -433,6 +435,7 @@ class GroupedStreamingLearnerLoop:
     def max_pending_batches(self, value: int) -> None:
         self._max_pending_batches = value
 
+    @traced_run
     def run(self, max_events: Optional[int] = None,
             idle_timeout: Optional[float] = 1.0,
             poll_interval: float = 0.01, batch: int = 1024) -> int:
@@ -498,6 +501,7 @@ class ReinforcementLearnerTopology:
               transport: Optional[Transport] = None) -> StreamingLearnerLoop:
         return StreamingLearnerLoop(config, transport)
 
+    @traced_run
     def run(self, topology_name: str, config_file: str,
             transport: Optional[Transport] = None):
         """Job-driver surface: args mirror the reference main()'s

@@ -65,6 +65,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.binning import Vocab
+from ..core.obs import traced_run
 from ..core.config import JobConfig
 from ..core.io import OutputWriter, read_lines, split_line, write_output
 from ..core.metrics import Counters
@@ -186,6 +187,7 @@ class ClassPartitionGenerator:
         raise ValueError(
             f"invalid splitting attribute selection strategy {strategy}")
 
+    @traced_run
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         delim_regex = self.config.field_delim_regex()
@@ -287,6 +289,7 @@ class SplitGenerator(ClassPartitionGenerator):
             in_path = os.path.join(in_path, split_path)
         return in_path, os.path.join(os.path.dirname(in_path), "splits")
 
+    @traced_run
     def run(self, in_path: Optional[str] = None,
             out_path: Optional[str] = None, mesh=None) -> Counters:
         if self.config.get("project.base.path"):
@@ -502,6 +505,7 @@ class DecisionTreeBuilder:
     _BUDGET_ROW_BYTES = 128
 
     # -- one level ---------------------------------------------------------
+    @traced_run
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
         counters = Counters()
         if not self.tree_available():
@@ -994,6 +998,7 @@ class DataPartitioner:
         field = self.schema.field_by_ordinal(attr)
         return attr, Split.from_key(attr, key, field), orig_index
 
+    @traced_run
     def run(self, in_path: Optional[str] = None,
             out_path: Optional[str] = None, mesh=None) -> Counters:
         counters = Counters()

@@ -17,11 +17,17 @@ state — the encoder vocabularies, the compiled-function cache, the device
 tables — is therefore only ever touched by one thread at a time, while
 the shared bounded caches underneath stay lock-protected for the
 warmup/reload paths (utils.caches).
+
+Observability (core.obs): per-request end-to-end and queue-wait latency
+go into shared :class:`LatencyHistogram` s (bounded memory, mergeable,
+p50/p95/p99 from log-bucket interpolation — replacing the old raw-sample
+sort that grew and re-sorted a window on every stats call), and the
+worker emits ``serve.batch`` / ``serve.queue.wait`` / ``serve.assemble``
+/ ``serve.score`` spans plus a queue-depth gauge when tracing is on.
 """
 
 from __future__ import annotations
 
-import statistics
 import threading
 import time
 from collections import deque
@@ -29,6 +35,7 @@ from concurrent.futures import Future
 from typing import Callable, List, Optional
 
 from ..core.metrics import Counters
+from ..core.obs import LatencyHistogram, get_tracer
 
 SERVE_GROUP = "Serve"
 
@@ -55,7 +62,7 @@ class MicroBatcher:
                  max_batch: int = 64,
                  max_delay_ms: float = 2.0,
                  max_queue_depth: int = 256,
-                 latency_window: int = 4096):
+                 hist_buckets: Optional[int] = None):
         self.name = name
         self.predict_fn = predict_fn
         self.counters = counters
@@ -65,10 +72,12 @@ class MicroBatcher:
         self._q: deque = deque()
         self._cv = threading.Condition()
         self._closed = False
-        # appended by the worker, snapshotted by stats readers — guarded
-        # by its own lock (deque iteration raises if it races an append)
-        self._lat_lock = threading.Lock()
-        self._latencies: deque = deque(maxlen=latency_window)
+        # per-request latency distributions: the shared log-bucketed
+        # histogram (core.obs) — bounded memory under sustained traffic,
+        # internally locked, mergeable across batchers
+        hkw = {"n_buckets": hist_buckets} if hist_buckets else {}
+        self.e2e_hist = LatencyHistogram(**hkw)
+        self.queue_wait_hist = LatencyHistogram(**hkw)
         self._worker = threading.Thread(
             target=self._run, name=f"serve-batcher-{name}", daemon=True)
         self._worker.start()
@@ -108,12 +117,14 @@ class MicroBatcher:
                 if not self._q:       # closed+drained while waiting
                     return []
                 deadline = self._q[0].t_enqueue + self.max_delay
-            batch = []
-            while self._q and len(batch) < self.max_batch:
-                batch.append(self._q.popleft())
-            return batch
+            with get_tracer().span("serve.assemble", model=self.name):
+                batch = []
+                while self._q and len(batch) < self.max_batch:
+                    batch.append(self._q.popleft())
+                return batch
 
     def _run(self) -> None:
+        tracer = get_tracer()
         while True:
             batch = self._drain_batch()
             if not batch:
@@ -121,46 +132,63 @@ class MicroBatcher:
                     if self._closed and not self._q:
                         return
                 continue
+            t_drain = time.perf_counter()
+            oldest = min(r.t_enqueue for r in batch)
+            for r in batch:
+                self.queue_wait_hist.record(t_drain - r.t_enqueue)
+            if tracer.enabled:
+                # queue-wait span: the oldest request's time in queue
+                # (recorded retroactively from its enqueue stamp)
+                tracer.record_span(
+                    "serve.queue.wait", int(oldest * 1e9),
+                    int((t_drain - oldest) * 1e9), model=self.name)
+                tracer.gauge(f"serve.{self.name}.queue.depth", self.depth())
             self.counters.incr(SERVE_GROUP, "Requests", len(batch))
             self.counters.incr(SERVE_GROUP, "Batches")
-            try:
-                outputs = self.predict_fn([r.line for r in batch])
-            except Exception as e:                 # noqa: BLE001
-                self.counters.incr(SERVE_GROUP, "Batch errors")
+            with tracer.span("serve.batch", model=self.name,
+                             batch=len(batch)):
+                try:
+                    with tracer.span("serve.score", model=self.name,
+                                     batch=len(batch)):
+                        outputs = self.predict_fn([r.line for r in batch])
+                except Exception as e:                 # noqa: BLE001
+                    self.counters.incr(SERVE_GROUP, "Batch errors")
+                    for r in batch:
+                        if not r.future.set_running_or_notify_cancel():
+                            continue
+                        r.future.set_exception(e)
+                    continue
+                done = time.perf_counter()
                 for r in batch:
+                    self.e2e_hist.record(done - r.t_enqueue)
+                if tracer.enabled:
+                    # end-to-end span: oldest enqueue -> results ready
+                    tracer.record_span(
+                        "serve.e2e", int(oldest * 1e9),
+                        int((done - oldest) * 1e9), model=self.name,
+                        batch=len(batch))
+                for r, out in zip(batch, outputs):
                     if not r.future.set_running_or_notify_cancel():
                         continue
-                    r.future.set_exception(e)
-                continue
-            done = time.perf_counter()
-            with self._lat_lock:
-                for r in batch:
-                    self._latencies.append(done - r.t_enqueue)
-            for r, out in zip(batch, outputs):
-                if not r.future.set_running_or_notify_cancel():
-                    continue
-                if out is None:
-                    self.counters.incr(SERVE_GROUP, "Unscorable")
-                    r.future.set_exception(
-                        ValueError("record not scorable by this model"))
-                else:
-                    r.future.set_result(out)
+                    if out is None:
+                        self.counters.incr(SERVE_GROUP, "Unscorable")
+                        r.future.set_exception(
+                            ValueError("record not scorable by this model"))
+                    else:
+                        r.future.set_result(out)
 
     # -- metrics / lifecycle ----------------------------------------------
     def latency_percentiles_ms(self) -> dict:
-        """p50/p95/p99 of recent request latencies, in milliseconds."""
-        with self._lat_lock:
-            lat = sorted(self._latencies)
-        if not lat:
-            return {"p50": None, "p95": None, "p99": None, "n": 0}
+        """p50/p95/p99 of end-to-end request latency, in milliseconds —
+        estimated from the shared log-bucketed histogram (same JSON field
+        names as the old raw-sample implementation, O(buckets) memory
+        instead of an ever-resorted sample window)."""
+        return self.e2e_hist.percentiles_ms()
 
-        def pct(p):
-            i = min(len(lat) - 1, int(p * len(lat)))
-            return round(lat[i] * 1000.0, 3)
-
-        return {"p50": pct(0.50), "p95": pct(0.95), "p99": pct(0.99),
-                "mean": round(statistics.fmean(lat) * 1000.0, 3),
-                "n": len(lat)}
+    def histograms(self) -> dict:
+        """Full latency-distribution snapshots for the stats surface."""
+        return {"e2e_ms": self.e2e_hist.snapshot(),
+                "queue_wait_ms": self.queue_wait_hist.snapshot()}
 
     def fill_ratio(self) -> Optional[float]:
         """Requests / padded (bucketed) rows — 1.0 means every scored slot
@@ -171,10 +199,10 @@ class MicroBatcher:
         return self.counters.get(SERVE_GROUP, "Requests") / padded
 
     def clear_latency_window(self) -> None:
-        """Reset the percentile window (load sweeps measure each offered
+        """Reset the latency histograms (load sweeps measure each offered
         load against a fresh window)."""
-        with self._lat_lock:
-            self._latencies.clear()
+        self.e2e_hist.reset()
+        self.queue_wait_hist.reset()
 
     def depth(self) -> int:
         with self._cv:

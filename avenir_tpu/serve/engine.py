@@ -32,6 +32,7 @@ import numpy as np
 from ..core.config import JobConfig
 from ..core.io import split_line
 from ..core.metrics import Counters
+from ..core.obs import get_tracer
 from ..utils.caches import bounded_cache_get, bounded_cache_put
 
 SERVE_GROUP = "Serve"
@@ -117,6 +118,9 @@ class ModelAdapter:
     def _bucket(self, n: int) -> int:
         b = pow2_bucket(n, self.max_bucket)
         self.counters.incr(SERVE_GROUP, "Padded rows", b)
+        # pad fraction: wasted slots in this scoring batch (0 = perfectly
+        # full bucket) — a Chrome-trace counter series when tracing is on
+        get_tracer().gauge("serve.pad.fraction", 1.0 - n / b)
         return b
 
     def _split(self, lines: List[str]) -> List[List[str]]:

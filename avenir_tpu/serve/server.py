@@ -33,6 +33,7 @@ import sys
 import threading
 from typing import Dict, Optional
 
+from ..core import obs
 from ..core.config import JobConfig, load_job_config, parse_cli_args
 from .batcher import MicroBatcher, ShedError
 from .registry import ModelEntry, ModelRegistry
@@ -49,7 +50,8 @@ class PredictionServer:
         self._batch_kw = dict(
             max_batch=config.get_int("serve.batch.max.size", 64),
             max_delay_ms=config.get_float("serve.batch.max.delay.ms", 2.0),
-            max_queue_depth=config.get_int("serve.queue.max.depth", 256))
+            max_queue_depth=config.get_int("serve.queue.max.depth", 256),
+            hist_buckets=obs.histogram_buckets_from_config(config))
         self._batchers: Dict[str, MicroBatcher] = {}
         self._lock = threading.Lock()
         self._tcp: Optional[socketserver.ThreadingTCPServer] = None
@@ -86,6 +88,10 @@ class PredictionServer:
 
     # -- request handling --------------------------------------------------
     def handle_line(self, line: str) -> dict:
+        with obs.get_tracer().span("serve.request"):
+            return self._handle_line(line)
+
+    def _handle_line(self, line: str) -> dict:
         try:
             obj = json.loads(line)
         except json.JSONDecodeError as e:
@@ -183,13 +189,16 @@ class PredictionServer:
                 "version": entry.version,
                 "kind": entry.kind,
                 "counters": entry.counters.as_dict(),
+                # byte-compatible p50/p95/p99 field names, now sourced
+                # from the shared log-bucketed LatencyHistogram
                 "latency_ms": (b.latency_percentiles_ms() if b else None),
+                "histograms": (b.histograms() if b else None),
                 "batch_fill_ratio": (round(b.fill_ratio(), 4)
                                      if b and b.fill_ratio() is not None
                                      else None),
                 "queue_depth": b.depth() if b else 0,
             }
-        return {"models": models}
+        return {"models": models, "obs": obs.get_tracer().stats()}
 
     # -- TCP frontend ------------------------------------------------------
     def start(self) -> int:
@@ -251,17 +260,23 @@ def request(host: str, port: int, obj: dict, timeout: float = 30.0) -> dict:
 
 
 def serve_main(argv) -> int:
-    """``python -m avenir_tpu serve -Dconf.path=serve.properties``."""
-    defines, positional = parse_cli_args(list(argv))
+    """``python -m avenir_tpu serve -Dconf.path=serve.properties
+    [--trace out.json]``."""
+    from ..cli import extract_trace_flag
+
+    argv, trace_path = extract_trace_flag(list(argv))
+    defines, positional = parse_cli_args(argv)
     if positional and positional[0] in ("-h", "--help"):
         print("usage: python -m avenir_tpu serve -Dconf.path=<serve."
-              "properties> [-Dserve.port=N ...]", file=sys.stderr)
+              "properties> [-Dserve.port=N ...] [--trace out.json]",
+              file=sys.stderr)
         return 2
     config = load_job_config(defines)
     if not config.get("serve.models"):
         print("serve: no models configured (serve.models=...)",
               file=sys.stderr)
         return 2
+    obs.configure_from_config(config, force_enable=bool(trace_path))
     server = PredictionServer(config)
     port = server.start()
     names = ", ".join(
@@ -269,10 +284,26 @@ def serve_main(argv) -> int:
     print(f"serving {names} on "
           f"{config.get('serve.host', '127.0.0.1')}:{port}", file=sys.stderr,
           flush=True)
+    # explicit shutdown handlers: SIGTERM is the standard operational stop,
+    # and a backgrounded server (sh's `serve &`) inherits SIGINT as
+    # SIG_IGN — installing our own handler re-enables both so shutdown
+    # (and the --trace export below) runs instead of requiring SIGKILL
+    stop_evt = threading.Event()
+    import signal
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            signal.signal(sig, lambda *_: stop_evt.set())
+        except (ValueError, OSError):       # non-main thread / platform
+            pass
     try:
-        threading.Event().wait()
+        stop_evt.wait()
     except KeyboardInterrupt:
         pass
     finally:
         server.stop()
+        if trace_path:
+            n = obs.get_tracer().export_chrome_trace(trace_path)
+            print(f"obs: wrote {n} trace events to {trace_path} "
+                  f"(open in chrome://tracing or ui.perfetto.dev)",
+                  file=sys.stderr)
     return 0

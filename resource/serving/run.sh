@@ -15,11 +15,30 @@ tail -n 600  work/all.csv > work/test/part-00000
 $PY -m avenir_tpu BayesianDistribution -Dconf.path=nb.properties work/train work/model
 
 # 2. serve it: ephemeral port, banner + counters on stderr -> work/server.log
+#    --trace records obs spans (queue wait / assemble / score / e2e per
+#    batch) and exports Chrome/Perfetto trace JSON on shutdown
 $PY -m avenir_tpu serve -Dconf.path=serve.properties -Dserve.port=0 \
+    --trace work/serve_trace.json \
     2> work/server.log &
 SERVER_PID=$!
 trap 'kill $SERVER_PID 2>/dev/null || true' EXIT
 
 # 3. concurrent single-row clients: byte-identical to batch predictions,
-#    coalesced by the micro-batcher; prints the stats surface
+#    coalesced by the micro-batcher; prints the stats surface (latency
+#    quantiles from the shared histogram + the obs tracer state)
 $PY client.py work/server.log work/test/part-00000
+
+# 4. graceful shutdown (SIGINT) flushes the span buffer to the trace
+#    file; open work/serve_trace.json in chrome://tracing or
+#    https://ui.perfetto.dev to see the traced serve session
+kill -INT $SERVER_PID
+wait $SERVER_PID 2>/dev/null || true
+trap - EXIT
+$PY - work/serve_trace.json <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+names = sorted({e["name"] for e in spans})
+print(f"serve trace: {len(spans)} spans ({', '.join(names)})")
+print(f"open {sys.argv[1]} in chrome://tracing or ui.perfetto.dev")
+EOF

@@ -1,0 +1,462 @@
+"""Unified tracing + timing metrics (core/obs): span nesting/parenting
+(same-thread and cross-thread), ring-buffer bounds, disabled-mode no-op
+behavior, histogram quantile accuracy vs numpy on known distributions,
+merge semantics, Perfetto/Chrome export schema validity, the
+thread-safety hammer for Counters, the serving batcher's shared-histogram
+stats, and a pipeline-ingest trace asserting H2D/fold overlap under
+prefetch."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core import obs
+from avenir_tpu.core.metrics import Counters
+from avenir_tpu.core.obs import LatencyHistogram, Metrics, Tracer
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_same_thread():
+    tr = Tracer(enabled=True)
+    with tr.span("outer", job="x"):
+        oid = tr.current_span_id()
+        with tr.span("inner"):
+            assert tr.current_span_id() != oid
+        with tr.span("inner2"):
+            pass
+    spans = {s.name: s for s in tr.spans()}
+    assert spans["inner"].parent_id == spans["outer"].span_id
+    assert spans["inner2"].parent_id == spans["outer"].span_id
+    assert spans["outer"].parent_id is None
+    assert spans["outer"].attrs == {"job": "x"}
+    # children finished first, all durations sane and nested in time
+    assert spans["outer"].dur_ns >= spans["inner"].dur_ns >= 0
+    assert tr.stats()["active_spans"] == 0
+
+
+def test_span_parenting_across_threads():
+    tr = Tracer(enabled=True)
+    with tr.span("main"):
+        parent = tr.current_span_id()
+
+        def worker():
+            tr.adopt(parent)
+            with tr.span("child"):
+                with tr.span("grandchild"):
+                    pass
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    spans = {s.name: s for s in tr.spans()}
+    assert spans["child"].parent_id == spans["main"].span_id
+    assert spans["grandchild"].parent_id == spans["child"].span_id
+    assert spans["child"].tid != spans["main"].tid
+
+
+def test_explicit_parent_and_record_span():
+    tr = Tracer(enabled=True)
+    with tr.span("root"):
+        rid = tr.current_span_id()
+    t0 = time.perf_counter_ns()
+    tr.record_span("measured", t0, 1234, parent=rid, k="v")
+    s = tr.spans("measured")[0]
+    assert s.parent_id == rid and s.dur_ns == 1234 and s.attrs == {"k": "v"}
+
+
+def test_disabled_tracer_records_nothing():
+    tr = Tracer(enabled=False)
+    ctx = tr.span("x", big="attr")
+    assert ctx is tr.span("y")            # the shared no-op singleton
+    with ctx:
+        pass
+    tr.gauge("g", 1.0)
+    tr.record_span("r", 0, 1)
+    assert tr.records() == []
+    assert tr.stats()["spans_recorded"] == 0
+
+
+def test_ring_buffer_bound():
+    tr = Tracer(enabled=True, buffer_spans=16)
+    for i in range(100):
+        with tr.span(f"s{i}"):
+            pass
+    assert len(tr.records()) == 16
+    assert tr.stats()["spans_recorded"] == 100
+    # oldest dropped: the survivors are the last 16
+    assert tr.spans()[0].name == "s84"
+
+
+def test_span_overlap_helper():
+    from avenir_tpu.core.obs import Span
+    a = Span("a", 1, None, 0, "t", 100, 50, {})
+    b = Span("b", 2, None, 0, "t", 120, 10, {})
+    c = Span("c", 3, None, 0, "t", 150, 10, {})
+    assert a.overlaps(b) and b.overlaps(a)
+    assert not a.overlaps(c)              # [100,150) vs [150,160)
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+def test_chrome_trace_export_schema(tmp_path):
+    tr = Tracer(enabled=True)
+    with tr.span("parent", stage="read"):
+        with tr.span("child"):
+            pass
+        tr.gauge("depth", 3)
+    out = tmp_path / "trace.json"
+    n = tr.export_chrome_trace(str(out))
+    doc = json.loads(out.read_text())
+    assert isinstance(doc["traceEvents"], list) and n == len(doc["traceEvents"])
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    cs = [e for e in doc["traceEvents"] if e["ph"] == "C"]
+    assert {e["name"] for e in xs} == {"parent", "child"}
+    for e in xs:
+        assert {"ph", "ts", "dur", "name", "pid", "tid"} <= set(e)
+        assert e["dur"] >= 0 and e["ts"] >= 0
+    assert cs and cs[0]["args"]["value"] == 3.0
+    # parented child points at the parent's span id
+    by_name = {e["name"]: e for e in xs}
+    assert by_name["child"]["args"]["parent"] == by_name["parent"]["args"]["id"]
+
+
+def test_jsonl_export(tmp_path):
+    tr = Tracer(enabled=True)
+    with tr.span("a"):
+        tr.gauge("g", 1.5)
+    out = tmp_path / "trace.jsonl"
+    n = tr.export_jsonl(str(out))
+    lines = [json.loads(l) for l in out.read_text().splitlines()]
+    assert len(lines) == n == 2
+    kinds = {l["type"] for l in lines}
+    assert kinds == {"span", "gauge"}
+
+
+# ---------------------------------------------------------------------------
+# histograms
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dist", ["lognormal", "uniform", "bimodal"])
+def test_histogram_quantiles_vs_numpy(dist):
+    rng = np.random.default_rng(7)
+    qs = (0.50, 0.90, 0.95, 0.99)
+    if dist == "lognormal":
+        xs = rng.lognormal(-6.0, 1.2, 30000)          # ~ms-scale latencies
+    elif dist == "uniform":
+        xs = rng.uniform(1e-4, 5e-2, 30000)
+    else:
+        xs = np.concatenate([rng.normal(2e-3, 2e-4, 15000),
+                             rng.normal(5e-2, 5e-3, 15000)])
+        xs = np.clip(xs, 1e-6, None)
+        # p50 falls in the empty density gap between the modes, where ANY
+        # value is a valid median estimate — test quantiles inside them
+        qs = (0.25, 0.75, 0.90, 0.99)
+    h = LatencyHistogram()
+    for v in xs:
+        h.record(v)
+    # log-bucket interpolation: worst-case ratio error is one bucket's
+    # growth factor (~1.21 at the default 12/decade); typical far less
+    for q in qs:
+        est = h.quantile(q)
+        true = float(np.percentile(xs, q * 100))
+        assert 1 / 1.25 < est / true < 1.25, (dist, q, est, true)
+
+
+def test_histogram_extremes_and_reset():
+    h = LatencyHistogram()
+    h.record(1e-9)                        # below lo -> underflow bucket
+    h.record(1e4)                         # above hi -> overflow bucket
+    assert h.n == 2
+    assert h.quantile(0.0) == pytest.approx(1e-9)
+    assert h.quantile(1.0) == pytest.approx(1e4)
+    snap = h.snapshot()
+    assert snap["n"] == 2 and snap["max_ms"] >= snap["min_ms"]
+    h.reset()
+    assert h.percentiles_ms() == {"p50": None, "p95": None, "p99": None,
+                                  "n": 0}
+    assert h.snapshot() == {"n": 0}
+
+
+def test_histogram_merge_matches_union():
+    rng = np.random.default_rng(3)
+    a, b = rng.lognormal(-5, 1, 4000), rng.lognormal(-7, 0.5, 4000)
+    ha, hb, hu = LatencyHistogram(), LatencyHistogram(), LatencyHistogram()
+    for v in a:
+        ha.record(v)
+        hu.record(v)
+    for v in b:
+        hb.record(v)
+        hu.record(v)
+    ha.merge(hb)
+    assert ha.counts == hu.counts
+    assert ha.n == hu.n and ha.vmin == hu.vmin and ha.vmax == hu.vmax
+    assert ha.quantile(0.95) == hu.quantile(0.95)
+    with pytest.raises(ValueError):
+        ha.merge(LatencyHistogram(n_buckets=10))
+
+
+def test_histogram_thread_safety_hammer():
+    h = LatencyHistogram()
+
+    def hammer(seed):
+        rng = np.random.default_rng(seed)
+        for v in rng.lognormal(-6, 1, 5000):
+            h.record(v)
+
+    threads = [threading.Thread(target=hammer, args=(s,)) for s in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.n == 8 * 5000
+    assert sum(h.counts) == h.n
+
+
+def test_metrics_registry_snapshot():
+    m = Metrics()
+    m.counters.incr("G", "n", 3)
+    m.histogram("lat").record(0.002)
+    m.histogram("lat").record(0.004)      # same instance
+    m.set_gauge("depth", 5)
+    snap = m.snapshot()
+    assert snap["counters"] == {"G": {"n": 3}}
+    assert snap["histograms"]["lat"]["n"] == 2
+    assert snap["gauges"] == {"depth": 5.0}
+
+
+# ---------------------------------------------------------------------------
+# Counters thread safety (satellite)
+# ---------------------------------------------------------------------------
+
+def test_counters_concurrent_hammer():
+    """incr is a read-modify-write shared by serving worker threads and
+    warmup/reload since PR 2 — hammer it from 8 threads and assert no
+    lost updates, plus torn-free snapshot iteration under load."""
+    c = Counters()
+    N, T = 5000, 8
+    stop = threading.Event()
+    errors = []
+
+    def snapshotter():
+        while not stop.is_set():
+            for g, n, v in c.items():
+                if v < 0:
+                    errors.append((g, n, v))
+
+    def hammer(k):
+        for i in range(N):
+            c.incr("Hot", "shared")
+            c.incr("Hot", f"t{k}")
+            c.set("Gauge", f"t{k}", i)
+
+    snap = threading.Thread(target=snapshotter, daemon=True)
+    snap.start()
+    threads = [threading.Thread(target=hammer, args=(k,)) for k in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    snap.join(timeout=5)
+    assert not errors
+    assert c.get("Hot", "shared") == N * T
+    for k in range(T):
+        assert c.get("Hot", f"t{k}") == N
+
+
+# ---------------------------------------------------------------------------
+# serving batcher on the shared histogram (satellite)
+# ---------------------------------------------------------------------------
+
+def test_batcher_latency_from_shared_histogram():
+    from avenir_tpu.serve import MicroBatcher
+
+    c = Counters()
+    b = MicroBatcher("t", lambda ls: [l + "!" for l in ls], c,
+                     max_batch=8, max_delay_ms=5, max_queue_depth=64)
+    try:
+        futures = [b.submit(f"x{i}") for i in range(32)]
+        for f in futures:
+            f.result(timeout=10)
+        pct = b.latency_percentiles_ms()
+        # byte-compatible field names, histogram-sourced values
+        assert set(pct) == {"p50", "p95", "p99", "mean", "n"}
+        assert pct["n"] == 32 and pct["p50"] <= pct["p95"] <= pct["p99"]
+        hists = b.histograms()
+        assert hists["e2e_ms"]["n"] == 32
+        assert hists["queue_wait_ms"]["n"] == 32
+        # queue wait is a component of end-to-end
+        assert hists["queue_wait_ms"]["p50_ms"] <= hists["e2e_ms"]["p99_ms"]
+        b.clear_latency_window()
+        assert b.latency_percentiles_ms()["n"] == 0
+        assert b.histograms() == {"e2e_ms": {"n": 0},
+                                  "queue_wait_ms": {"n": 0}}
+    finally:
+        b.close()
+
+
+def test_batcher_emits_serving_spans():
+    from avenir_tpu.serve import MicroBatcher
+
+    tr = obs.configure(enabled=True)
+    tr.clear()
+    try:
+        b = MicroBatcher("m", lambda ls: [l for l in ls], Counters(),
+                         max_batch=4, max_delay_ms=2, max_queue_depth=64)
+        try:
+            fs = [b.submit(f"r{i}") for i in range(8)]
+            for f in fs:
+                f.result(timeout=10)
+        finally:
+            b.close()
+        names = {s.name for s in tr.spans()}
+        assert {"serve.batch", "serve.score", "serve.queue.wait",
+                "serve.e2e"} <= names
+        batch = tr.spans("serve.batch")[0]
+        score = tr.spans("serve.score")[0]
+        assert score.parent_id == batch.span_id
+        assert score.attrs["model"] == "m"
+    finally:
+        obs.configure(enabled=False)
+        tr.clear()
+
+
+# ---------------------------------------------------------------------------
+# pipeline-ingest tracing (H2D overlaps fold under prefetch)
+# ---------------------------------------------------------------------------
+
+def test_pipeline_trace_h2d_overlaps_fold(mesh8):
+    from avenir_tpu.core import pipeline
+    from avenir_tpu.models.bayesian import _nb_local
+
+    rng = np.random.default_rng(0)
+    n, F, B, C = 4096, 4, 6, 3
+    x = rng.integers(0, B, (n, F)).astype(np.int32)
+    y = rng.integers(0, C, n).astype(np.int32)
+
+    tr = obs.configure(enabled=True)
+    tr.clear()
+    try:
+        def chunks():
+            for s in range(0, n, 512):
+                yield x[s:s + 512], y[s:s + 512]
+
+        with tr.span("ingest.test"):
+            root = tr.current_span_id()
+            pipeline.streaming_fold(chunks(), _nb_local, static_args=(C, B),
+                                    mesh=mesh8, prefetch_depth=1)
+        h2d = tr.spans("ingest.h2d")
+        fold = tr.spans("ingest.fold")
+        assert len(h2d) == 8 and len(fold) == 8
+        # worker-thread H2D spans adopt the caller's open span as parent;
+        # fold spans parent to it explicitly
+        assert all(s.parent_id == root for s in h2d)
+        assert all(s.parent_id == root for s in fold)
+        assert h2d[0].tid != fold[0].tid
+        # prefetch depth >= 1: while the consumer folds chunk c (the
+        # first fold includes the jit compile), the worker is already
+        # transferring chunk c+1 — some H2D span must overlap some fold
+        # span in wall-clock time
+        assert any(h.overlaps(f) for h in h2d for f in fold), \
+            "no H2D/fold overlap despite prefetch_depth=1"
+        # queue-depth gauge series recorded
+        assert any(not isinstance(r, obs.Span) and
+                   r.name == "ingest.prefetch.queue.depth"
+                   for r in tr.records())
+    finally:
+        obs.configure(enabled=False)
+        tr.clear()
+
+
+def test_pipeline_read_parse_spans(tmp_path):
+    from avenir_tpu.core import pipeline
+
+    p = tmp_path / "in.txt"
+    p.write_text("".join(f"a{i},{i}\n" for i in range(100)))
+    tr = obs.configure(enabled=True)
+    tr.clear()
+    try:
+        chunks = list(pipeline.iter_field_chunks(str(p), ",", 32))
+        assert sum(len(c) for c in chunks) == 100
+        reads = tr.spans("ingest.read")
+        parses = tr.spans("ingest.parse")
+        assert len(reads) == 4 and len(parses) == 4
+        assert [s.attrs["rows"] for s in reads] == [32, 32, 32, 4]
+    finally:
+        obs.configure(enabled=False)
+        tr.clear()
+
+
+# ---------------------------------------------------------------------------
+# CLI --trace end-to-end: Chrome-trace file with nested ingest spans
+# ---------------------------------------------------------------------------
+
+def test_cli_trace_flag_produces_chrome_trace(tmp_path):
+    from avenir_tpu import cli
+    from avenir_tpu.core.io import write_output
+    from avenir_tpu.datagen import gen_telecom_churn
+
+    schema = {"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "plan", "ordinal": 1, "dataType": "categorical",
+         "feature": True, "cardinality": ["planA", "planB"]},
+        {"name": "minUsed", "ordinal": 2, "dataType": "int", "feature": True,
+         "min": 0, "max": 2200, "bucketWidth": 200},
+        {"name": "dataUsed", "ordinal": 3, "dataType": "int", "feature": True,
+         "min": 0, "max": 1000, "bucketWidth": 100},
+        {"name": "csCall", "ordinal": 4, "dataType": "int", "feature": True,
+         "min": 0, "max": 14, "bucketWidth": 2},
+        {"name": "csEmail", "ordinal": 5, "dataType": "int", "feature": True,
+         "min": 0, "max": 22, "bucketWidth": 4},
+        {"name": "network", "ordinal": 6, "dataType": "int",
+         "feature": True},
+        {"name": "churned", "ordinal": 7, "dataType": "categorical",
+         "cardinality": ["N", "Y"]}]}
+    sp = tmp_path / "schema.json"
+    sp.write_text(json.dumps(schema))
+    rows = gen_telecom_churn(600, seed=11)
+    write_output(str(tmp_path / "in"), [",".join(r) for r in rows])
+    trace = tmp_path / "t.json"
+
+    rc = cli.main(["BayesianDistribution",
+                   f"-Dfeature.schema.file.path={sp}",
+                   "-Dpipeline.chunk.rows=128",
+                   "-Dpipeline.prefetch.depth=1",
+                   "--trace", str(trace),
+                   str(tmp_path / "in"), str(tmp_path / "model")])
+    assert rc == 0
+    try:
+        doc = json.loads(trace.read_text())
+        xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        names = {e["name"] for e in xs}
+        # the nested ingest chain: read -> parse -> H2D -> fold, under
+        # the job's top-level span
+        assert {"job:BayesianDistribution", "phase:train", "ingest.read",
+                "ingest.parse", "ingest.h2d", "ingest.fold"} <= names
+        for e in xs:
+            assert {"ph", "ts", "dur", "name", "pid", "tid"} <= set(e)
+        by_id = {e["args"]["id"]: e for e in xs if "args" in e}
+        job = next(e for e in xs if e["name"] == "job:BayesianDistribution")
+
+        def ancestry(e):
+            seen = set()
+            while e is not None and e["args"]["id"] not in seen:
+                seen.add(e["args"]["id"])
+                yield e["name"]
+                e = by_id.get(e["args"].get("parent"))
+
+        for name in ("ingest.h2d", "ingest.fold", "ingest.parse"):
+            e = next(e for e in xs if e["name"] == name)
+            assert "job:BayesianDistribution" in list(ancestry(e)), name
+        assert job["dur"] > 0
+    finally:
+        obs.configure(enabled=False)
+        obs.get_tracer().clear()
