@@ -118,10 +118,76 @@ def extract_trace_flag(argv):
     return out, trace_path
 
 
+def _init_runtime() -> None:
+    """Platform pin + x64 enable shared by every CLI entry point: the
+    JAX_PLATFORMS env var alone is overridden by site TPU plugins, so an
+    ``AVENIR_PLATFORM`` override must go through the config API (same as
+    tests/conftest.py)."""
+    import os
+    plat = os.environ.get("AVENIR_PLATFORM")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
+    import avenir_tpu
+    avenir_tpu.enable_x64()
+
+
+def _export_trace(trace_path) -> None:
+    """Export the obs tracer as Chrome/Perfetto trace JSON (no-op when
+    --trace was not given)."""
+    if not trace_path:
+        return
+    from .core import obs
+    n = obs.get_tracer().export_chrome_trace(trace_path)
+    print(f"obs: wrote {n} trace events to {trace_path} "
+          f"(open in chrome://tracing or ui.perfetto.dev)",
+          file=sys.stderr)
+
+
+def _job_resolver(cls_name: str):
+    """``multi`` manifest resolver: job class name -> (factory, prefix)."""
+    modname, clsname, prefix = resolve(cls_name)
+    return _lazy(modname, clsname), prefix
+
+
+def multi_main(argv) -> int:
+    """``python -m avenir_tpu multi -Dconf.path=<manifest> <in> [<out>]``:
+    run every job in the ``multi.jobs`` manifest off ONE streamed ingest
+    pass (core.multiscan), writing each job's normal output file.  Jobs
+    that cannot fuse (no FoldSpec, mid-stream cap overflow) re-run
+    standalone after the fused pass, so the workflow's outputs are
+    always complete."""
+    argv, trace_path = extract_trace_flag(argv)
+    defines, positional = parse_cli_args(argv)
+    if not positional:
+        print("expected <input path> [<output base dir>]", file=sys.stderr)
+        return 2
+    in_path = positional[0]
+    out_base = positional[1] if len(positional) > 1 else None
+
+    _init_runtime()
+    config = load_job_config(defines, "")
+    from .core import obs
+    from .core.multiscan import run_multi
+    obs.configure_from_config(config, force_enable=bool(trace_path))
+    try:
+        results = run_multi(config, in_path, out_base, _job_resolver,
+                            log=lambda m: print(m, file=sys.stderr))
+    finally:
+        _export_trace(trace_path)
+    for jid, counters in results.items():
+        print(f"--- job {jid}", file=sys.stderr)
+        if isinstance(counters, Counters):
+            print(counters.format(), file=sys.stderr)
+    return 0
+
+
 def main(argv=None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
     if not argv:
         print("usage: python -m avenir_tpu <JobClass> -Dconf.path=<props> <in> <out>",
+              file=sys.stderr)
+        print("       python -m avenir_tpu multi -Dconf.path=<manifest.properties> <in> [<out base>]",
               file=sys.stderr)
         print("       python -m avenir_tpu serve -Dconf.path=<serve.properties>",
               file=sys.stderr)
@@ -129,16 +195,14 @@ def main(argv=None) -> int:
         return 2
 
     job_name, rest = argv[0], argv[1:]
+    if job_name == "multi":
+        # shared-scan job fusion (core.multiscan): one streamed ingest
+        # pass feeding every job named by the multi.* manifest
+        return multi_main(rest)
     if job_name == "serve":
         # online prediction service (model registry + micro-batching
         # frontend) — net-new surface, no reference driver class
-        import os
-        plat = os.environ.get("AVENIR_PLATFORM")
-        if plat:
-            import jax
-            jax.config.update("jax_platforms", plat)
-        import avenir_tpu
-        avenir_tpu.enable_x64()
+        _init_runtime()
         from .serve.server import serve_main
         return serve_main(rest)
     # --trace <out.json>: record core.obs spans for the whole job and
@@ -164,17 +228,7 @@ def main(argv=None) -> int:
         print("expected <input path> <output path>", file=sys.stderr)
         return 2
 
-    import os
-    plat = os.environ.get("AVENIR_PLATFORM")
-    if plat:
-        # pin the backend through the config API: the JAX_PLATFORMS env var
-        # alone is overridden by site TPU plugins (same as tests/conftest.py)
-        import jax
-        jax.config.update("jax_platforms", plat)
-
-    import avenir_tpu
-    avenir_tpu.enable_x64()
-
+    _init_runtime()
     config = load_job_config(defines, prefix)
     from .core import obs
     obs.configure_from_config(config, force_enable=bool(trace_path))
@@ -189,11 +243,7 @@ def main(argv=None) -> int:
     finally:
         # export even when the job raises or is interrupted — a trace of
         # the failing/slow run is the one the user most needs
-        if trace_path:
-            n = obs.get_tracer().export_chrome_trace(trace_path)
-            print(f"obs: wrote {n} trace events to {trace_path} "
-                  f"(open in chrome://tracing or ui.perfetto.dev)",
-                  file=sys.stderr)
+        _export_trace(trace_path)
     if isinstance(result, Counters):
         print(result.format(), file=sys.stderr)
         return 0

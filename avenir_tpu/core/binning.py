@@ -35,6 +35,19 @@ class ChunkedEncodeUnsupported(Exception):
     fall back to the one-shot ``encode_path``."""
 
 
+def _rows_hint(chunk: bytes) -> Optional[int]:
+    """Exact row count of a byte chunk when cheaply provable (no blank
+    lines), letting the native parser skip its csv_scan sizing pass;
+    None otherwise.  The newline count equals the parser's row count
+    only when no blank lines exist (csv_scan/csv_parse skip them);
+    blanks are rare (multi-file joins), so they just take the scan
+    pass."""
+    if b"\n\n" in chunk or chunk.startswith(b"\n"):
+        return None
+    n = chunk.count(b"\n")
+    return n if chunk.endswith(b"\n") else n + 1
+
+
 class Vocab:
     """Stable string->index mapping for one categorical column."""
 
@@ -267,7 +280,12 @@ class DatasetEncoder:
             first = fh.readline().rstrip("\n")
         if not first:
             return None
-        n_cols = first.count(delim) + 1
+        return self._specs_for_cols(first.count(delim) + 1)
+
+    def _specs_for_cols(self, n_cols: int):
+        """(specs, n_cols, id_ord) for the C encode of ``n_cols``-column
+        input, or None on a schema misfit."""
+        from .. import native
 
         specs = []
         for j, f in enumerate(self.feature_fields):
@@ -326,6 +344,41 @@ class DatasetEncoder:
         return self._assemble(x, values, y,
                               ids if ids is not None else [], [])
 
+    def encode_buffer_chunk(self, chunk: bytes, delim: str = ","):
+        """Native C encode of ONE raw byte chunk with the shared
+        vocabularies: ``(x, values, y, n)`` with raw (unshifted) bucket
+        bins — the per-chunk step of ``encode_path_chunks``, driven by a
+        caller-owned buffer (the multi-scan engine's shared byte scan).
+        Returns None when the native path does not apply (no C lib,
+        regex delimiter, schema misfit, parse failure) — callers fall
+        back to the Python columnar ``encode``."""
+        from .io import is_plain_delim
+        from .obs import get_tracer
+        from .pipeline import first_nonblank_line
+        from .. import native
+
+        if native.get_lib() is None or not is_plain_delim(delim):
+            return None
+        first = first_nonblank_line(chunk)
+        if not first:
+            F = len(self.feature_fields)
+            return (np.zeros((0, F), np.int32), np.zeros((0, F)),
+                    np.zeros(0, np.int32), 0)
+        sp = self._specs_for_cols(first.count(delim.encode()) + 1)
+        if sp is None:
+            return None
+        specs, n_cols, _ = sp
+        with get_tracer().span("ingest.parse", bytes=len(chunk),
+                               native=True):
+            res = native.encode_schema_buffer(
+                chunk, specs, n_cols, len(self.feature_fields),
+                self.class_field is not None, id_ordinal=-1, delim=delim,
+                n_rows_hint=_rows_hint(chunk))
+            if res is None:
+                return None
+            n, x, values, y, _ = self._remap_native(res)
+        return x, values, y, n
+
     def encode_path_chunks(self, path: str, delim: str = ",",
                            chunk_bytes: int = 48 << 20,
                            chunk_rows: Optional[int] = None):
@@ -368,13 +421,11 @@ class DatasetEncoder:
             buf = native._read_buffer(path)
         row_ends = None
         if chunk_rows is not None:
+            from .pipeline import row_chunk_ends
             chunk_rows = max(int(chunk_rows), 1)
-            nl = np.flatnonzero(np.frombuffer(buf, dtype=np.uint8)
-                                == ord("\n"))
-            # byte offset just past every chunk_rows-th line boundary
-            row_ends = list(nl[chunk_rows - 1::chunk_rows] + 1)
-            if not row_ends or row_ends[-1] < len(buf):
-                row_ends.append(len(buf))
+            # the shared boundary definition (multi-scan passes chunk the
+            # same buffer identically — load-bearing for parity)
+            row_ends = row_chunk_ends(buf, chunk_rows) if buf else []
         pos = 0
         while pos < len(buf):
             if row_ends is not None:
@@ -385,14 +436,7 @@ class DatasetEncoder:
                     nl = buf.find(b"\n", end)
                     end = len(buf) if nl < 0 else nl + 1
             chunk = buf[pos:end]
-            # the newline count equals the parser's row count only when no
-            # blank lines exist (csv_scan/csv_parse skip them); blanks are
-            # rare (multi-file joins), so they just take the scan pass
-            n_hint = None
-            if b"\n\n" not in chunk and not chunk.startswith(b"\n"):
-                n_hint = chunk.count(b"\n")
-                if not chunk.endswith(b"\n"):
-                    n_hint += 1
+            n_hint = _rows_hint(chunk)
             with tracer.span("ingest.parse", bytes=len(chunk)):
                 res = native.encode_schema_buffer(
                     chunk, specs, n_cols, len(self.feature_fields),

@@ -87,6 +87,15 @@ class JobConfig:
     def must_list(self, key: str, delim: str = ",", msg: Optional[str] = None) -> List[str]:
         return self.must(key, msg).split(delim)
 
+    # -- nested key groups -----------------------------------------------
+    def subkeys(self, prefix: str) -> Dict[str, str]:
+        """All props under ``prefix.`` with the prefix stripped — the
+        manifest-style nested key groups (e.g. core.multiscan's
+        ``multi.job.<id>.*`` per-job overrides)."""
+        p = prefix if prefix.endswith(".") else prefix + "."
+        return {k[len(p):]: v for k, v in self.props.items()
+                if k.startswith(p)}
+
     # -- common conventions ----------------------------------------------
     def field_delim_regex(self) -> str:
         return self.get("field.delim.regex", ",")

@@ -1,0 +1,501 @@
+"""Shared-scan job fusion: one streamed ingest pass feeding N fold jobs.
+
+avenir workflows chain several MapReduce jobs over the SAME input CSV —
+Naive Bayes counts, mutual information, correlation, Markov transition
+counts, attribute stats — and each re-reads the input from scratch
+(resource/*.sh in the reference; our rebuilt runbooks inherited the
+shape).  The cold end-to-end pipeline is host-ingest-bound (BENCH_r05:
+prefetch overlap buys 1.58x while the on-device fold sustains hundreds of
+M rows/s), so an N-job workflow pays N ingests for one file's worth of
+bytes.  Following MRShare's scan sharing for concurrent MapReduce jobs
+(Nykiel et al., VLDB 2010) and tf.data's input-pipeline amortization
+(Murray et al., VLDB 2021), this engine reads, parses, and H2D-copies
+each chunk ONCE and fans it out to every registered job's jitted fold —
+an N-job workflow costs ~one ingest.
+
+Three layers:
+
+- :class:`FoldSpec` — the protocol a fusable driver exports (via a
+  ``fold_spec(out_path)`` method): per-chunk host ``encode`` (runs on the
+  prefetch worker, may raise ``ChunkedEncodeUnsupported`` to bow out),
+  the jitted fold contract (``local_fn``/``static_args`` — the same
+  ``ops.counting`` shape ``core.pipeline.streaming_fold`` consumes, with
+  ``static_args`` sizeable from chunk 0 because folds compile lazily),
+  and ``finalize`` (emit the job's NORMAL output file from the folded
+  carry — byte-identical to a standalone run).
+- :class:`ChunkContext` — per-chunk memo shared across specs: jobs on
+  the same schema share one ``DatasetEncoder.encode`` AND one H2D copy
+  per chunk (the engine dedupes transfers by host-array identity).
+- :class:`MultiScanEngine` — runs the double-buffered prefetch reader
+  once per chunk (``core.pipeline`` reader + :class:`ChunkTransfer` /
+  :class:`ChunkFold`), dispatches the device-resident chunk to every
+  registered fold (each jitted + mesh-sharded via the shared
+  ``_fold_fns`` path, carries donated independently), emits per-job
+  ``multiscan.encode`` / ``multiscan.fold`` sub-spans and a
+  ``multiscan.fanout.width`` gauge per chunk, and finalizes each job.
+  A spec that bows out mid-stream (cap overflow, unsupported input) is
+  dropped from the fan-out and reported; the CLI re-runs it standalone
+  so the workflow's outputs are always complete and identical.
+
+The ``python -m avenir_tpu multi`` CLI drives this from a properties
+manifest (``multi.jobs=...`` with per-job class/conf/output keys); see
+:func:`load_manifest` and resource/multiscan/ for the runbook.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .binning import ChunkedEncodeUnsupported
+from .config import JobConfig, parse_properties
+from .metrics import Counters
+from .obs import get_tracer
+from . import pipeline
+
+
+class FoldSpec:
+    """One fusable job's slice of the shared scan.
+
+    Subclasses (exported by driver modules) override :meth:`encode` and
+    :meth:`finalize` and set the fold contract attributes.  ``local_fn``
+    may be None for host-only jobs (e.g. exact float moments, which are
+    deliberately computed on host — see models.bayesian's moments note):
+    such specs do all their work in ``encode`` and ``finalize``.
+    """
+
+    #: display/registry name (defaults to the class name of the driver)
+    name: str = "fold"
+    #: sharded per-chunk fold, ``local_fn(*shards, mask, *static_args)``
+    #: -> pytree (the ``ops.counting.sharded_reduce`` contract); None for
+    #: host-only specs
+    local_fn: Optional[Callable] = None
+    #: hashable static args for the fold — may be (re)assigned during the
+    #: FIRST ``encode`` call (folds compile after chunk 0's encode)
+    static_args: tuple = ()
+    #: arrays transferred once and replicated
+    broadcast_args: Sequence[np.ndarray] = ()
+    #: True: every chunk pads to the engine's fixed chunk capacity (one
+    #: compiled shape; transfers shared with other fixed specs); False:
+    #: variable-length outputs (e.g. flattened pair streams) bucket to
+    #: power-of-two extents
+    fixed_capacity: bool = True
+
+    def bind(self, engine: "MultiScanEngine") -> None:
+        """Called at registration — the hook where specs swap private
+        per-job state for engine-shared state (e.g. a shared
+        ``DatasetEncoder`` via :meth:`MultiScanEngine.shared_encoder`)."""
+
+    def encode(self, ctx: "ChunkContext") -> Optional[tuple]:
+        """Host-side work for one chunk: encode/guard through the shared
+        ``ctx`` views (``ctx.encoded(enc)`` for schema jobs — the native
+        C single-pass encode when available — or ``ctx.fields()`` for
+        raw field access) and return the tuple of host arrays to fold,
+        or None to skip the chunk (host-only specs return ``()`` to mark
+        it consumed).  Runs on the prefetch worker when depth >= 1.
+        Raise ``ChunkedEncodeUnsupported`` to withdraw from the fused
+        pass (the job is re-run standalone)."""
+        raise NotImplementedError
+
+    def finalize(self, carry) -> Counters:
+        """Emit the job's normal output file from the folded carry
+        (host-numpy pytree; None for host-only specs) — byte-identical
+        to the standalone driver's output."""
+        raise NotImplementedError
+
+
+class ChunkContext:
+    """One chunk's shared views, lazily built and memoized so N jobs cost
+    one parse: the raw bytes are always available; ``fields()`` parses
+    them once into a field matrix for whichever specs ask; ``encoded()``
+    schema-encodes them once per encoder — through the native C
+    single-pass kernel straight off the bytes when available (no Python
+    string ever materializes for schema-only job sets)."""
+
+    __slots__ = ("raw", "delim", "_tracer", "_memo")
+
+    def __init__(self, raw: bytes, delim: str, tracer=None):
+        self.raw = raw
+        self.delim = delim
+        self._tracer = tracer or get_tracer()
+        self._memo: dict = {}
+
+    def shared(self, key, build: Callable):
+        """Memoized ``build()`` — specs sharing a key (e.g. one encoder
+        object) compute the value once per chunk."""
+        if key not in self._memo:
+            self._memo[key] = build()
+        return self._memo[key]
+
+    def fields(self):
+        """The chunk parsed to fields: a 2-D string ndarray for
+        rectangular chunks (one bulk split), else a list of per-line
+        field lists; blank lines dropped.  Built once per chunk however
+        many specs consume it."""
+        return self.shared("fields", self._parse_fields)
+
+    def _parse_fields(self):
+        with self._tracer.span("ingest.parse", bytes=len(self.raw),
+                               native=False):
+            lines = [l for l in self.raw.decode().split("\n") if l]
+            fields, _ = pipeline.split_field_lines(lines, self.delim)
+            return fields
+
+    def columns(self, ordinals: Tuple[int, ...],
+                kinds: Optional[Tuple[int, ...]] = None):
+        """Just these file columns as typed arrays ``{ordinal: array}``
+        (``kinds`` per ordinal from ``native``'s INT64/FLOAT64/BYTES;
+        default BYTES), extracted by the native C parser without
+        materializing the full field matrix — the cheap path for jobs
+        that touch a handful of columns (correlation pairs, stats
+        attributes).  None when the native fast path does not apply:
+        callers fall back to ``fields()``."""
+        key = ("columns", tuple(ordinals),
+               tuple(kinds) if kinds is not None else None)
+        return self.shared(
+            key, lambda: self._parse_columns(tuple(ordinals), kinds))
+
+    def _parse_columns(self, ordinals, kinds):
+        from .io import is_plain_delim
+        from .. import native
+
+        if native.get_lib() is None or not is_plain_delim(self.delim):
+            return None
+        first = pipeline.first_nonblank_line(self.raw)
+        if not first:
+            return None
+        n_cols = first.count(self.delim.encode()) + 1
+        if not ordinals or max(ordinals) >= n_cols:
+            return None
+        col_types = [native.SKIP] * n_cols
+        for i, o in enumerate(ordinals):
+            col_types[o] = kinds[i] if kinds is not None else native.BYTES
+        with self._tracer.span("ingest.parse", bytes=len(self.raw),
+                               native=True, columns=len(ordinals)):
+            res = native.parse_csv_columns_buffer(self.raw, col_types,
+                                                  self.delim)
+        if res is None:
+            return None
+        return res[1]
+
+    def encoded(self, enc) -> tuple:
+        """``(x, values, y, n)`` schema-encode of this chunk through
+        ``enc`` (whose vocab state is shared across chunks): the native
+        C single-pass encode when available (raw, unshifted bucket bins
+        — callers own the negative-bin guard, as with
+        ``encode_path_chunks``), else the Python columnar encode of
+        ``fields()`` (which raises the same ``ChunkedEncodeUnsupported``
+        on a negative-bin column)."""
+        return self.shared(("encoded", id(enc)), lambda: self._encode(enc))
+
+    def _encode(self, enc):
+        res = enc.encode_buffer_chunk(self.raw, self.delim)
+        if res is not None:
+            return res
+        dsc = enc.encode(self.fields())
+        if (dsc.bin_offset != 0).any():
+            raise ChunkedEncodeUnsupported("negative bin")
+        return dsc.x, dsc.values, dsc.y, dsc.n_rows
+
+
+class _SpecFailure:
+    __slots__ = ("spec", "reason")
+
+    def __init__(self, spec: FoldSpec, reason: str):
+        self.spec = spec
+        self.reason = reason
+
+
+class MultiScanEngine:
+    """Runs the shared scan and fans each chunk out to every spec."""
+
+    def __init__(self, mesh=None, chunk_rows: int = pipeline.DEFAULT_CHUNK_ROWS,
+                 prefetch_depth: int = pipeline.DEFAULT_PREFETCH_DEPTH):
+        from ..parallel.mesh import get_mesh
+
+        if chunk_rows <= 0:
+            raise ValueError(f"chunk_rows must be positive: {chunk_rows}")
+        self.mesh = mesh or get_mesh()
+        self.chunk_rows = int(chunk_rows)
+        self.prefetch_depth = int(prefetch_depth)
+        self.specs: List[FoldSpec] = []
+        self.failures: List[_SpecFailure] = []
+        self._encoders: Dict[object, object] = {}
+
+    # -- registration ------------------------------------------------------
+    def register(self, spec: FoldSpec) -> FoldSpec:
+        self.specs.append(spec)
+        spec.bind(self)
+        return spec
+
+    def shared_encoder(self, key, enc):
+        """The canonical encoder for ``key`` (first registration wins).
+        Specs built from the same schema file hand in interchangeable
+        freshly-seeded encoders; sharing one object lets every chunk be
+        schema-encoded once for all of them."""
+        return self._encoders.setdefault(key, enc)
+
+    # -- the shared scan ---------------------------------------------------
+    def run(self, in_path: str, delim_regex: str = ",") -> Dict[str, Counters]:
+        """One streamed pass over ``in_path`` feeding every registered
+        spec; returns ``{spec.name: Counters}`` for specs that completed
+        fused.  Withdrawn specs are in :attr:`failures` — the caller
+        re-runs those standalone."""
+        tracer = get_tracer()
+        parent = tracer.current_span_id()
+        stager = pipeline.HostStager()
+        xfer_fixed = pipeline.ChunkTransfer(self.mesh,
+                                            capacity=self.chunk_rows,
+                                            stager=stager, tracer=tracer)
+        xfer_var = pipeline.ChunkTransfer(self.mesh, capacity=None,
+                                          stager=stager, tracer=tracer)
+        folds: Dict[FoldSpec, pipeline.ChunkFold] = {}
+        # `active` is mutated only by the encode side (worker thread when
+        # depth >= 1); the fold side learns about withdrawals implicitly
+        # (a withdrawn spec stops appearing in chunk items)
+        active: List[FoldSpec] = list(self.specs)
+        fed_any: set = set()
+
+        def encode_chunk(raw: bytes) -> list:
+            """(spec, device tuple | None) pairs for one raw byte chunk —
+            the parse+encode+H2D half, run on the prefetch worker."""
+            ctx = ChunkContext(raw, delim_regex, tracer)
+            items: list = []
+            for spec in list(active):
+                try:
+                    with tracer.span("multiscan.encode", job=spec.name):
+                        arrs = spec.encode(ctx)
+                    if arrs is None:
+                        continue
+                    if spec.local_fn is None:
+                        items.append((spec, None))
+                        continue
+                    xfer = xfer_fixed if spec.fixed_capacity else xfer_var
+                    # the memoized value PINS the host arrays alongside
+                    # the device tuple: the id()-based key is only
+                    # unambiguous while every keyed array stays alive
+                    # for the chunk
+                    arrs = tuple(arrs)
+                    _, dev = ctx.shared(
+                        ("h2d", tuple(id(a) for a in arrs),
+                         spec.fixed_capacity),
+                        lambda: (arrs, xfer(arrs)))
+                except Exception as exc:  # noqa: BLE001 — withdrawal,
+                    # not abort: ANY per-spec encode/transfer failure
+                    # (cap overflow, unparseable value, unknown symbol,
+                    # a misbehaving FoldSpec's mismatched shapes)
+                    # withdraws that job only; the co-scheduled healthy
+                    # jobs keep their shared scan, and the standalone
+                    # re-run reproduces the job's own success or error
+                    active.remove(spec)
+                    reason = (str(exc) if isinstance(
+                        exc, ChunkedEncodeUnsupported)
+                        else f"{type(exc).__name__}: {exc}")
+                    self.failures.append(_SpecFailure(spec, reason))
+                    continue
+                items.append((spec, dev))
+            return items
+
+        def fold_items(items: list) -> None:
+            tracer.gauge("multiscan.fanout.width", len(items))
+            for spec, dev in items:
+                fed_any.add(spec)
+                if dev is None:
+                    continue
+                cf = folds.get(spec)
+                if cf is None:
+                    # created at the spec's FIRST fold, after its first
+                    # encode sized static_args from chunk 0
+                    cf = folds[spec] = pipeline.ChunkFold(
+                        spec.local_fn, static_args=spec.static_args,
+                        broadcast_args=spec.broadcast_args, mesh=self.mesh,
+                        tracer=tracer, parent=parent,
+                        span_name="multiscan.fold",
+                        span_attrs={"job": spec.name})
+                cf.fold(dev)
+
+        chunks = pipeline.iter_byte_chunks(in_path, self.chunk_rows)
+        if self.prefetch_depth <= 0:
+            # strict serial reference: encode + fold + BLOCK, per chunk
+            def consume(items):
+                fold_items(items)
+                for cf in folds.values():
+                    cf.block()
+        else:
+            consume = fold_items
+        pipeline.drive_prefetched(chunks, encode_chunk, consume,
+                                  self.prefetch_depth, tracer=tracer,
+                                  parent=parent,
+                                  thread_name="avenir-multiscan-prefetch")
+
+        # -- finalize every surviving spec --------------------------------
+        results: Dict[str, Counters] = {}
+        for spec in list(active):
+            carry = folds[spec].result() if spec in folds else None
+            if spec.local_fn is not None and carry is None:
+                # device spec that never folded a chunk (empty stream /
+                # every chunk skipped): no fused result — run standalone
+                active.remove(spec)
+                self.failures.append(_SpecFailure(spec, "empty stream"))
+                continue
+            if spec.local_fn is None and spec not in fed_any:
+                active.remove(spec)
+                self.failures.append(_SpecFailure(spec, "empty stream"))
+                continue
+            try:
+                with tracer.span("multiscan.finalize", job=spec.name):
+                    results[spec.name] = spec.finalize(carry)
+            except Exception as exc:  # noqa: BLE001 — one job's emit
+                # failure (e.g. unwritable output path) must not cost the
+                # other jobs their outputs; the standalone re-run
+                # reproduces and surfaces this job's own error
+                active.remove(spec)
+                self.failures.append(_SpecFailure(
+                    spec, f"finalize failed: {type(exc).__name__}: {exc}"))
+        return results
+
+
+# ---------------------------------------------------------------------------
+# properties-file manifest (the `multi` CLI job)
+# ---------------------------------------------------------------------------
+
+#: streaming-fold consumers that deliberately do NOT export a FoldSpec —
+#: the tier-2 lint (tests/test_multiscan_coverage.py) requires every
+#: other consumer to export one
+NON_FUSABLE: Dict[str, str] = {
+    "DecisionTreeBuilder":
+        "iterative multi-level growth: each level's fold is keyed by the "
+        "previous level's routing decisions, so one shared scan cannot "
+        "feed all levels",
+    "FrequentItemsApriori":
+        "k-pass pipeline: pass k's candidate itemsets derive from pass "
+        "k-1's output file, so the passes cannot share one scan",
+}
+
+
+class JobEntry:
+    """One manifest job: its driver instance, FoldSpec (if fusable under
+    the current config), and output path."""
+
+    __slots__ = ("jid", "cls_name", "job", "spec", "out_path")
+
+    def __init__(self, jid, cls_name, job, spec, out_path):
+        self.jid = jid
+        self.cls_name = cls_name
+        self.job = job
+        self.spec = spec
+        self.out_path = out_path
+
+
+def load_manifest(config: JobConfig, out_base: Optional[str],
+                  resolver: Callable) -> List[JobEntry]:
+    """Build per-job drivers from a ``multi.*`` manifest.
+
+    Keys::
+
+        multi.jobs=nb,mi,corr                # required: job ids, in order
+        multi.job.<id>.class=<JobClass>      # required: short or FQCN
+        multi.job.<id>.conf.path=<props>     # optional per-job file
+        multi.job.<id>.output.path=<dir>     # optional (default
+                                             #   <out_base>/<id>)
+        multi.job.<id>.<key>=<value>         # inline per-job overrides
+
+    Each job's config = the manifest's non-``multi.*`` keys, overlaid by
+    its conf file, overlaid by its inline keys — wrapped with the job's
+    registry prefix (``resolver`` returns the CLI registry's
+    ``(factory, prefix)``).  All jobs must agree on ``field.delim.regex``
+    (one scan, one parse).
+    """
+    ids = [s.strip() for s in config.must("multi.jobs").split(",") if s.strip()]
+    if not ids:
+        raise SystemExit("multi.jobs is empty")
+    if len(set(ids)) != len(ids):
+        raise SystemExit(f"duplicate job ids in multi.jobs: {ids}")
+    shared_delim = config.field_delim_regex()
+    base_props = {k: v for k, v in config.props.items()
+                  if not k.startswith("multi.")}
+    entries: List[JobEntry] = []
+    for jid in ids:
+        cls_name = config.must(f"multi.job.{jid}.class")
+        props = dict(base_props)
+        conf_path = config.get(f"multi.job.{jid}.conf.path")
+        if conf_path:
+            with open(conf_path, "r") as fh:
+                props.update(parse_properties(fh.read()))
+        reserved = ("class", "conf.path", "output.path")
+        for k, v in config.subkeys(f"multi.job.{jid}").items():
+            if k not in reserved:
+                props[k] = v
+        factory, prefix = resolver(cls_name)
+        job_cfg = JobConfig(props, prefix)
+        if job_cfg.field_delim_regex() != shared_delim:
+            raise SystemExit(
+                f"multi job {jid!r}: field.delim.regex "
+                f"{job_cfg.field_delim_regex()!r} differs from the shared "
+                f"scan's {shared_delim!r} (one scan = one parse)")
+        out_path = config.get(f"multi.job.{jid}.output.path")
+        if out_path is None:
+            if out_base is None:
+                raise SystemExit(
+                    f"multi job {jid!r}: no multi.job.{jid}.output.path "
+                    f"and no <out> CLI argument to derive it from")
+            out_path = os.path.join(out_base, jid)
+        job = factory(job_cfg)
+        spec_fn = getattr(job, "fold_spec", None)
+        spec = spec_fn(out_path) if spec_fn is not None else None
+        entries.append(JobEntry(jid, cls_name, job, spec, out_path))
+    return entries
+
+
+def run_multi(config: JobConfig, in_path: str, out_base: Optional[str],
+              resolver: Callable, mesh=None,
+              log=None) -> Dict[str, Counters]:
+    """Execute a ``multi.*`` manifest: fused shared scan for every
+    fusable job, standalone re-runs for the rest (non-fusable classes,
+    configs the specs cannot serve, mid-stream withdrawals) — the
+    workflow's outputs are complete and byte-identical to running each
+    job separately either way."""
+    tracer = get_tracer()
+    entries = load_manifest(config, out_base, resolver)
+    engine = MultiScanEngine(
+        mesh=mesh,
+        chunk_rows=config.pipeline_chunk_rows(
+            default=pipeline.DEFAULT_CHUNK_ROWS),
+        prefetch_depth=config.pipeline_prefetch_depth())
+    fused: Dict[str, JobEntry] = {}
+    standalone: List[Tuple[JobEntry, str]] = []
+    for e in entries:
+        if e.spec is None:
+            standalone.append((e, "no FoldSpec under this class/config"))
+            continue
+        e.spec.name = e.jid
+        engine.register(e.spec)
+        fused[e.jid] = e
+
+    results: Dict[str, Counters] = {}
+    with tracer.span("multiscan.scan", jobs=",".join(fused)):
+        results.update(engine.run(in_path, config.field_delim_regex()))
+    for failure in engine.failures:
+        standalone.append((fused[failure.spec.name], failure.reason))
+
+    first_error = None
+    for e, reason in standalone:
+        if log is not None:
+            log(f"multiscan: job {e.jid!r} ({e.cls_name}) running "
+                f"standalone: {reason}")
+        try:
+            with tracer.span("multiscan.standalone", job=e.jid):
+                results[e.jid] = e.job.run(in_path, e.out_path, mesh=mesh)
+        except Exception as exc:  # noqa: BLE001 — finish the other jobs
+            # first, then surface this job's own error: one bad job must
+            # not cost the rest of the workflow their outputs
+            if log is not None:
+                log(f"multiscan: job {e.jid!r} failed standalone: "
+                    f"{type(exc).__name__}: {exc}")
+            if first_error is None:
+                first_error = exc
+    if first_error is not None:
+        raise first_error
+    return results

@@ -243,6 +243,17 @@ class Tracer:
         return [r for r in self.records() if isinstance(r, Span)
                 and (name is None or r.name == name)]
 
+    def span_summary(self, name: str) -> Dict[str, float]:
+        """Aggregate duration stats for spans named ``name`` — the quick
+        way to compare per-chunk host costs (e.g. ``ingest.parse`` vs
+        ``ingest.h2d`` across two pipeline configurations) without
+        exporting a full trace."""
+        spans = self.spans(name)
+        total_ns = sum(s.dur_ns for s in spans)
+        n = len(spans)
+        return {"count": n, "total_ms": total_ns / 1e6,
+                "mean_ms": (total_ns / n / 1e6) if n else 0.0}
+
     def clear(self) -> None:
         with self._lock:
             self._buf.clear()

@@ -131,36 +131,90 @@ def iter_line_chunks(path: str, chunk_rows: int) -> Iterator[List[str]]:
         yield buf
 
 
-def iter_field_chunks(path: str, delim_regex: str,
-                      chunk_rows: int) -> Iterator[object]:
-    """Row chunks as 2-D string ndarrays via ONE whole-chunk split (the
-    ``read_field_matrix`` bulk parser, per chunk): the vectorized ingest
-    fast path for plain single-character delimiters.  Ragged chunks or
-    regex delimiters degrade to per-line field lists — callers treat both
-    shapes uniformly (ndarray column indexing vs list indexing is hidden
-    behind ``DatasetEncoder.encode``)."""
+def split_field_lines(lines: List[str], delim_regex: str):
+    """(fields, bulk) for a chunk of non-blank record lines — THE
+    chunk-to-fields definition shared by ``iter_field_chunks`` and the
+    multi-scan engine's ``ChunkContext.fields``: a 2-D string ndarray via
+    ONE whole-chunk split (the ``read_field_matrix`` bulk parser, per
+    chunk) when the delimiter is a plain single character and the chunk
+    is rectangular, else per-line field lists (``bulk`` False).  Callers
+    treat both shapes uniformly (ndarray column indexing vs list
+    indexing is hidden behind ``DatasetEncoder.encode``)."""
     from .io import is_plain_delim, split_line
 
+    if is_plain_delim(delim_regex) and lines:
+        n_delim = lines[0].count(delim_regex)
+        if all(l.count(delim_regex) == n_delim for l in lines):
+            flat = delim_regex.join(lines).split(delim_regex)
+            return (np.asarray(flat, dtype=str).reshape(
+                len(lines), n_delim + 1), True)
+    return [split_line(l, delim_regex) for l in lines], False
+
+
+def iter_field_chunks(path: str, delim_regex: str,
+                      chunk_rows: int) -> Iterator[object]:
+    """Row chunks through ``split_field_lines`` — the vectorized ingest
+    fast path for plain single-character delimiters, degrading to
+    per-line field lists for ragged chunks or regex delimiters."""
     tracer = get_tracer()
-    plain = is_plain_delim(delim_regex)
     for lines in iter_line_chunks(path, chunk_rows):
         t0 = time.perf_counter_ns()
-        if plain:
-            n_delim = lines[0].count(delim_regex)
-            if all(l.count(delim_regex) == n_delim for l in lines):
-                flat = delim_regex.join(lines).split(delim_regex)
-                arr = np.asarray(flat, dtype=str).reshape(
-                    len(lines), n_delim + 1)
-                tracer.record_span("ingest.parse", t0,
-                                   time.perf_counter_ns() - t0,
-                                   rows=len(lines), bulk=True)
-                yield arr
-                continue
-        recs = [split_line(l, delim_regex) for l in lines]
+        fields, bulk = split_field_lines(lines, delim_regex)
         tracer.record_span("ingest.parse", t0,
                            time.perf_counter_ns() - t0,
-                           rows=len(lines), bulk=False)
-        yield recs
+                           rows=len(lines), bulk=bulk)
+        yield fields
+
+
+def row_chunk_ends(buf: bytes, chunk_rows: int) -> List[int]:
+    """Byte offsets just past every ``chunk_rows``-th line boundary of
+    ``buf`` (plus the buffer end) — THE chunk-boundary definition shared
+    by ``DatasetEncoder.encode_path_chunks`` and ``iter_byte_chunks``, so
+    a fused multi-scan pass and a standalone native-encode pass see
+    identical chunking (load-bearing for e.g. float-moment accumulation
+    order parity).  Blank lines count toward a chunk's line budget but
+    not its parsed rows."""
+    nl = np.flatnonzero(np.frombuffer(buf, dtype=np.uint8) == ord("\n"))
+    ends = [int(e) for e in nl[chunk_rows - 1::chunk_rows] + 1]
+    if not ends or ends[-1] < len(buf):
+        ends.append(len(buf))
+    return ends
+
+
+def first_nonblank_line(chunk: bytes) -> bytes:
+    """The first non-empty line of a byte chunk (b"" if none), via a
+    bounded find-based scan — NOT a whole-chunk split: column-count
+    sniffing runs per chunk on the hot ingest path, where materializing
+    ~chunk_rows throwaway line objects would rival the parse itself."""
+    pos = 0
+    while pos < len(chunk):
+        nl = chunk.find(b"\n", pos)
+        if nl < 0:
+            return chunk[pos:]
+        if nl > pos:
+            return chunk[pos:nl]
+        pos = nl + 1
+    return b""
+
+
+def iter_byte_chunks(path: str, chunk_rows: int) -> Iterator[bytes]:
+    """Raw byte chunks split at ``row_chunk_ends`` boundaries.  The whole
+    byte buffer is read once (host memory is O(file), matching the
+    native ingest; DEVICE residency stays O(chunk))."""
+    from ..native import _read_buffer
+
+    if chunk_rows <= 0:
+        raise ValueError(f"chunk_rows must be positive: {chunk_rows}")
+    tracer = get_tracer()
+    with tracer.span("ingest.read", path=path):
+        buf = _read_buffer(path)
+    if not buf:
+        return
+    pos = 0
+    for end in row_chunk_ends(buf, chunk_rows):
+        if end > pos:
+            yield buf[pos:end]
+        pos = end
 
 
 def peek(it: Iterable):
@@ -186,18 +240,32 @@ def peek(it: Iterable):
 
 # Compiled (first, accumulate) step pairs keyed like ops.counting's reduce
 # cache: a stable local_fn object + static args lets every chunk (and every
-# training run) hit the jit cache.
+# training run) hit the jit cache.  The memo is a bounded LRU
+# (utils.caches): a long-lived process running many jobs — the multi-scan
+# engine fans one scan out to N folds, and a serving or notebook process
+# may train against many meshes/shapes — would otherwise accumulate
+# compiled executables without limit.
 _fold_cache: dict = {}
+_FOLD_CACHE_CAP = 32
+
+
+def clear_fold_cache() -> None:
+    """Explicitly drop every compiled fold pair (the clear hook for hosts
+    that want deterministic release of compiled executables, e.g. between
+    unrelated multi-job batches)."""
+    from ..utils.caches import bounded_cache_clear
+    bounded_cache_clear(_fold_cache)
 
 
 def _fold_fns(local_fn: Callable, mesh, static_args: tuple,
               ndims: Tuple[int, ...], n_bcast: int):
     import jax
     from ..parallel.mesh import shard_map
+    from ..utils.caches import bounded_cache_get, bounded_cache_put
     from jax.sharding import PartitionSpec as P
 
     key = (local_fn, mesh, static_args, ndims, n_bcast)
-    fns = _fold_cache.get(key)
+    fns = bounded_cache_get(_fold_cache, key)
     if fns is not None:
         return fns
     axes = tuple(mesh.axis_names)
@@ -228,7 +296,7 @@ def _fold_fns(local_fn: Callable, mesh, static_args: tuple,
                                out_specs=P()),
                      donate_argnums=0)
     fns = (first_fn, acc_fn)
-    _fold_cache[key] = fns
+    bounded_cache_put(_fold_cache, key, fns, cap=_FOLD_CACHE_CAP)
     return fns
 
 
@@ -253,6 +321,288 @@ class _PrefetchError:
 
 
 _DONE = object()
+
+
+def drive_prefetched(chunks: Iterable, produce: Callable, consume: Callable,
+                     depth: int, tracer=None, parent=None,
+                     thread_name: str = "avenir-ingest-prefetch") -> None:
+    """Run ``consume(produce(chunk))`` over a chunk stream — serially
+    when ``depth <= 0``, else with ``produce`` (parse + H2D transfer) on
+    a worker thread feeding a bounded queue of ``depth`` items so it
+    overlaps ``consume`` (the device fold dispatch).  The one
+    producer/queue/shutdown protocol shared by ``streaming_fold`` and
+    the multi-scan engine: exceptions from either side propagate to the
+    caller, and teardown signals the producer then drains until any
+    blocked put frees."""
+    tracer = tracer or get_tracer()
+    if depth <= 0:
+        for item in chunks:
+            consume(produce(item))
+        return
+
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+
+    def worker():
+        tracer.adopt(parent)
+        try:
+            for item in chunks:
+                # consumer died (fold error / Ctrl-C): stop parsing
+                # and transferring chunks nobody will fold
+                if stop.is_set():
+                    return
+                # produce() returns as soon as its H2D transfers are
+                # enqueued; the bounded queue keeps at most `depth`
+                # chunks live ahead of the consumer
+                q.put(produce(item))
+                tracer.gauge("ingest.prefetch.queue.depth", q.qsize())
+            q.put(_DONE)
+        except BaseException as exc:  # noqa: BLE001 — relayed to caller
+            q.put(_PrefetchError(exc))
+
+    t = threading.Thread(target=worker, daemon=True, name=thread_name)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _DONE:
+                break
+            if isinstance(item, _PrefetchError):
+                raise item.exc
+            tracer.gauge("ingest.prefetch.queue.depth", q.qsize())
+            consume(item)
+    finally:
+        # signal the producer to quit, then drain (a blocking get
+        # with timeout, not a busy spin) until any put it is stuck
+        # on has been freed and the loop's stop check fired
+        stop.set()
+        while t.is_alive():
+            try:
+                q.get(timeout=0.05)
+            except queue.Empty:
+                pass
+        t.join()
+
+
+# ---------------------------------------------------------------------------
+# host staging buffers (reused across chunks)
+# ---------------------------------------------------------------------------
+
+def _dev_aliases_buf(dev, buf: np.ndarray) -> bool:
+    """Whether any shard of device array ``dev`` aliases host buffer
+    ``buf``'s memory (``device_put`` zero-copies sufficiently-aligned host
+    ndarrays on the CPU backend — per buffer, depending on its alignment).
+    Unprovable -> True (never reuse a buffer we cannot prove was copied
+    out of)."""
+    try:
+        lo = buf.ctypes.data
+        hi = lo + buf.nbytes
+        for sh in dev.addressable_shards:
+            p = sh.data.unsafe_buffer_pointer()
+            if lo <= p < hi:
+                return True
+        return False
+    except Exception:
+        return True
+
+
+class HostStager:
+    """Reusable host staging buffers for padded chunk uploads.
+
+    The transfer step pads every chunk to its bucketed extent; allocating
+    (and first-touch faulting) a fresh padded matrix + mask per chunk was
+    measurable allocator churn on the hot ingest path.  One buffer per
+    (target rows, tail shape, dtype) is kept and overwritten each chunk.
+    Reuse is sound only when the previous ``device_put`` COPIED the
+    buffer: after each put the caller reports the device array via
+    :meth:`committed`, which checks the shard buffer pointers — an
+    aliasing (zero-copy) put hands the buffer's ownership to the device
+    array and retires the slot, so accelerator backends (H2D always
+    copies) reuse every chunk while an aliasing CPU put degrades to the
+    old allocate-per-chunk behavior instead of corrupting live arrays.
+    Before a reuse, the previous device array is ``block_until_ready``-ed
+    so the copy out of the buffer has completed.
+
+    ``force_copy=True`` allocates deliberately misaligned buffers, which
+    XLA must copy on every backend — the testable-everywhere mode (and a
+    sound default for callers that prefer guaranteed reuse over a chance
+    at zero-copy puts).
+
+    NOT thread-safe: one stager per transfer stream (the prefetch worker
+    or the serial loop — exactly one thread ever stages chunks).
+    """
+
+    __slots__ = ("_slots", "_by_id", "reuses", "force_copy")
+
+    def __init__(self, force_copy: bool = False):
+        self._slots: dict = {}
+        self._by_id: dict = {}
+        self.reuses = 0
+        self.force_copy = force_copy
+
+    def _alloc(self, shape: tuple, dtype) -> np.ndarray:
+        if not self.force_copy:
+            return np.zeros(shape, dtype=dtype)
+        # odd-address view: fails any >1-byte alignment requirement, so
+        # device_put cannot zero-copy it and reuse is always sound
+        dtype = np.dtype(dtype)
+        nbytes = int(np.prod(shape)) * dtype.itemsize
+        raw = np.zeros(nbytes + 2, dtype=np.uint8)
+        off = 1 if raw.ctypes.data % 2 == 0 else 2
+        return raw[off:off + nbytes].view(dtype).reshape(shape)
+
+    def _buffer(self, key, shape: tuple, dtype) -> np.ndarray:
+        slot = self._slots.get(key)
+        if slot is None:
+            buf = self._alloc(shape, dtype)
+            slot = [buf, None]
+            self._slots[key] = slot
+            self._by_id[id(buf)] = slot
+            return buf
+        if slot[1] is not None:
+            slot[1].block_until_ready()
+            slot[1] = None
+        self.reuses += 1
+        return slot[0]
+
+    def stage(self, a: np.ndarray, target: int,
+              tag: int = 0) -> np.ndarray:
+        """``a`` padded with zero rows to ``target`` leading extent, in a
+        reused buffer when possible.  ``target == len(a)`` returns ``a``
+        itself (nothing to pad).  ``tag`` distinguishes same-shaped
+        sibling arrays within one transfer (e.g. Markov's three int32
+        pair streams): each position gets its own slot, so staging one
+        never blocks on a sibling's still-in-flight copy — only on its
+        OWN buffer's previous-chunk copy."""
+        n = a.shape[0]
+        if n == target:
+            return a
+        shape = (target,) + a.shape[1:]
+        buf = self._buffer((shape, a.dtype.str, tag), shape, a.dtype)
+        buf[:n] = a
+        buf[n:] = 0
+        return buf
+
+    def mask(self, n: int, target: int) -> np.ndarray:
+        """Validity mask: True for the first ``n`` of ``target`` rows."""
+        buf = self._buffer(((target,), "mask"), (target,), bool)
+        buf[:n] = True
+        buf[n:] = False
+        return buf
+
+    def committed(self, buf, dev) -> None:
+        """Record the device array produced from ``buf``; if the put
+        ALIASED the buffer instead of copying, retire the slot (the
+        device array owns that memory now — it must never be mutated)."""
+        slot = self._by_id.get(id(buf))
+        if slot is None:
+            return
+        if _dev_aliases_buf(dev, buf):
+            for key, s in list(self._slots.items()):
+                if s is slot:
+                    del self._slots[key]
+            del self._by_id[id(buf)]
+        else:
+            slot[1] = dev
+
+
+class ChunkTransfer:
+    """Pads a chunk's host arrays to the bucketed extent, appends the
+    validity mask, and places everything row-sharded on the mesh with
+    async ``device_put`` — the H2D half of the streaming fold, reusable
+    across folds (the multi-scan engine hands ONE transferred chunk to
+    several folds).  Owns a :class:`HostStager` so padded staging buffers
+    are reused across chunks."""
+
+    def __init__(self, mesh, capacity: Optional[int] = None,
+                 stager: Optional[HostStager] = None, tracer=None):
+        self.mesh = mesh
+        self.capacity = capacity
+        self.stager = stager or HostStager()
+        self.tracer = tracer or get_tracer()
+        self._d = int(mesh.devices.size)
+
+    def _row_sharding(self, ndim: int):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        axes = tuple(self.mesh.axis_names)
+        return NamedSharding(self.mesh, P(axes, *([None] * (ndim - 1))))
+
+    def __call__(self, arrs: Tuple[np.ndarray, ...]) -> tuple:
+        import jax
+
+        with self.tracer.span("ingest.h2d",
+                              staged_reuses=self.stager.reuses):
+            arrs = tuple(np.asarray(a) for a in arrs)
+            n = arrs[0].shape[0]
+            for a in arrs:
+                if a.shape[0] != n:
+                    raise ValueError("chunk arrays disagree on row count")
+            target = _bucket_rows(n, self._d, self.capacity)
+            out = []
+            for i, a in enumerate(arrs):
+                buf = self.stager.stage(a, target, tag=i)
+                dev = jax.device_put(buf, self._row_sharding(a.ndim))
+                if buf is not a:
+                    self.stager.committed(buf, dev)
+                out.append(dev)
+            mbuf = self.stager.mask(n, target)
+            mdev = jax.device_put(mbuf, self._row_sharding(1))
+            self.stager.committed(mbuf, mdev)
+            out.append(mdev)
+            return tuple(out)
+
+
+class ChunkFold:
+    """One stream's donated-carry fold state: compiles the (first,
+    accumulate) pair lazily on the first chunk (so callers may size
+    ``static_args`` from chunk 0 before any fold runs) and accumulates
+    ``carry = carry + psum(local_fn(chunk))`` in place."""
+
+    def __init__(self, local_fn: Callable, static_args: tuple = (),
+                 broadcast_args: Sequence[np.ndarray] = (), mesh=None,
+                 tracer=None, parent=None, span_name: str = "ingest.fold",
+                 span_attrs: Optional[dict] = None):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.mesh import get_mesh
+
+        self.mesh = mesh or get_mesh()
+        self.local_fn = local_fn
+        self.static_args = static_args
+        self.tracer = tracer or get_tracer()
+        self.parent = parent
+        self.span_name = span_name
+        self.span_attrs = span_attrs or {}
+        self.bcast_dev = tuple(
+            jax.device_put(np.asarray(b), NamedSharding(self.mesh, P()))
+            for b in broadcast_args)
+        self.carry = None
+        self._fns = None
+
+    def fold(self, dev: tuple) -> None:
+        with self.tracer.span(self.span_name, parent=self.parent,
+                              **self.span_attrs):
+            if self._fns is None:
+                self._fns = _fold_fns(self.local_fn, self.mesh,
+                                      tuple(self.static_args),
+                                      tuple(a.ndim for a in dev[:-1]),
+                                      len(self.bcast_dev))
+            if self.carry is None:
+                self.carry = self._fns[0](*dev, *self.bcast_dev)
+            else:
+                self.carry = self._fns[1](self.carry, *dev, *self.bcast_dev)
+
+    def block(self) -> None:
+        import jax
+        if self.carry is not None:
+            self.carry = jax.block_until_ready(self.carry)
+
+    def result(self):
+        """The carry pytree as host numpy arrays (None if nothing folded)."""
+        import jax
+        if self.carry is None:
+            return None
+        return jax.tree_util.tree_map(np.asarray, self.carry)
 
 
 def streaming_fold(chunks: Iterable[Tuple[np.ndarray, ...]],
@@ -287,110 +637,26 @@ def streaming_fold(chunks: Iterable[Tuple[np.ndarray, ...]],
     ``ChunkedEncodeUnsupported``) propagate to the caller regardless of
     which thread raised them.
     """
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
     from ..parallel.mesh import get_mesh
 
     mesh = mesh or get_mesh()
-    d = int(mesh.devices.size)
-    axes = tuple(mesh.axis_names)
     tracer = get_tracer()
     # worker-thread spans (H2D copies + the read/parse work the chunk
     # generator does on that thread) parent under the caller's open span
     parent = tracer.current_span_id()
 
-    def row_sharding(ndim):
-        return NamedSharding(mesh, P(axes, *([None] * (ndim - 1))))
-
-    bcast_dev = tuple(
-        jax.device_put(np.asarray(b), NamedSharding(mesh, P()))
-        for b in broadcast_args)
-
-    def transfer(arrs):
-        with tracer.span("ingest.h2d"):
-            arrs = tuple(np.asarray(a) for a in arrs)
-            n = arrs[0].shape[0]
-            for a in arrs:
-                if a.shape[0] != n:
-                    raise ValueError("chunk arrays disagree on row count")
-            target = _bucket_rows(n, d, capacity)
-            mask = np.zeros(target, dtype=bool)
-            mask[:n] = True
-            out = []
-            for a in arrs:
-                if target != n:
-                    pad = np.zeros((target - n,) + a.shape[1:], dtype=a.dtype)
-                    a = np.concatenate([a, pad])
-                out.append(jax.device_put(a, row_sharding(a.ndim)))
-            out.append(jax.device_put(mask, row_sharding(1)))
-            return tuple(out)
-
-    carry = None
-    fns = None
-
-    def fold(dev):
-        nonlocal carry, fns
-        with tracer.span("ingest.fold", parent=parent):
-            if fns is None:
-                fns = _fold_fns(local_fn, mesh, static_args,
-                                tuple(a.ndim for a in dev[:-1]),
-                                len(bcast_dev))
-            if carry is None:
-                carry = fns[0](*dev, *bcast_dev)
-            else:
-                carry = fns[1](carry, *dev, *bcast_dev)
+    transfer = ChunkTransfer(mesh, capacity=capacity, tracer=tracer)
+    cf = ChunkFold(local_fn, static_args=static_args,
+                   broadcast_args=broadcast_args, mesh=mesh, tracer=tracer,
+                   parent=parent)
 
     if prefetch_depth <= 0:
         # strict serial: parse -> transfer -> fold -> BLOCK, per chunk
-        for item in chunks:
-            fold(transfer(item))
-            carry = jax.block_until_ready(carry)
+        def consume(dev):
+            cf.fold(dev)
+            cf.block()
     else:
-        q: queue.Queue = queue.Queue(maxsize=prefetch_depth)
-        stop = threading.Event()
-
-        def worker():
-            tracer.adopt(parent)
-            try:
-                for item in chunks:
-                    # consumer died (fold error / Ctrl-C): stop parsing
-                    # and transferring chunks nobody will fold
-                    if stop.is_set():
-                        return
-                    # device_put here is the overlapped H2D copy: it
-                    # returns as soon as the transfer is enqueued, and
-                    # the bounded queue keeps at most `depth` chunks live
-                    q.put(transfer(item))
-                    tracer.gauge("ingest.prefetch.queue.depth", q.qsize())
-                q.put(_DONE)
-            except BaseException as exc:  # noqa: BLE001 — relayed to caller
-                q.put(_PrefetchError(exc))
-
-        t = threading.Thread(target=worker, daemon=True,
-                             name="avenir-ingest-prefetch")
-        t.start()
-        try:
-            while True:
-                item = q.get()
-                if item is _DONE:
-                    break
-                if isinstance(item, _PrefetchError):
-                    raise item.exc
-                tracer.gauge("ingest.prefetch.queue.depth", q.qsize())
-                fold(item)
-        finally:
-            # signal the producer to quit, then drain (a blocking get
-            # with timeout, not a busy spin) until any put it is stuck
-            # on has been freed and the loop's stop check fired
-            stop.set()
-            while t.is_alive():
-                try:
-                    q.get(timeout=0.05)
-                except queue.Empty:
-                    pass
-            t.join()
-
-    if carry is None:
-        return None
-    return jax.tree_util.tree_map(np.asarray, carry)
+        consume = cf.fold
+    drive_prefetched(chunks, transfer, consume, prefetch_depth,
+                     tracer=tracer, parent=parent)
+    return cf.result()

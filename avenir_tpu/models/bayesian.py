@@ -48,6 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.binning import DatasetEncoder, EncodedDataset
+from ..core.multiscan import FoldSpec as MultiScanFoldSpec
 from ..core.obs import get_tracer, traced_run
 from ..core.config import JobConfig
 from ..core.io import read_lines, split_line, write_output
@@ -185,6 +186,77 @@ def _host_moments(values: np.ndarray, y: np.ndarray, n_class: int,
 # trainer
 # ---------------------------------------------------------------------------
 
+class _NBStreamState:
+    """Cap sizing, per-chunk guards, and host-moment accumulation shared
+    by the standalone streamed trainer (``_train_streamed``) and the
+    shared-scan FoldSpec (``fold_spec``) — one definition of the stream
+    contract so the two paths cannot drift."""
+
+    def __init__(self, enc: DatasetEncoder):
+        ffields = enc.feature_fields
+        self.enc = enc
+        self.F = len(ffields)
+        self.binned = [j for j, f in enumerate(ffields)
+                       if f.is_categorical() or f.is_bucket_width_defined()]
+        self.cont_cols = [j for j in range(self.F) if j not in self.binned]
+        self.bucket_cols = [j for j, f in enumerate(ffields)
+                            if f.is_bucket_width_defined()]
+        self.declared = [f.num_bins() if (f.is_bucket_width_defined()
+                                          and f.max is not None) else 0
+                         for f in ffields]
+        self.mom_acc: Dict[int, np.ndarray] = {}
+        self.num_bins_seen = np.zeros(self.F, dtype=np.int64)
+        self.n_chunks = 0
+        self.bins_cap: Optional[int] = None
+        self.n_class_cap: Optional[int] = None
+
+    def size_caps(self, x0: np.ndarray) -> None:
+        """Bin/class extents from the declared schema + first chunk
+        (+headroom); see ``_train_streamed`` for the sizing rationale."""
+        obs0 = [int(x0[:, j].max()) + 1 if len(x0) else 0
+                for j in self.binned]
+        cat_card = [len(self.enc.vocabs[f.ordinal])
+                    for f in self.enc.feature_fields if f.is_categorical()]
+        self.bins_cap = max([1] + [self.declared[j] for j in self.bucket_cols]
+                            + obs0 + cat_card) + 4
+        # no class headroom: the class vocabulary is complete after
+        # chunk 0 in practice (declared in the schema, or every class
+        # present early); a late new class fails the cap guard and
+        # falls back — cheaper than paying a wider moments GEMV and
+        # count table on every run
+        self.n_class_cap = max(len(self.enc.class_vocab), 1)
+
+    def accept(self, x, values, y, n, narrow: bool = True):
+        """Guard + accumulate one encoded chunk; returns the (x, y) fold
+        arrays (int8-narrowed when ``narrow``), None for an empty chunk.
+        Raises ``ChunkedEncodeUnsupported`` on any cap overflow."""
+        from ..core.binning import ChunkedEncodeUnsupported
+
+        if n == 0:
+            return None
+        for j in self.bucket_cols:
+            if int(x[:, j].min()) < 0:
+                raise ChunkedEncodeUnsupported("negative bin")
+        mx = [int(x[:, j].max()) + 1 for j in self.binned]
+        for j, m in zip(self.binned, mx):
+            self.num_bins_seen[j] = max(self.num_bins_seen[j], m)
+        if (max(mx, default=0) > self.bins_cap
+                or int(y.max(initial=-1)) >= self.n_class_cap):
+            raise ChunkedEncodeUnsupported("cap overflow")
+        xs, ys = x, y
+        if narrow:
+            if self.bins_cap <= 127 and self.F <= 127:
+                xs = xs.astype(np.int8)
+            if self.n_class_cap <= 127:
+                ys = ys.astype(np.int8)
+        mom = _host_moments(values, y, self.n_class_cap, self.cont_cols)
+        for j, m in mom.items():
+            acc = self.mom_acc.get(j)
+            self.mom_acc[j] = m.copy() if acc is None else acc + m
+        self.n_chunks += 1
+        return xs, ys
+
+
 class BayesianDistribution:
     """The Naive Bayes distribution trainer job."""
 
@@ -238,13 +310,13 @@ class BayesianDistribution:
         from ..core.binning import ChunkedEncodeUnsupported
 
         enc = DatasetEncoder(self.schema)
-        ffields = enc.feature_fields
-        F = len(ffields)
+        F = len(enc.feature_fields)
         chunk_bytes = self.config.get_int("ingest.chunk.bytes", 48 << 20)
         # budget row estimate: un-narrowed int32 x row + y (conservative —
         # int8 narrowing only shrinks the live set under the budget)
         chunk_rows = self.config.pipeline_chunk_rows(row_bytes=4 * (F + 1))
         depth = self.config.pipeline_prefetch_depth()
+        st = _NBStreamState(enc)
         try:
             gen = enc.encode_path_chunks(in_path, delim_in,
                                          chunk_bytes=chunk_bytes,
@@ -252,83 +324,48 @@ class BayesianDistribution:
             first, gen = pipeline.peek(gen)
             if first is None:
                 return None
-            binned = [j for j, f in enumerate(ffields)
-                      if f.is_categorical() or f.is_bucket_width_defined()]
-            cont_cols = [j for j in range(F) if j not in binned]
-            bucket_cols = [j for j, f in enumerate(ffields)
-                           if f.is_bucket_width_defined()]
-
-            x0 = first[0]
-            declared = [f.num_bins() if (f.is_bucket_width_defined()
-                                         and f.max is not None) else 0
-                        for f in ffields]
-            obs0 = [int(x0[:, j].max()) + 1 if len(x0) else 0
-                    for j in binned]
             # declared categorical cardinalities are pre-seeded into the
             # vocab, so the emit loop walks len(vocab) bins even when the
             # data uses fewer — the count tensor must cover them
-            cat_card = [len(enc.vocabs[f.ordinal])
-                        for f in ffields if f.is_categorical()]
-            bins_cap = max([1] + [declared[j] for j in bucket_cols]
-                           + obs0 + cat_card) + 4
-            # no class headroom: the class vocabulary is complete after
-            # chunk 0 in practice (declared in the schema, or every class
-            # present early); a late new class fails the cap guard and
-            # falls back — cheaper than paying a wider moments GEMV and
-            # count table on every run
-            n_class_cap = max(len(enc.class_vocab), 1)
-
-            mom_acc: Dict[int, np.ndarray] = {}
-            num_bins_seen = np.zeros(F, dtype=np.int64)
-            n_chunks = [0]
+            st.size_caps(first[0])
 
             def chunks():
                 # guards + dtype narrowing + host moments run HERE — on
                 # the prefetch worker when depth >= 1, overlapping the
                 # device fold of the previous chunk
                 for x, values, y, n in gen:
-                    if n == 0:
-                        continue
-                    for j in bucket_cols:
-                        if int(x[:, j].min()) < 0:
-                            raise ChunkedEncodeUnsupported("negative bin")
-                    mx = [int(x[:, j].max()) + 1 for j in binned]
-                    for j, m in zip(binned, mx):
-                        num_bins_seen[j] = max(num_bins_seen[j], m)
-                    if (max(mx, default=0) > bins_cap
-                            or int(y.max(initial=-1)) >= n_class_cap):
-                        raise ChunkedEncodeUnsupported("cap overflow")
-                    xs, ys = x, y
-                    if bins_cap <= 127 and F <= 127:
-                        xs = xs.astype(np.int8)
-                    if n_class_cap <= 127:
-                        ys = ys.astype(np.int8)
-                    mom = _host_moments(values, y, n_class_cap, cont_cols)
-                    for j, m in mom.items():
-                        acc = mom_acc.get(j)
-                        mom_acc[j] = m.copy() if acc is None else acc + m
-                    n_chunks[0] += 1
-                    yield xs, ys
+                    out = st.accept(x, values, y, n)
+                    if out is not None:
+                        yield out
 
             total = pipeline.streaming_fold(
-                chunks(), _nb_local, static_args=(n_class_cap, bins_cap),
+                chunks(), _nb_local,
+                static_args=(st.n_class_cap, st.bins_cap),
                 mesh=mesh, prefetch_depth=depth, capacity=chunk_rows)
         except ChunkedEncodeUnsupported:
             return None
         if total is None:
             return None
+        return self._streamed_model_lines(enc, st, total, counters, delim)
 
-        counters.set("Ingest", "Chunks", n_chunks[0])
+    def _streamed_model_lines(self, enc: DatasetEncoder,
+                              st: _NBStreamState, total, counters: Counters,
+                              delim: str) -> List[str]:
+        """Model lines from a streamed count fold (shared tail of
+        ``_train_streamed`` and the multi-scan FoldSpec)."""
+        counters.set("Ingest", "Chunks", st.n_chunks)
+        ffields = enc.feature_fields
+        F = len(ffields)
         n_class = len(enc.class_vocab)
         counts = np.asarray(total)[:n_class]
-        moments = {j: m[:, :n_class] for j, m in mom_acc.items()}
+        moments = {j: m[:, :n_class] for j, m in st.mom_acc.items()}
 
         num_bins = []
         for j, f in enumerate(ffields):
             if f.is_categorical():
                 num_bins.append(len(enc.vocabs[f.ordinal]))
             elif f.is_bucket_width_defined():
-                num_bins.append(max(declared[j], int(num_bins_seen[j])))
+                num_bins.append(max(st.declared[j], int(st.num_bins_seen[j])))
             else:
                 num_bins.append(0)
         ds_meta = EncodedDataset(
@@ -342,6 +379,14 @@ class BayesianDistribution:
             vocabs=enc.vocabs, class_vocab=enc.class_vocab)
         return self._emit_model_lines(ds_meta, counts, moments, delim,
                                       counters)
+
+    def fold_spec(self, out_path: str):
+        """Export this trainer's shared-scan ``core.multiscan.FoldSpec``
+        (None in text mode — token streams cannot ride the tabular
+        scan)."""
+        if not self.tabular:
+            return None
+        return _NBFoldSpec(self, out_path)
 
     def train_lines(self, ds: EncodedDataset, delim: str,
                     counters: Counters, mesh=None) -> List[str]:
@@ -465,6 +510,53 @@ class BayesianDistribution:
                 counters.incr("Distribution Data", "Feature prior binned ")
                 lines.append(f"{delim}{o}{delim}{tok}{delim}{cnt}")
         write_output(out_path, lines)
+        return counters
+
+
+class _NBFoldSpec(MultiScanFoldSpec):
+    """Shared-scan FoldSpec for the NB trainer (core.multiscan contract):
+    schema-encodes each parsed chunk (sharing the encoder — and therefore
+    the per-chunk encode AND H2D copy — with any co-registered job on the
+    same schema file), folds ``_nb_local`` count tables on device, and
+    finalizes to the normal model file.  Fold arrays stay un-narrowed so
+    they are identical objects to a sharing job's (the int8 transfer
+    narrowing would fork a private copy per job)."""
+
+    def __init__(self, job: "BayesianDistribution", out_path: str):
+        self.job = job
+        self.out_path = out_path
+        self.name = type(job).__name__
+        self.local_fn = _nb_local
+        self.static_args: tuple = ()
+        self.enc = DatasetEncoder(job.schema)
+        self.delim = job.config.field_delim_out()
+        self.st: Optional[_NBStreamState] = None
+
+    def bind(self, engine) -> None:
+        import os
+        sp = self.job.config.get("feature.schema.file.path")
+        if sp:
+            self.enc = engine.shared_encoder(
+                ("schema-encoder", os.path.abspath(sp)), self.enc)
+
+    def encode(self, ctx):
+        # ctx.encoded: native C single-pass encode off the raw bytes when
+        # available (negative bins arrive unshifted and fail accept's
+        # guard; the Python fallback raises on its per-chunk shift)
+        x, values, y, n = ctx.encoded(self.enc)
+        if n == 0:
+            return None
+        if self.st is None:
+            self.st = _NBStreamState(self.enc)
+            self.st.size_caps(x)
+            self.static_args = (self.st.n_class_cap, self.st.bins_cap)
+        return self.st.accept(x, values, y, n, narrow=False)
+
+    def finalize(self, carry) -> Counters:
+        counters = Counters()
+        lines = self.job._streamed_model_lines(self.enc, self.st, carry,
+                                               counters, self.delim)
+        write_output(self.out_path, lines)
         return counters
 
 

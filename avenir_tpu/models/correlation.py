@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.config import JobConfig
+from ..core.multiscan import FoldSpec as MultiScanFoldSpec
 from ..core.obs import traced_run
 from ..core.io import read_lines, split_line, write_output
 from ..core.metrics import Counters
@@ -87,6 +88,39 @@ def _cat_corr_local(src, dst, mask, sizes):
     return count_table(sizes, (p_idx, src, dst), mask=m)
 
 
+def _encode_pairs_from_cols(cols, n, pairs, card):
+    """(src_idx, dst_idx) int32 [n, n_pairs] cardinality indices from
+    per-ordinal value columns (str or bytes arrays) — one ``np.unique``
+    + LUT per distinct ordinal.  An attribute value outside the declared
+    cardinality raises KeyError exactly like a per-record lookup."""
+    idx = {}
+    for o, col in cols.items():
+        uniq, inv = np.unique(col, return_inverse=True)
+        lut = np.asarray(
+            [card[o][u.decode() if isinstance(u, bytes) else str(u)]
+             for u in uniq.tolist()], dtype=np.int32)
+        idx[o] = lut[inv.reshape(-1)]
+    if not pairs:
+        return (np.zeros((n, 0), np.int32), np.zeros((n, 0), np.int32))
+    src_idx = np.stack([idx[s] for s, _ in pairs], axis=1)
+    dst_idx = np.stack([idx[d] for _, d in pairs], axis=1)
+    return src_idx, dst_idx
+
+
+def _encode_pair_columns(records, pairs, card):
+    """``_encode_pairs_from_cols`` over parsed records (field matrix or
+    list of field lists)."""
+    ords = sorted({o for p in pairs for o in p})
+    if isinstance(records, np.ndarray) and records.ndim == 2:
+        cols = {o: records[:, o] for o in ords}
+        n = records.shape[0]
+    else:
+        cols = {o: np.asarray([r[o] for r in records], dtype=str)
+                for o in ords}
+        n = len(records)
+    return _encode_pairs_from_cols(cols, n, pairs, card)
+
+
 class CategoricalCorrelation:
     """Shared contingency-matrix job; subclasses choose the statistic."""
 
@@ -100,14 +134,12 @@ class CategoricalCorrelation:
     def statistic(self, table: np.ndarray) -> float:
         return cramer_index(table)
 
-    @traced_run
-    def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
-        counters = Counters()
+    def _pair_setup(self):
+        """(pairs, fields, card, sizes) from the configured source/dest
+        attribute lists — shared by ``run`` and the multi-scan FoldSpec."""
         cfg = self.config
-        delim = cfg.field_delim_out()
         src_attrs = [int(v) for v in cfg.must_list("source.attributes")]
         dst_attrs = [int(v) for v in cfg.must_list("dest.attributes")]
-
         pairs: List[Tuple[int, int]] = [
             (s, d) for s in src_attrs for d in dst_attrs if s != d]
         fields = {o: self.schema.field_by_ordinal(o)
@@ -115,30 +147,40 @@ class CategoricalCorrelation:
         card = {o: {v: i for i, v in enumerate(fields[o].cardinality)}
                 for o in fields}
         max_card = max(len(c) for c in card.values())
-
-        records = [split_line(l, cfg.field_delim_regex())
-                   for l in read_lines(in_path)]
-        n = len(records)
-        src_idx = np.zeros((n, len(pairs)), dtype=np.int32)
-        dst_idx = np.zeros((n, len(pairs)), dtype=np.int32)
-        for i, r in enumerate(records):
-            for p, (s, d) in enumerate(pairs):
-                src_idx[i, p] = card[s][r[s]]
-                dst_idx[i, p] = card[d][r[d]]
-
         sizes = (len(pairs), max_card, max_card)
-        counts = np.asarray(sharded_reduce(
-            _cat_corr_local, src_idx, dst_idx, mesh=mesh,
-            static_args=(sizes,)))
+        return pairs, fields, card, sizes
 
+    def _emit_lines(self, counts, pairs, fields, card, delim) -> List[str]:
         out = []
         for p, (s, d) in enumerate(pairs):
             tbl = counts[p, :len(card[s]), :len(card[d])]
             out.append(f"{fields[s].name}{delim}{fields[d].name}{delim}"
                        f"{self.statistic(tbl)}")
-        write_output(out_path, out)
+        return out
+
+    @traced_run
+    def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
+        counters = Counters()
+        cfg = self.config
+        delim = cfg.field_delim_out()
+        pairs, fields, card, sizes = self._pair_setup()
+
+        records = [split_line(l, cfg.field_delim_regex())
+                   for l in read_lines(in_path)]
+        src_idx, dst_idx = _encode_pair_columns(records, pairs, card)
+
+        counts = np.asarray(sharded_reduce(
+            _cat_corr_local, src_idx, dst_idx, mesh=mesh,
+            static_args=(sizes,)))
+
+        write_output(out_path,
+                     self._emit_lines(counts, pairs, fields, card, delim))
         counters.set("Correlation", "Pairs", len(pairs))
         return counters
+
+    def fold_spec(self, out_path: str):
+        """Export this job's shared-scan ``core.multiscan.FoldSpec``."""
+        return _CatCorrFoldSpec(self, out_path)
 
 
 class CramerCorrelation(CategoricalCorrelation):
@@ -154,6 +196,55 @@ class HeterogeneityReductionCorrelation(CategoricalCorrelation):
         if alg == "gini":
             return concentration_coeff(table)
         return uncertainty_coeff(table)
+
+
+class _CatCorrFoldSpec(MultiScanFoldSpec):
+    """Shared-scan FoldSpec for the contingency-matrix correlation
+    family (Cramer/heterogeneity — the statistic stays the driver's):
+    per chunk the configured attribute pairs encode to cardinality
+    indices and fold one ``count_table`` scatter; finalize reduces each
+    pair's matrix with the job's statistic.  An attribute value outside
+    the declared cardinality withdraws the spec (the standalone re-run
+    then raises the same KeyError a standalone workflow would)."""
+
+    def __init__(self, job: CategoricalCorrelation, out_path: str):
+        self.job = job
+        self.out_path = out_path
+        self.name = type(job).__name__
+        self.local_fn = _cat_corr_local
+        self.delim = job.config.field_delim_out()
+        self.pairs, self.fields, self.card, sizes = job._pair_setup()
+        self.static_args = (sizes,)
+
+    def encode(self, ctx):
+        from ..core.binning import ChunkedEncodeUnsupported
+
+        ords = tuple(sorted({o for p in self.pairs for o in p}))
+        cols = ctx.columns(ords)
+        try:
+            if cols is not None:
+                n = len(next(iter(cols.values()))) if cols else 0
+                if n == 0:
+                    return None
+                return _encode_pairs_from_cols(cols, n, self.pairs,
+                                               self.card)
+            chunk = ctx.fields()
+            n = (chunk.shape[0] if isinstance(chunk, np.ndarray)
+                 else len(chunk))
+            if n == 0:
+                return None
+            return _encode_pair_columns(chunk, self.pairs, self.card)
+        except KeyError as exc:
+            raise ChunkedEncodeUnsupported(
+                f"undeclared attribute value {exc}")
+
+    def finalize(self, carry) -> Counters:
+        counters = Counters()
+        write_output(self.out_path, self.job._emit_lines(
+            np.asarray(carry), self.pairs, self.fields, self.card,
+            self.delim))
+        counters.set("Correlation", "Pairs", len(self.pairs))
+        return counters
 
 
 class NumericalCorrelation:

@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..core.config import JobConfig
+from ..core.multiscan import FoldSpec as MultiScanFoldSpec
 from ..core.obs import traced_run
 from ..core.io import read_lines, split_line, write_output
 from ..core.metrics import Counters
@@ -53,6 +54,18 @@ def _moment_rows(vals: np.ndarray, conds: List[str],
     return out
 
 
+def _stats_lines(attrs: List[int], vals_by_attr, conds: List[str],
+                 delim: str) -> List[str]:
+    """NumericalAttrStats output lines from per-attribute value arrays
+    (shared by the standalone job and the multi-scan FoldSpec)."""
+    out = []
+    for a in attrs:
+        for cond, row in _moment_rows(np.asarray(vals_by_attr[a]), conds, a):
+            body = delim.join(str(v) for v in row)
+            out.append(f"{a}{delim}{cond}{delim}{body}")
+    return out
+
+
 class NumericalAttrStats:
     """Per-attribute (optionally class-conditioned) moment stats job."""
 
@@ -69,16 +82,108 @@ class NumericalAttrStats:
 
         records = [split_line(l, cfg.field_delim_regex())
                    for l in read_lines(in_path)]
-        out = []
-        for a in attrs:
-            vals = np.asarray([float(r[a]) for r in records])
-            conds = ([r[cond_ord] for r in records] if cond_ord >= 0
-                     else ["0"] * len(records))
-            for cond, row in _moment_rows(vals, conds, a):
-                body = delim.join(str(v) for v in row)
-                out.append(f"{a}{delim}{cond}{delim}{body}")
-        write_output(out_path, out)
+        vals_by_attr = {a: np.asarray([float(r[a]) for r in records])
+                        for a in attrs}
+        conds = ([r[cond_ord] for r in records] if cond_ord >= 0
+                 else ["0"] * len(records))
+        write_output(out_path, _stats_lines(attrs, vals_by_attr, conds,
+                                            delim))
         counters.set("Stats", "Attributes", len(attrs))
+        return counters
+
+    def fold_spec(self, out_path: str):
+        """Export this job's shared-scan ``core.multiscan.FoldSpec``
+        (host-only: exact float moments are deliberately computed on
+        host — see the module docstring)."""
+        return _StatsFoldSpec(self, out_path)
+
+
+class _StatsFoldSpec(MultiScanFoldSpec):
+    """Host-only shared-scan spec for NumericalAttrStats: per chunk the
+    configured attribute columns parse to float64 and buffer (a few
+    columns — tiny next to the CSV the scan no longer re-reads);
+    finalize concatenates and emits through the exact same
+    ``_moment_rows`` math as a standalone run, so output is
+    byte-identical (same full-array summation order)."""
+
+    local_fn = None
+
+    def __init__(self, job: NumericalAttrStats, out_path: str):
+        cfg = job.config
+        self.job = job
+        self.out_path = out_path
+        self.name = type(job).__name__
+        self.attrs = [int(v) for v in cfg.must_list("attr.list")]
+        self.cond_ord = cfg.get_int("cond.attr.ord", -1)
+        self.delim = cfg.field_delim_out()
+        self._vals: Dict[int, list] = {a: [] for a in self.attrs}
+        self._conds: List[str] = []
+
+    def encode(self, ctx):
+        cols = self._native_columns(ctx)
+        if cols is not None:
+            n, vals, conds = cols
+            if n == 0:
+                return None
+            for a in self.attrs:
+                self._vals[a].append(vals[a])
+            self._conds.extend(conds)
+            return ()
+        chunk = ctx.fields()
+        if isinstance(chunk, np.ndarray) and chunk.ndim == 2:
+            n = chunk.shape[0]
+            if n == 0:
+                return None
+            for a in self.attrs:
+                self._vals[a].append(chunk[:, a].astype(np.float64))
+            if self.cond_ord >= 0:
+                self._conds.extend(chunk[:, self.cond_ord].tolist())
+            else:
+                self._conds.extend(["0"] * n)
+        else:
+            if not chunk:
+                return None
+            for a in self.attrs:
+                self._vals[a].append(
+                    np.asarray([float(r[a]) for r in chunk]))
+            if self.cond_ord >= 0:
+                self._conds.extend(str(r[self.cond_ord]) for r in chunk)
+            else:
+                self._conds.extend(["0"] * len(chunk))
+        return ()   # host-only: chunk consumed, nothing to fold
+
+    def _native_columns(self, ctx):
+        """(n, {attr: float64 array}, cond list) via the native
+        column extractor (C strtod — identical values to ``float()``),
+        or None to fall back to the parsed field matrix."""
+        from .. import native
+
+        want = list(self.attrs)
+        kinds = [native.FLOAT64] * len(want)
+        if self.cond_ord >= 0:
+            if self.cond_ord in want:
+                return None            # duplicate ordinal: one kind each
+            want.append(self.cond_ord)
+            kinds.append(native.BYTES)
+        cols = ctx.columns(tuple(want), tuple(kinds))
+        if cols is None:
+            return None
+        n = len(cols[self.attrs[0]]) if self.attrs else 0
+        vals = {a: cols[a] for a in self.attrs}
+        if self.cond_ord >= 0:
+            conds = [s.decode() for s in cols[self.cond_ord].tolist()]
+        else:
+            conds = ["0"] * n
+        return n, vals, conds
+
+    def finalize(self, carry) -> Counters:
+        counters = Counters()
+        vals_by_attr = {
+            a: (np.concatenate(v) if v else np.zeros(0))
+            for a, v in self._vals.items()}
+        write_output(self.out_path, _stats_lines(
+            self.attrs, vals_by_attr, self._conds, self.delim))
+        counters.set("Stats", "Attributes", len(self.attrs))
         return counters
 
 

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.config import JobConfig
+from ..core.multiscan import FoldSpec as MultiScanFoldSpec
 from ..core.obs import get_tracer, traced_run
 from ..core.io import read_lines, split_line, write_output
 from ..core.metrics import Counters
@@ -199,19 +200,32 @@ class MarkovStateTransitionModel:
                                      if class_ord >= 0 else 0, S)))
 
         with tracer.span("phase:emit"):
-            lines: List[str] = []
-            if output_states:
-                lines.append(",".join(states))
-            if class_ord >= 0:
-                for ci, lbl in enumerate(class_labels):
-                    lines.append(f"classLabel:{lbl}")
-                    lines.extend(
-                        serialize_matrix(normalize_rows(counts[ci], scale)))
-            else:
-                lines.extend(serialize_matrix(normalize_rows(counts, scale)))
-            write_output(out_path, lines)
+            write_output(out_path, self._model_lines(
+                counts, class_labels, states, scale, output_states,
+                class_ord))
         counters.set("Markov", "Transitions", int(counts.sum()))
         return counters
+
+    @staticmethod
+    def _model_lines(counts, class_labels, states, scale, output_states,
+                     class_ord) -> List[str]:
+        """Reference-format model lines (shared by ``run`` and the
+        multi-scan FoldSpec)."""
+        lines: List[str] = []
+        if output_states:
+            lines.append(",".join(states))
+        if class_ord >= 0:
+            for ci, lbl in enumerate(class_labels):
+                lines.append(f"classLabel:{lbl}")
+                lines.extend(
+                    serialize_matrix(normalize_rows(counts[ci], scale)))
+        else:
+            lines.extend(serialize_matrix(normalize_rows(counts, scale)))
+        return lines
+
+    def fold_spec(self, out_path: str):
+        """Export this trainer's shared-scan ``core.multiscan.FoldSpec``."""
+        return _MarkovFoldSpec(self, out_path)
 
     def _count_streamed(self, in_path, delim_regex, vocab, S, eff_skip,
                         class_ord, chunk_rows, depth, mesh):
@@ -272,6 +286,77 @@ class MarkovStateTransitionModel:
         elif class_ord >= 0:
             counts = counts[:n_class]
         return counts, class_labels
+
+
+class _MarkovFoldSpec(MultiScanFoldSpec):
+    """Shared-scan FoldSpec for the Markov transition trainer: each
+    parsed chunk's trailing state sequences flatten to 1-D (from, to,
+    class) pair streams (variable length -> power-of-two buckets, so
+    ``fixed_capacity`` is False) folded by ``_markov_pair_local``; class
+    labels are discovered in input order exactly like the standalone
+    paths, with the same first-chunk class cap + fallback contract."""
+
+    fixed_capacity = False
+
+    def __init__(self, job: "MarkovStateTransitionModel", out_path: str):
+        cfg = job.config
+        self.job = job
+        self.out_path = out_path
+        self.name = type(job).__name__
+        self.local_fn = _markov_pair_local
+        self.static_args: tuple = ()
+        self.states = cfg.must("model.states").split(",")
+        self.vocab = {s: i for i, s in enumerate(self.states)}
+        self.S = len(self.states)
+        skip = cfg.get_int("skip.field.count", 0)
+        self.class_ord = cfg.get_int("class.label.field.ord", -1)
+        self.eff_skip = skip + (1 if self.class_ord >= 0 else 0)
+        self.scale = cfg.get_int("trans.prob.scale", 1000)
+        self.output_states = cfg.get_boolean("output.states", True)
+        self.class_labels: List[str] = []
+        self._seen: Dict[str, int] = {}
+        self._cap: Optional[int] = None
+
+    def encode(self, ctx):
+        from ..core.binning import ChunkedEncodeUnsupported
+
+        records = [r for r in ctx.fields() if len(r) >= self.eff_skip + 2]
+        if not records:
+            return None
+        cls_idx = np.zeros(len(records), dtype=np.int32)
+        if self.class_ord >= 0:
+            for i, r in enumerate(records):
+                lbl = str(r[self.class_ord])
+                if lbl not in self._seen:
+                    self._seen[lbl] = len(self._seen)
+                    self.class_labels.append(lbl)
+                cls_idx[i] = self._seen[lbl]
+            if self._cap is not None and len(self.class_labels) > self._cap:
+                raise ChunkedEncodeUnsupported("late class label")
+        seq, _ = encode_sequences(records, self.eff_skip, self.vocab)
+        if seq.shape[1] < 2:
+            return None
+        if self._cap is None:
+            # headroom covers stragglers; a genuinely late-appearing
+            # label beyond it falls back (standalone re-run)
+            n_class_cap = 0
+            if self.class_ord >= 0:
+                self._cap = n_class_cap = max(len(self.class_labels), 1) + 2
+            self.static_args = (n_class_cap, self.S)
+        frm, to = _transition_pairs(seq)
+        cls = np.repeat(cls_idx, frm.shape[1])
+        return frm.ravel(), to.ravel(), cls
+
+    def finalize(self, carry) -> Counters:
+        counters = Counters()
+        counts = np.asarray(carry)
+        if self.class_ord >= 0:
+            counts = counts[:len(self.class_labels)]
+        write_output(self.out_path, self.job._model_lines(
+            counts, self.class_labels, self.states, self.scale,
+            self.output_states, self.class_ord))
+        counters.set("Markov", "Transitions", int(counts.sum()))
+        return counters
 
 
 # ---------------------------------------------------------------------------

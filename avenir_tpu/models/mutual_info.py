@@ -31,6 +31,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..core.binning import DatasetEncoder, EncodedDataset
+from ..core.multiscan import FoldSpec as MultiScanFoldSpec
 from ..core.obs import traced_run
 from ..core.config import JobConfig
 from ..core.io import write_output
@@ -152,6 +153,53 @@ _ALGOS = {
 }
 
 
+class _MIStreamState:
+    """Per-chunk guards, cap sizing, and bin/row accounting shared by the
+    standalone streamed MI path and the shared-scan FoldSpec."""
+
+    def __init__(self, enc: DatasetEncoder):
+        self.enc = enc
+        ffields = enc.feature_fields
+        self.F = len(ffields)
+        self.num_bins_seen = np.zeros(self.F, dtype=np.int64)
+        self.n_rows = 0
+        self.caps: Dict[str, int] = {}
+        self.declared = [f.num_bins() if (f.is_bucket_width_defined()
+                                          and f.max is not None) else 0
+                         for f in ffields]
+        self.pair_i: Tuple[int, ...] = ()
+        self.pair_j: Tuple[int, ...] = ()
+
+    def size_caps(self) -> None:
+        """Bin/class extents from the declared schema + the first
+        accepted chunk (+headroom); call after the first ``accept``."""
+        cat_card = [len(self.enc.vocabs[f.ordinal])
+                    for f in self.enc.feature_fields if f.is_categorical()]
+        self.caps["B"] = int(max([1] + self.declared + cat_card
+                                 + list(self.num_bins_seen))) + 4
+        self.caps["C"] = max(len(self.enc.class_vocab), 1) + 2
+        self.pair_i, self.pair_j = map(tuple, np.triu_indices(self.F, k=1))
+
+    def accept(self, x, y, n: int):
+        """Guard one encoded chunk; returns the (x, y) fold arrays or
+        None for an empty chunk.  ``x`` carries raw (unshifted) bins —
+        callers on the shifting Python encode guard ``bin_offset``
+        themselves; the negative check here covers the native path."""
+        from ..core.binning import ChunkedEncodeUnsupported
+
+        if n == 0:
+            return None
+        if (x < 0).any():
+            raise ChunkedEncodeUnsupported("negative bin")
+        mx = x.max(axis=0) + 1
+        np.maximum(self.num_bins_seen, mx, out=self.num_bins_seen)
+        if self.caps and (int(mx.max()) > self.caps["B"]
+                          or int(y.max()) >= self.caps["C"]):
+            raise ChunkedEncodeUnsupported("cap overflow")
+        self.n_rows += n
+        return x, y
+
+
 class MutualInformation:
     """The MI job."""
 
@@ -211,58 +259,50 @@ class MutualInformation:
         from ..core import pipeline
         from ..core.binning import ChunkedEncodeUnsupported
 
-        ffields = enc.feature_fields
-        F = len(ffields)
         delim_regex = cfg.field_delim_regex()
-        n_rows = [0]
-        num_bins_seen = np.zeros(F, dtype=np.int64)
-        caps = {}
+        st = _MIStreamState(enc)
 
         def encoded():
             for arr in pipeline.iter_field_chunks(in_path, delim_regex,
                                                   chunk_rows):
                 dsc = enc.encode(arr)
-                if dsc.n_rows == 0:
-                    continue
                 if (dsc.bin_offset != 0).any():
                     raise ChunkedEncodeUnsupported("negative bin")
-                mx = dsc.x.max(axis=0) + 1
-                np.maximum(num_bins_seen, mx, out=num_bins_seen)
-                if caps and (int(mx.max()) > caps["B"]
-                             or int(dsc.y.max()) >= caps["C"]):
-                    raise ChunkedEncodeUnsupported("cap overflow")
-                n_rows[0] += dsc.n_rows
-                yield dsc.x, dsc.y
+                out = st.accept(dsc.x, dsc.y, dsc.n_rows)
+                if out is not None:
+                    yield out
 
         try:
             first, stream = pipeline.peek(encoded())
             if first is None:
                 return None
-            declared = [f.num_bins() if (f.is_bucket_width_defined()
-                                         and f.max is not None) else 0
-                        for f in ffields]
-            cat_card = [len(enc.vocabs[f.ordinal])
-                        for f in ffields if f.is_categorical()]
-            caps["B"] = int(max([1] + declared + cat_card
-                                + list(num_bins_seen))) + 4
-            caps["C"] = max(len(enc.class_vocab), 1) + 2
-            pair_i, pair_j = map(tuple, np.triu_indices(F, k=1))
+            st.size_caps()
             res = pipeline.streaming_fold(
                 stream, _mi_local,
-                static_args=(caps["C"], caps["B"], pair_i, pair_j),
+                static_args=(st.caps["C"], st.caps["B"],
+                             st.pair_i, st.pair_j),
                 mesh=mesh, prefetch_depth=depth, capacity=chunk_rows)
         except ChunkedEncodeUnsupported:
             return None
         if res is None:
             return None
-        counters.set("Basic", "Records", n_rows[0])
+        counters.set("Basic", "Records", st.n_rows)
+        lines = self._streamed_lines(enc, st, res, delim, cfg)
+        write_output(out_path, lines)
+        return counters
 
+    def _streamed_lines(self, enc: DatasetEncoder, st: _MIStreamState,
+                        res, delim, cfg) -> List[str]:
+        """Output lines from a streamed fold result (shared tail of
+        ``_run_streamed`` and the multi-scan FoldSpec)."""
+        ffields = enc.feature_fields
+        F = len(ffields)
         num_bins = []
         for j, f in enumerate(ffields):
             if f.is_categorical():
                 num_bins.append(len(enc.vocabs[f.ordinal]))
             else:
-                num_bins.append(max(declared[j], int(num_bins_seen[j])))
+                num_bins.append(max(st.declared[j], int(st.num_bins_seen[j])))
         C = len(enc.class_vocab)
         B = max(num_bins)
         fc = np.asarray(res["fc"], dtype=np.int64)[:C, :, :B]
@@ -274,9 +314,11 @@ class MutualInformation:
             bin_offset=np.zeros(F, np.int32),
             binned_mask=np.ones(F, dtype=bool),
             vocabs=enc.vocabs, class_vocab=enc.class_vocab)
-        lines = self._emit(ds_meta, fc, pc, pair_i, pair_j, delim, cfg)
-        write_output(out_path, lines)
-        return counters
+        return self._emit(ds_meta, fc, pc, st.pair_i, st.pair_j, delim, cfg)
+
+    def fold_spec(self, out_path: str):
+        """Export this job's shared-scan ``core.multiscan.FoldSpec``."""
+        return _MIFoldSpec(self, out_path)
 
     # -- host post-processing ----------------------------------------------
     def _emit(self, ds: EncodedDataset, fc, pc, pair_i, pair_j, delim,
@@ -452,3 +494,46 @@ class MutualInformation:
             for f, v in fn(score, rf):
                 out.append(f"{f}{delim}{v}")
         return out
+
+
+class _MIFoldSpec(MultiScanFoldSpec):
+    """Shared-scan FoldSpec for MutualInformation: shares the schema
+    encode (and H2D copy) with co-registered jobs on the same schema
+    file, folds both distribution tables on device, finalizes to the
+    normal distributions/MI/scores output file."""
+
+    def __init__(self, job: "MutualInformation", out_path: str):
+        self.job = job
+        self.out_path = out_path
+        self.name = type(job).__name__
+        self.local_fn = _mi_local
+        self.static_args: tuple = ()
+        self.enc = DatasetEncoder(job.schema)
+        self.delim = job.config.field_delim_out()
+        self.st: Optional[_MIStreamState] = None
+
+    def bind(self, engine) -> None:
+        import os
+        sp = self.job.config.get("feature.schema.file.path")
+        if sp:
+            self.enc = engine.shared_encoder(
+                ("schema-encoder", os.path.abspath(sp)), self.enc)
+
+    def encode(self, ctx):
+        x, _, y, n = ctx.encoded(self.enc)
+        if self.st is None:
+            self.st = _MIStreamState(self.enc)
+        out = self.st.accept(x, y, n)
+        if out is not None and not self.st.caps:
+            self.st.size_caps()
+            self.static_args = (self.st.caps["C"], self.st.caps["B"],
+                                self.st.pair_i, self.st.pair_j)
+        return out
+
+    def finalize(self, carry) -> Counters:
+        counters = Counters()
+        counters.set("Basic", "Records", self.st.n_rows)
+        lines = self.job._streamed_lines(self.enc, self.st, carry,
+                                         self.delim, self.job.config)
+        write_output(self.out_path, lines)
+        return counters

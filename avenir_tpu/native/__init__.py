@@ -129,7 +129,18 @@ def parse_csv_columns(path: str, col_types: Sequence[int], delim: str = ","
     lib = get_lib()
     if lib is None or len(delim) != 1:
         return None
-    buf = _read_buffer(path)
+    return parse_csv_columns_buffer(_read_buffer(path), col_types, delim)
+
+
+def parse_csv_columns_buffer(buf: bytes, col_types: Sequence[int],
+                             delim: str = ","
+                             ) -> Optional[Tuple[int, Dict[int, np.ndarray]]]:
+    """``parse_csv_columns`` over an in-memory buffer — the per-chunk
+    form the shared-scan engine uses to extract just the columns a job
+    needs without materializing the whole field matrix."""
+    lib = get_lib()
+    if lib is None or len(delim) != 1:
+        return None
     n_cols = len(col_types)
     bdelim = ctypes.c_char(delim.encode())
     widths = (ctypes.c_int * n_cols)(*([0] * n_cols))

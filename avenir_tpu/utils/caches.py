@@ -46,3 +46,9 @@ def bounded_cache_put(cache: dict, key, value,
         while len(cache) >= cap:
             cache.pop(next(iter(cache)))
         cache[key] = value
+
+
+def bounded_cache_clear(cache: dict) -> None:
+    """Drop every entry (under the same lock the readers use)."""
+    with _LOCK:
+        cache.clear()

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Shared-scan workflow: NB train + mutual information + Cramer
+# correlation + attribute stats over ONE streamed pass of the same
+# churn CSV (core/multiscan job fusion).  Mirrors the reference's
+# chained per-job shell scripts (e.g. resource/cust_churn_*.sh), which
+# re-read the input once per job — here the scan is shared.
+set -euo pipefail
+cd "$(dirname "$0")"
+PY=${PYTHON:-python}
+rm -rf work && mkdir -p work/in
+
+$PY -m avenir_tpu.datagen telecom_churn 20000 --seed 31 --out work/in/part-00000
+
+$PY -m avenir_tpu multi -Dconf.path=workflow.properties work/in work/out
+
+echo "NB model:        work/out/nb/part-r-00000"
+echo "MI distributions:work/out/mi/part-r-00000"
+echo "Cramer index:    work/out/corr/part-r-00000"
+echo "attribute stats: work/out/stats/part-r-00000"
+head -n 2 work/out/corr/part-r-00000
+head -n 2 work/out/stats/part-r-00000

@@ -1,0 +1,456 @@
+"""Shared-scan job fusion (core/multiscan): byte-parity of fused
+multi-job runs against the standalone drivers, transfer/encode sharing,
+cap-overflow fallback, the bounded fold cache, reusable host staging
+buffers, obs sub-spans + fan-out gauge, and the `multi` CLI."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core import JobConfig
+from avenir_tpu.core import multiscan, pipeline
+from avenir_tpu.core.metrics import Counters
+
+
+# ---------------------------------------------------------------------------
+# shared workload: ONE CSV feeding all five fusable drivers
+# ---------------------------------------------------------------------------
+
+# id, color, amount, score, label, s1..s4 (trailing Markov states)
+NB_SCHEMA = {"fields": [
+    {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+    {"name": "color", "ordinal": 1, "dataType": "categorical",
+     "feature": True, "cardinality": ["red", "green", "blue"]},
+    {"name": "amount", "ordinal": 2, "dataType": "int", "feature": True,
+     "min": 0, "max": 100, "bucketWidth": 7},
+    {"name": "score", "ordinal": 3, "dataType": "int", "feature": True},
+    {"name": "label", "ordinal": 4, "dataType": "categorical",
+     "cardinality": ["N", "Y"]},
+]}
+
+# all-binned subset (MutualInformation requires bucketWidth on numerics;
+# Cramer wants declared cardinalities on both attributes)
+MI_SCHEMA = {"fields": [
+    {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+    {"name": "color", "ordinal": 1, "dataType": "categorical",
+     "feature": True, "cardinality": ["red", "green", "blue"]},
+    {"name": "amount", "ordinal": 2, "dataType": "int", "feature": True,
+     "min": 0, "max": 100, "bucketWidth": 7},
+    {"name": "label", "ordinal": 4, "dataType": "categorical",
+     "cardinality": ["N", "Y"]},
+]}
+
+STATES = ["A", "B", "C"]
+
+
+def _rows(n=467, seed=11, colors=("red", "green", "blue")):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        c = colors[int(rng.integers(len(colors)))]
+        amt = int(rng.integers(0, 100))
+        score = int(rng.integers(-40, 60))       # integer-valued -> exact
+        lbl = "Y" if (c == "red") ^ (amt > 55) ^ (rng.random() < 0.2) else "N"
+        seq = [STATES[int(rng.integers(3))] for _ in range(4)]
+        rows.append([f"id{i:05d}", c, str(amt), str(score), lbl] + seq)
+    return rows
+
+
+def _write_workload(tmp_path, rows):
+    (tmp_path / "nb_schema.json").write_text(json.dumps(NB_SCHEMA))
+    (tmp_path / "mi_schema.json").write_text(json.dumps(MI_SCHEMA))
+    in_dir = tmp_path / "in"
+    in_dir.mkdir(exist_ok=True)
+    (in_dir / "part-00000").write_text(
+        "\n".join(",".join(r) for r in rows) + "\n")
+    return str(in_dir)
+
+
+def _job_props(tmp_path):
+    """Per-job standalone configs (the fused manifest reuses these)."""
+    return {
+        "nb": ("BayesianDistribution",
+               {"feature.schema.file.path": str(tmp_path / "nb_schema.json")}),
+        "mi": ("MutualInformation",
+               {"feature.schema.file.path": str(tmp_path / "mi_schema.json")}),
+        "corr": ("CramerCorrelation",
+                 {"feature.schema.file.path": str(tmp_path / "mi_schema.json"),
+                  "source.attributes": "1", "dest.attributes": "4"}),
+        "mst": ("MarkovStateTransitionModel",
+                {"model.states": ",".join(STATES),
+                 "skip.field.count": "5"}),
+        "stats": ("NumericalAttrStats",
+                  {"attr.list": "2,3", "cond.attr.ord": "4"}),
+    }
+
+
+def _read_out(path):
+    return open(os.path.join(path, "part-r-00000")).read()
+
+
+def _run_standalone(tmp_path, in_dir, pipe_props, mesh):
+    from avenir_tpu.cli import resolve, _lazy
+
+    outs = {}
+    for jid, (cls, props) in _job_props(tmp_path).items():
+        modname, clsname, prefix = resolve(cls)
+        job = _lazy(modname, clsname)(JobConfig(dict(props, **pipe_props),
+                                                prefix))
+        out = tmp_path / f"alone_{jid}"
+        job.run(in_dir, str(out), mesh=mesh)
+        outs[jid] = _read_out(str(out))
+    return outs
+
+
+def _run_fused(tmp_path, in_dir, pipe_props, mesh, tag="fused", log=None):
+    from avenir_tpu.cli import _job_resolver
+
+    props = dict(pipe_props)
+    props["multi.jobs"] = ",".join(_job_props(tmp_path))
+    for jid, (cls, jprops) in _job_props(tmp_path).items():
+        props[f"multi.job.{jid}.class"] = cls
+        for k, v in jprops.items():
+            props[f"multi.job.{jid}.{k}"] = v
+    out_base = tmp_path / tag
+    multiscan.run_multi(JobConfig(props), in_dir, str(out_base),
+                        _job_resolver, mesh=mesh, log=log)
+    return {jid: _read_out(str(out_base / jid))
+            for jid in _job_props(tmp_path)}
+
+
+# ---------------------------------------------------------------------------
+# byte parity: fused == standalone, all five drivers, both meshes
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("depth", [0, 2])
+def test_fused_five_jobs_byte_parity_mesh8(tmp_path, mesh8, depth):
+    in_dir = _write_workload(tmp_path, _rows())
+    pipe = {"pipeline.chunk.rows": "101",
+            "pipeline.prefetch.depth": str(depth)}
+    want = _run_standalone(tmp_path, in_dir, pipe, mesh8)
+    got = _run_fused(tmp_path, in_dir, pipe, mesh8, tag=f"fused{depth}")
+    assert set(got) == set(want)
+    for jid in want:
+        assert got[jid] == want[jid], jid
+
+
+def test_fused_byte_parity_mesh1(tmp_path, mesh1):
+    in_dir = _write_workload(tmp_path, _rows(311, seed=5))
+    pipe = {"pipeline.chunk.rows": "64", "pipeline.prefetch.depth": "1"}
+    want = _run_standalone(tmp_path, in_dir, pipe, mesh1)
+    got = _run_fused(tmp_path, in_dir, pipe, mesh1)
+    for jid in want:
+        assert got[jid] == want[jid], jid
+
+
+def test_same_schema_jobs_share_one_encoder(tmp_path, mesh8):
+    """NB + MI on the SAME schema file share one DatasetEncoder (one
+    schema encode and one H2D copy per chunk) and still match their
+    standalone outputs."""
+    from avenir_tpu.cli import resolve, _lazy
+    from avenir_tpu.models.bayesian import BayesianDistribution
+    from avenir_tpu.models.mutual_info import MutualInformation
+
+    in_dir = _write_workload(tmp_path, _rows(353, seed=7))
+    sp = str(tmp_path / "mi_schema.json")
+    nb = BayesianDistribution(JobConfig({"feature.schema.file.path": sp}))
+    mi = MutualInformation(JobConfig({"feature.schema.file.path": sp}))
+    engine = multiscan.MultiScanEngine(mesh=mesh8, chunk_rows=80,
+                                      prefetch_depth=2)
+    spec_nb = engine.register(nb.fold_spec(str(tmp_path / "f_nb")))
+    spec_mi = engine.register(mi.fold_spec(str(tmp_path / "f_mi")))
+    assert spec_nb.enc is spec_mi.enc, "schema encoder not shared"
+    results = engine.run(in_dir, ",")
+    assert not engine.failures
+    assert set(results) == {"BayesianDistribution", "MutualInformation"}
+
+    for jid, cls in (("nb", "BayesianDistribution"),
+                     ("mi", "MutualInformation")):
+        modname, clsname, prefix = resolve(cls)
+        job = _lazy(modname, clsname)(JobConfig(
+            {"feature.schema.file.path": sp,
+             "pipeline.chunk.rows": "80"}, prefix))
+        job.run(in_dir, str(tmp_path / f"a_{jid}"), mesh=mesh8)
+        assert (_read_out(str(tmp_path / f"f_{jid}"))
+                == _read_out(str(tmp_path / f"a_{jid}"))), jid
+
+
+def test_cap_overflow_falls_back_standalone_and_stays_identical(tmp_path,
+                                                                mesh8):
+    """Categories appearing only after chunk 0 overflow the NB/MI bin
+    caps mid-stream: those jobs are withdrawn from the fused pass and
+    re-run standalone, other jobs stay fused, and every output is still
+    byte-identical."""
+    rows = _rows(300, seed=3)
+    # undeclared colors flood in late (after the first 128-row chunk);
+    # the shared bin cap is first-chunk max extent (~15 amount bins) + 4
+    # headroom, so 30 new categories push the color column past it
+    late = _rows(120, seed=4,
+                 colors=tuple(f"c{i}" for i in range(30)))
+    in_dir = _write_workload(tmp_path, rows + late)
+    pipe = {"pipeline.chunk.rows": "128", "pipeline.prefetch.depth": "2"}
+
+    # corr withdraws too (undeclared color values) -> drop it from this
+    # manifest; its standalone form would KeyError just the same
+    props = dict(pipe, **{"multi.jobs": "nb,mi,mst,stats"})
+    jp = _job_props(tmp_path)
+    for jid in ("nb", "mi", "mst", "stats"):
+        cls, jprops = jp[jid]
+        props[f"multi.job.{jid}.class"] = cls
+        for k, v in jprops.items():
+            props[f"multi.job.{jid}.{k}"] = v
+    from avenir_tpu.cli import _job_resolver
+    msgs = []
+    out_base = tmp_path / "fused"
+    multiscan.run_multi(JobConfig(props), in_dir, str(out_base),
+                        _job_resolver, mesh=mesh8, log=msgs.append)
+    assert any("nb" in m and "standalone" in m for m in msgs), msgs
+
+    from avenir_tpu.cli import resolve, _lazy
+    for jid in ("nb", "mi", "mst", "stats"):
+        cls, jprops = jp[jid]
+        modname, clsname, prefix = resolve(cls)
+        job = _lazy(modname, clsname)(JobConfig(dict(jprops, **pipe),
+                                                prefix))
+        job.run(in_dir, str(tmp_path / f"alone_{jid}"), mesh=mesh8)
+        assert (_read_out(str(out_base / jid))
+                == _read_out(str(tmp_path / f"alone_{jid}"))), jid
+
+
+def test_non_withdrawal_encode_error_spares_healthy_jobs(tmp_path, mesh8):
+    """A spec whose encode raises a NON-ChunkedEncodeUnsupported error
+    (here: Markov hitting an undeclared state symbol -> KeyError) is
+    withdrawn like any other failure: the co-scheduled healthy jobs keep
+    their fused outputs, and the bad job's own error surfaces from its
+    standalone re-run — after every other standalone job has finished."""
+    from avenir_tpu.cli import _job_resolver, resolve, _lazy
+
+    rows = _rows(150, seed=21)
+    rows[97][5] = "ZZ"                 # not in model.states -> KeyError
+    in_dir = _write_workload(tmp_path, rows)
+    pipe = {"pipeline.chunk.rows": "64", "pipeline.prefetch.depth": "2"}
+    jp = _job_props(tmp_path)
+    props = dict(pipe, **{"multi.jobs": "nb,mst"})
+    for jid in ("nb", "mst"):
+        cls, jprops = jp[jid]
+        props[f"multi.job.{jid}.class"] = cls
+        for k, v in jprops.items():
+            props[f"multi.job.{jid}.{k}"] = v
+    msgs = []
+    with pytest.raises(KeyError, match="ZZ"):
+        multiscan.run_multi(JobConfig(props), in_dir, str(tmp_path / "f"),
+                            _job_resolver, mesh=mesh8, log=msgs.append)
+    assert any("mst" in m and "standalone" in m for m in msgs), msgs
+
+    modname, clsname, prefix = resolve(jp["nb"][0])
+    job = _lazy(modname, clsname)(JobConfig(dict(jp["nb"][1], **pipe),
+                                            prefix))
+    job.run(in_dir, str(tmp_path / "alone_nb"), mesh=mesh8)
+    assert (_read_out(str(tmp_path / "f" / "nb"))
+            == _read_out(str(tmp_path / "alone_nb")))
+
+
+def test_finalize_error_spares_other_jobs(tmp_path, mesh8):
+    """A spec whose finalize cannot write (output path under a regular
+    FILE) fails alone: the co-scheduled job still writes its fused
+    output, and the bad job's own OS error surfaces at the end."""
+    from avenir_tpu.cli import _job_resolver
+
+    in_dir = _write_workload(tmp_path, _rows(120, seed=23))
+    (tmp_path / "blocker").write_text("not a directory\n")
+    pipe = {"pipeline.chunk.rows": "64", "pipeline.prefetch.depth": "2"}
+    jp = _job_props(tmp_path)
+    props = dict(pipe, **{
+        "multi.jobs": "nb,stats",
+        "multi.job.nb.output.path": str(tmp_path / "blocker" / "nb")})
+    for jid in ("nb", "stats"):
+        cls, jprops = jp[jid]
+        props[f"multi.job.{jid}.class"] = cls
+        for k, v in jprops.items():
+            props[f"multi.job.{jid}.{k}"] = v
+    msgs = []
+    with pytest.raises(OSError):
+        multiscan.run_multi(JobConfig(props), in_dir,
+                            str(tmp_path / "f"), _job_resolver,
+                            mesh=mesh8, log=msgs.append)
+    assert any("finalize failed" in m for m in msgs), msgs
+    assert os.path.exists(str(tmp_path / "f" / "stats" / "part-r-00000"))
+
+
+# ---------------------------------------------------------------------------
+# the `multi` CLI
+# ---------------------------------------------------------------------------
+
+def test_multi_cli_end_to_end(tmp_path, mesh8, capsys):
+    from avenir_tpu import cli
+
+    in_dir = _write_workload(tmp_path, _rows(241, seed=9))
+    manifest = ["multi.jobs=nb,stats",
+                "multi.job.nb.class=BayesianDistribution",
+                f"multi.job.nb.conf.path={tmp_path}/nb.properties",
+                "multi.job.stats.class=org.chombo.mr.NumericalAttrStats",
+                "multi.job.stats.attr.list=2,3",
+                "multi.job.stats.cond.attr.ord=4",
+                "pipeline.chunk.rows=96"]
+    (tmp_path / "multi.properties").write_text("\n".join(manifest) + "\n")
+    (tmp_path / "nb.properties").write_text(
+        f"feature.schema.file.path={tmp_path}/nb_schema.json\n")
+    rc = cli.main(["multi", f"-Dconf.path={tmp_path}/multi.properties",
+                   in_dir, str(tmp_path / "out")])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "--- job nb" in err and "--- job stats" in err
+
+    rc = cli.main(["BayesianDistribution",
+                   f"-Dconf.path={tmp_path}/nb.properties",
+                   "-Dpipeline.chunk.rows=96",
+                   in_dir, str(tmp_path / "alone_nb")])
+    assert rc == 0
+    assert (_read_out(str(tmp_path / "out" / "nb"))
+            == _read_out(str(tmp_path / "alone_nb")))
+
+
+def test_manifest_validation(tmp_path):
+    from avenir_tpu.cli import _job_resolver
+
+    cfg = JobConfig({"multi.jobs": "a,a",
+                     "multi.job.a.class": "BayesianDistribution"})
+    with pytest.raises(SystemExit, match="duplicate"):
+        multiscan.load_manifest(cfg, "/tmp/x", _job_resolver)
+    cfg = JobConfig({"multi.jobs": "a",
+                     "multi.job.a.class": "NumericalAttrStats",
+                     "multi.job.a.attr.list": "1",
+                     "multi.job.a.field.delim.regex": ";"})
+    with pytest.raises(SystemExit, match="delim"):
+        multiscan.load_manifest(cfg, "/tmp/x", _job_resolver)
+
+
+# ---------------------------------------------------------------------------
+# satellite: bounded fold cache
+# ---------------------------------------------------------------------------
+
+def test_fold_fns_memo_is_bounded_lru(tmp_path, mesh8, monkeypatch):
+    """Repeated multi-job runs with distinct static args do not leak
+    compiled entries past the cap; the explicit clear hook empties it."""
+    from avenir_tpu.models.bayesian import _nb_local
+
+    monkeypatch.setattr(pipeline, "_FOLD_CACHE_CAP", 4)
+    pipeline.clear_fold_cache()
+    x = np.zeros((16, 2), np.int32)
+    y = np.zeros(16, np.int32)
+    for k in range(pipeline._FOLD_CACHE_CAP + 3):
+        pipeline.streaming_fold(iter([(x, y)]), _nb_local,
+                                static_args=(1, k + 1), mesh=mesh8,
+                                prefetch_depth=0)
+        assert len(pipeline._fold_cache) <= pipeline._FOLD_CACHE_CAP
+    assert len(pipeline._fold_cache) == pipeline._FOLD_CACHE_CAP
+    # LRU, not FIFO: the most recent key survives a subsequent insert
+    last_key = next(reversed(pipeline._fold_cache))
+    pipeline.streaming_fold(iter([(x, y)]), _nb_local,
+                            static_args=(1, 999), mesh=mesh8,
+                            prefetch_depth=0)
+    assert last_key in pipeline._fold_cache
+    pipeline.clear_fold_cache()
+    assert len(pipeline._fold_cache) == 0
+
+
+# ---------------------------------------------------------------------------
+# satellite: reusable host staging buffers
+# ---------------------------------------------------------------------------
+
+def test_host_stager_reuses_buffers_without_corruption(mesh8):
+    """force_copy staging: buffers are reused across chunks (reuses > 0)
+    and earlier chunks' device arrays keep their values after the buffer
+    is overwritten — the copy-semantics contract `committed` enforces."""
+    stager = pipeline.HostStager(force_copy=True)
+    xfer = pipeline.ChunkTransfer(mesh8, capacity=128, stager=stager)
+    rng = np.random.default_rng(0)
+    chunks = [(rng.integers(0, 9, (100, 3)).astype(np.int32),
+               rng.integers(0, 2, 100).astype(np.int32))
+              for _ in range(4)]
+    devs = [xfer(c) for c in chunks]
+    assert stager.reuses > 0, "staging buffers never reused"
+    for (x, y), dev in zip(chunks, devs):
+        got_x, got_y, mask = (np.asarray(d) for d in dev)
+        np.testing.assert_array_equal(got_x[:100], x)
+        np.testing.assert_array_equal(got_y[:100], y)
+        assert mask[:100].all() and not mask[100:].any()
+
+
+def test_ingest_h2d_spans_report_staging_reuse(mesh8):
+    """The existing ingest.h2d spans carry the stager's running reuse
+    count, so a trace shows whether per-chunk host staging is being
+    amortized (the satellite's per-chunk host-time verification hook);
+    span_summary aggregates the per-chunk costs."""
+    from avenir_tpu.core import obs
+
+    tr = obs.configure(enabled=True)
+    tr.clear()
+    try:
+        stager = pipeline.HostStager(force_copy=True)
+        xfer = pipeline.ChunkTransfer(mesh8, capacity=128, stager=stager)
+        x = np.zeros((100, 2), np.int32)
+        for _ in range(3):
+            xfer((x,))
+        spans = tr.spans("ingest.h2d")
+        assert len(spans) == 3
+        reuse_counts = [s.attrs["staged_reuses"] for s in spans]
+        assert reuse_counts[-1] > 0, "reuse never engaged"
+        summary = tr.span_summary("ingest.h2d")
+        assert summary["count"] == 3 and summary["total_ms"] > 0
+    finally:
+        obs.configure(enabled=False)
+        tr.clear()
+
+
+def test_host_stager_default_mode_never_corrupts(mesh8):
+    """Default (zero-copy-allowed) staging: an aliasing put retires the
+    slot instead of reusing it, so device values survive regardless."""
+    stager = pipeline.HostStager()
+    xfer = pipeline.ChunkTransfer(mesh8, capacity=64, stager=stager)
+    a = np.arange(60, dtype=np.int64)
+    dev_a = xfer((a,))
+    b = np.arange(60, dtype=np.int64) * 7
+    xfer((b,))
+    np.testing.assert_array_equal(np.asarray(dev_a[0])[:60], a)
+
+
+# ---------------------------------------------------------------------------
+# satellite: per-job obs sub-spans + fan-out gauge
+# ---------------------------------------------------------------------------
+
+def test_multiscan_obs_spans_and_fanout_gauge(tmp_path, mesh8):
+    from avenir_tpu.core import obs
+    from avenir_tpu.models.bayesian import BayesianDistribution
+    from avenir_tpu.models.discriminant import NumericalAttrStats
+
+    in_dir = _write_workload(tmp_path, _rows(200, seed=13))
+    tr = obs.configure(enabled=True)
+    tr.clear()
+    try:
+        engine = multiscan.MultiScanEngine(mesh=mesh8, chunk_rows=64,
+                                          prefetch_depth=2)
+        engine.register(BayesianDistribution(JobConfig(
+            {"feature.schema.file.path": str(tmp_path / "nb_schema.json")}
+        )).fold_spec(str(tmp_path / "o_nb")))
+        engine.register(NumericalAttrStats(JobConfig(
+            {"attr.list": "2", "cond.attr.ord": "4"}
+        )).fold_spec(str(tmp_path / "o_stats")))
+        engine.run(in_dir, ",")
+
+        enc_jobs = {s.attrs.get("job") for s in tr.spans("multiscan.encode")}
+        assert enc_jobs == {"BayesianDistribution", "NumericalAttrStats"}
+        fold_jobs = {s.attrs.get("job") for s in tr.spans("multiscan.fold")}
+        assert fold_jobs == {"BayesianDistribution"}   # stats is host-only
+        widths = [g.value for g in tr.records()
+                  if isinstance(g, obs.Gauge)
+                  and g.name == "multiscan.fanout.width"]
+        assert widths and max(widths) == 2.0
+        assert tr.span_summary("multiscan.fold")["count"] >= 4
+        fins = {s.attrs.get("job") for s in tr.spans("multiscan.finalize")}
+        assert fins == {"BayesianDistribution", "NumericalAttrStats"}
+    finally:
+        obs.configure(enabled=False)
+        tr.clear()

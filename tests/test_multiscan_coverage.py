@@ -1,0 +1,101 @@
+"""Tier-2 shared-scan lint: every registered batch driver that consumes
+the streaming fold (``core.pipeline.streaming_fold``) must either export
+a shared-scan ``fold_spec`` (core.multiscan) or appear on the explicit
+``NON_FUSABLE`` exclusion list with a written reason — so new streaming
+consumers cannot silently opt out of workflow fusion, and stale
+exclusions cannot linger after a driver becomes fusable."""
+
+import importlib
+import inspect
+
+from avenir_tpu.cli import JOBS
+from avenir_tpu.core.multiscan import NON_FUSABLE
+
+
+def _driver_classes():
+    for fqcn, (modname, clsname, _) in sorted(JOBS.items()):
+        mod = importlib.import_module(f"avenir_tpu.models.{modname}")
+        yield fqcn, getattr(mod, clsname)
+
+
+def _consumes_streaming_fold(cls) -> bool:
+    try:
+        src = inspect.getsource(cls)
+    except (OSError, TypeError):  # pragma: no cover - C/builtin classes
+        return False
+    return "streaming_fold" in src
+
+
+def test_every_streaming_fold_consumer_exports_foldspec_or_is_excluded():
+    bad = []
+    for fqcn, cls in _driver_classes():
+        if not _consumes_streaming_fold(cls):
+            continue
+        if cls.__name__ in NON_FUSABLE:
+            continue
+        if not callable(getattr(cls, "fold_spec", None)):
+            bad.append(fqcn)
+    assert not bad, (
+        f"streaming-fold consumers without a fold_spec export (add one or "
+        f"put the class on core.multiscan.NON_FUSABLE with a reason): {bad}")
+
+
+def test_exclusions_are_real_consumers_with_reasons():
+    """Every NON_FUSABLE entry names an actual streaming-fold consumer
+    that does NOT export a fold_spec, and carries a non-empty reason —
+    a stale or vacuous exclusion fails."""
+    consumers = {cls.__name__: cls for _, cls in _driver_classes()
+                 if _consumes_streaming_fold(cls)}
+    for name, reason in NON_FUSABLE.items():
+        assert reason and reason.strip(), f"empty exclusion reason: {name}"
+        assert name in consumers, (
+            f"NON_FUSABLE entry {name!r} is not a registered "
+            f"streaming-fold consumer (stale exclusion?)")
+        assert not callable(getattr(consumers[name], "fold_spec", None)), (
+            f"{name} exports fold_spec AND sits on the exclusion list — "
+            f"drop the stale exclusion")
+
+
+def test_fusable_drivers_fold_specs_construct():
+    """The five ported drivers' fold_spec exports actually build a
+    FoldSpec against a minimal config (a smoke check that the export is
+    not a dead attribute)."""
+    import json
+
+    from avenir_tpu.core import JobConfig
+    from avenir_tpu.core.multiscan import FoldSpec
+    from avenir_tpu.models.bayesian import BayesianDistribution
+    from avenir_tpu.models.correlation import (CramerCorrelation,
+                                               HeterogeneityReductionCorrelation)
+    from avenir_tpu.models.discriminant import NumericalAttrStats
+    from avenir_tpu.models.markov import MarkovStateTransitionModel
+    from avenir_tpu.models.mutual_info import MutualInformation
+    from avenir_tpu.core.schema import FeatureSchema
+
+    schema = FeatureSchema.from_json(json.dumps({"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "c", "ordinal": 1, "dataType": "categorical",
+         "feature": True, "cardinality": ["a", "b"]},
+        {"name": "v", "ordinal": 2, "dataType": "int", "feature": True,
+         "min": 0, "max": 10, "bucketWidth": 2},
+        {"name": "y", "ordinal": 3, "dataType": "categorical",
+         "cardinality": ["N", "Y"]}]}))
+    jobs = [
+        BayesianDistribution(JobConfig({}), schema=schema),
+        MutualInformation(JobConfig({}), schema=schema),
+        CramerCorrelation(JobConfig({"source.attributes": "1",
+                                     "dest.attributes": "3"}),
+                          schema=schema),
+        HeterogeneityReductionCorrelation(
+            JobConfig({"source.attributes": "1", "dest.attributes": "3"}),
+            schema=schema),
+        MarkovStateTransitionModel(JobConfig({"model.states": "A,B"})),
+        NumericalAttrStats(JobConfig({"attr.list": "2"})),
+    ]
+    for job in jobs:
+        spec = job.fold_spec("/tmp/out")
+        assert isinstance(spec, FoldSpec), type(job).__name__
+
+    # text-mode NB cannot ride the tabular scan: fold_spec declines
+    nb_text = BayesianDistribution(JobConfig({"tabular.input": "false"}))
+    assert nb_text.fold_spec("/tmp/out") is None
