@@ -118,6 +118,24 @@ def extract_trace_flag(argv):
     return out, trace_path
 
 
+def extract_resume_flag(argv):
+    """Pull ``--resume`` out of an arg vector; returns (remaining argv,
+    bool).  The flag maps to ``checkpoint.resume=true`` — the job
+    restarts from its sidecar checkpoint (core.checkpoint) when one
+    exists, or runs from scratch when none does."""
+    out = [a for a in argv if a != "--resume"]
+    return out, len(out) != len(argv)
+
+
+def configure_resilience(config) -> None:
+    """Apply the resilience-layer config surfaces (retry policy + fault
+    injection plan) — called by every CLI entry point next to the obs
+    configure."""
+    from .core import faultinject, resilience
+    resilience.configure_from_config(config)
+    faultinject.configure_from_config(config)
+
+
 def _init_runtime() -> None:
     """Platform pin + x64 enable shared by every CLI entry point: the
     JAX_PLATFORMS env var alone is overridden by site TPU plugins, so an
@@ -158,6 +176,7 @@ def multi_main(argv) -> int:
     standalone after the fused pass, so the workflow's outputs are
     always complete."""
     argv, trace_path = extract_trace_flag(argv)
+    argv, resume = extract_resume_flag(argv)
     defines, positional = parse_cli_args(argv)
     if not positional:
         print("expected <input path> [<output base dir>]", file=sys.stderr)
@@ -167,9 +186,12 @@ def multi_main(argv) -> int:
 
     _init_runtime()
     config = load_job_config(defines, "")
+    if resume:
+        config.set("checkpoint.resume", "true")
     from .core import obs
     from .core.multiscan import run_multi
     obs.configure_from_config(config, force_enable=bool(trace_path))
+    configure_resilience(config)
     try:
         results = run_multi(config, in_path, out_base, _job_resolver,
                             log=lambda m: print(m, file=sys.stderr))
@@ -208,6 +230,8 @@ def main(argv=None) -> int:
     # --trace <out.json>: record core.obs spans for the whole job and
     # export them as Chrome/Perfetto trace_event JSON on exit
     rest, trace_path = extract_trace_flag(rest)
+    # --resume: restart from the job's sidecar checkpoint (core.checkpoint)
+    rest, resume = extract_resume_flag(rest)
     # --profile-dir=<dir>: capture a jax.profiler trace of the whole job
     # (SURVEY §5 tracing rebuild note); view with TensorBoard or Perfetto
     profile_dir = None
@@ -230,8 +254,11 @@ def main(argv=None) -> int:
 
     _init_runtime()
     config = load_job_config(defines, prefix)
+    if resume:
+        config.set("checkpoint.resume", "true")
     from .core import obs
     obs.configure_from_config(config, force_enable=bool(trace_path))
+    configure_resilience(config)
     job = _lazy(modname, clsname)(config)
     try:
         if profile_dir:

@@ -381,7 +381,10 @@ class DatasetEncoder:
 
     def encode_path_chunks(self, path: str, delim: str = ",",
                            chunk_bytes: int = 48 << 20,
-                           chunk_rows: Optional[int] = None):
+                           chunk_rows: Optional[int] = None,
+                           start_offset: int = 0,
+                           with_offsets: bool = False,
+                           salvage=None):
         """Generator over C-encoded chunks of the input, split at line
         boundaries: yields ``(x, values, y, n_rows)`` per chunk with the
         SAME shared vocabularies as ``encode_path`` (codes are globally
@@ -397,9 +400,21 @@ class DatasetEncoder:
         — callers fall back to ``encode_path``.  No per-chunk bin
         shifting happens here: callers own the
         declared-extent/negative-bin guards (see models.bayesian's
-        streamed trainer)."""
+        streamed trainer).
+
+        Resilience surface: ``start_offset`` (a checkpointed chunk-end
+        byte offset) skips already-folded chunks — boundaries derive
+        from the whole buffer, so the resumed chunking is identical;
+        ``with_offsets`` yields ``(x, values, y, n, chunk_index,
+        end_offset)`` so the caller can build checkpoint tokens;
+        ``salvage`` (core.resilience.salvage_chunk) replaces the
+        whole-chunk ``ChunkedEncodeUnsupported`` on a native encode
+        failure with per-row quarantine of the malformed rows.  Each
+        chunk also passes the fault-injection hooks
+        (``pipeline.chunk_faults``)."""
         from .io import is_plain_delim
         from .obs import get_tracer
+        from . import pipeline
         from .. import native
 
         tracer = get_tracer()
@@ -427,6 +442,7 @@ class DatasetEncoder:
             # same buffer identically — load-bearing for parity)
             row_ends = row_chunk_ends(buf, chunk_rows) if buf else []
         pos = 0
+        idx = 0
         while pos < len(buf):
             if row_ends is not None:
                 end = int(row_ends.pop(0))
@@ -435,7 +451,11 @@ class DatasetEncoder:
                 if end < len(buf):
                     nl = buf.find(b"\n", end)
                     end = len(buf) if nl < 0 else nl + 1
-            chunk = buf[pos:end]
+            if end <= start_offset:
+                pos = end
+                idx += 1
+                continue
+            chunk = pipeline.chunk_faults(buf[pos:end], idx)
             n_hint = _rows_hint(chunk)
             with tracer.span("ingest.parse", bytes=len(chunk)):
                 res = native.encode_schema_buffer(
@@ -443,10 +463,19 @@ class DatasetEncoder:
                     self.class_field is not None, id_ordinal=id_ord,
                     delim=delim, n_rows_hint=n_hint)
                 if res is None:
-                    raise ChunkedEncodeUnsupported("native encode failed")
-                n, x, values, y, _ = self._remap_native(res)
-            yield x, values, y, n
+                    if salvage is None:
+                        raise ChunkedEncodeUnsupported(
+                            "native encode failed")
+                    # per-row quarantine instead of a whole-chunk abort
+                    x, values, y, n = salvage(chunk)
+                else:
+                    n, x, values, y, _ = self._remap_native(res)
+            if with_offsets:
+                yield x, values, y, n, idx, end
+            else:
+                yield x, values, y, n
             pos = end
+            idx += 1
 
     @staticmethod
     def _cat_lut(vocab: Vocab, uniques) -> np.ndarray:

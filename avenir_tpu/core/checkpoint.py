@@ -1,0 +1,213 @@
+"""Checkpoint/resume for streaming folds: durable mid-stream state.
+
+MapReduce recovers a lost task by re-executing it from durable
+intermediate state (Dean & Ghemawat, OSDI 2004); the rebuilt streaming
+ingest (core.pipeline / core.multiscan) holds ALL of its intermediate
+state in memory — the device-resident fold carry, the host stream state
+(vocabularies, moment accumulators), and the read position — so a crash
+mid-file previously meant starting over.  This module makes a scan
+restartable: every ``checkpoint.interval.chunks`` folded chunks the
+driver writes a sidecar checkpoint holding
+
+- the BYTE OFFSET of the last folded chunk's end (chunk boundaries are
+  deterministic — ``pipeline.row_chunk_ends`` over the whole buffer —
+  so a resumed run re-derives the identical chunking and skips whole
+  chunks up to the offset),
+- the fold carry pulled to host (``jax.block_until_ready`` then
+  ``np.asarray`` per leaf),
+- the pickled host stream state captured ON THE PRODUCER at the moment
+  the checkpointed chunk was produced (encoder vocabularies, moment
+  accumulators, quarantine budget counts, multiscan per-spec state +
+  withdrawal list) — produce-side capture keeps it consistent with the
+  carry even when the prefetch worker runs ahead of the fold,
+- an input fingerprint + the chunking parameters, validated at load so
+  a checkpoint can never resume against a different file or chunk
+  geometry (that would silently break byte parity).
+
+``--resume`` on the CLI (``checkpoint.resume=true``) loads the sidecar
+and restarts mid-file; a resumed run is byte-identical to an
+uninterrupted one (asserted in tests/test_resilience.py at mesh=1 and
+8-way).  A successful run deletes its sidecar, so stale checkpoints
+never shadow a completed job.
+
+Config surface:
+
+- ``checkpoint.interval.chunks`` — checkpoint every N folded chunks
+  (absent/0 = checkpointing disabled)
+- ``checkpoint.path``            — sidecar path (default ``<out>.ckpt``)
+- ``checkpoint.resume``          — resume from the sidecar if present
+  (the CLI ``--resume`` flag sets this)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import tempfile
+from typing import Any, Dict, Optional
+
+KEY_INTERVAL = "checkpoint.interval.chunks"
+KEY_PATH = "checkpoint.path"
+KEY_RESUME = "checkpoint.resume"
+
+CKPT_VERSION = 1
+_FP_HASH_BYTES = 1 << 20       # fingerprint hashes the first 1 MB
+
+
+class CheckpointMismatch(RuntimeError):
+    """The sidecar does not match this run (different input file or
+    chunking parameters): resuming would silently break byte parity, so
+    fail fast and tell the user to re-run without ``--resume``."""
+
+
+def input_fingerprint(path: str) -> Dict[str, Any]:
+    """A cheap identity for the input file/dir: per-part (name, size)
+    plus a hash of the first part's head — enough to catch "resumed
+    against a different file" without re-reading gigabytes."""
+    from .io import _input_files
+
+    files = _input_files(path)
+    parts = [(os.path.basename(fp), os.path.getsize(fp)) for fp in files]
+    h = hashlib.sha1()
+    if files:
+        with open(files[0], "rb") as fh:
+            h.update(fh.read(_FP_HASH_BYTES))
+    return {"parts": parts, "head_sha1": h.hexdigest()}
+
+
+class CheckpointToken:
+    """One checkpoint-due marker, created on the PRODUCER side: the
+    chunk index/end-offset plus the host stream state pickled at capture
+    time (so later producer-side mutation cannot leak in).  The consumer
+    attaches the blocked fold carry and hands it to ``save``."""
+
+    __slots__ = ("chunk_index", "offset", "state_bytes")
+
+    def __init__(self, chunk_index: int, offset: int, state_obj: Any):
+        self.chunk_index = int(chunk_index)
+        self.offset = int(offset)
+        self.state_bytes = pickle.dumps(state_obj,
+                                        protocol=pickle.HIGHEST_PROTOCOL)
+
+
+class StreamCheckpointer:
+    """Sidecar writer/loader for one streaming scan."""
+
+    def __init__(self, path: str, interval: int, kind: str, in_path: str,
+                 params: Optional[Dict[str, Any]] = None,
+                 resume: bool = False):
+        if interval < 1:
+            raise ValueError(f"{KEY_INTERVAL} must be >= 1: {interval}")
+        self.path = path
+        self.interval = int(interval)
+        self.kind = kind
+        self.in_path = in_path
+        self.params = dict(params or {})
+        self.resume = bool(resume)
+        self.saves = 0
+        self._fp = None
+
+    def _fingerprint(self) -> Dict[str, Any]:
+        """The input fingerprint, computed once per checkpointer: the
+        input cannot change mid-scan (the whole buffer was read up
+        front), and re-hashing a megabyte on every periodic save would
+        be a measurable per-checkpoint tax."""
+        if self._fp is None:
+            self._fp = input_fingerprint(self.in_path)
+        return self._fp
+
+    @classmethod
+    def from_config(cls, config, kind: str, in_path: str, default_path: str,
+                    params: Optional[Dict[str, Any]] = None
+                    ) -> Optional["StreamCheckpointer"]:
+        """The config-driven constructor: None when checkpointing is off
+        AND no resume was requested (``--resume`` alone implies the
+        default interval, so an interrupted checkpointed run can resume
+        without repeating the interval key)."""
+        interval = config.get_int(KEY_INTERVAL, 0)
+        resume = config.get_boolean(KEY_RESUME, False)
+        if interval <= 0 and not resume:
+            return None
+        return cls(config.get(KEY_PATH, default_path),
+                   max(interval, 1) if interval > 0 else 8,
+                   kind, in_path, params=params, resume=resume)
+
+    # -- producer side -----------------------------------------------------
+    def due(self, chunk_index: int) -> bool:
+        return (chunk_index + 1) % self.interval == 0
+
+    def token(self, chunk_index: int, offset: int,
+              state_obj: Any) -> CheckpointToken:
+        return CheckpointToken(chunk_index, offset, state_obj)
+
+    # -- consumer side -----------------------------------------------------
+    def save(self, token: CheckpointToken, carry: Any,
+             extra: Optional[Dict[str, Any]] = None) -> None:
+        """Atomically write the sidecar (tmp + rename: a crash mid-save
+        leaves the previous checkpoint intact)."""
+        payload = {
+            "version": CKPT_VERSION,
+            "kind": self.kind,
+            "fingerprint": self._fingerprint(),
+            "params": self.params,
+            "chunk_index": token.chunk_index,
+            "offset": token.offset,
+            "state": token.state_bytes,
+            "carry": carry,
+            "extra": dict(extra or {}),
+        }
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(prefix=".ckpt-", dir=d)
+        try:
+            with os.fdopen(fd, "wb") as fh:
+                pickle.dump(payload, fh,
+                            protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, self.path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        self.saves += 1
+
+    # -- resume side -------------------------------------------------------
+    def load(self) -> Optional[Dict[str, Any]]:
+        """The validated sidecar payload with ``state`` unpickled, or
+        None when no sidecar exists (resume degrades to a full run —
+        trivially byte-identical).  Raises :class:`CheckpointMismatch`
+        on a version/kind/fingerprint/params mismatch."""
+        if not os.path.exists(self.path):
+            return None
+        with open(self.path, "rb") as fh:
+            payload = pickle.load(fh)
+        if payload.get("version") != CKPT_VERSION:
+            raise CheckpointMismatch(
+                f"checkpoint {self.path}: version "
+                f"{payload.get('version')} != {CKPT_VERSION}")
+        if payload.get("kind") != self.kind:
+            raise CheckpointMismatch(
+                f"checkpoint {self.path}: kind {payload.get('kind')!r} "
+                f"does not match this job ({self.kind!r})")
+        fp = input_fingerprint(self.in_path)
+        if payload.get("fingerprint") != fp:
+            raise CheckpointMismatch(
+                f"checkpoint {self.path} was written against a different "
+                f"input than {self.in_path!r} — re-run without --resume")
+        if payload.get("params") != self.params:
+            raise CheckpointMismatch(
+                f"checkpoint {self.path}: chunking/config params changed "
+                f"({payload.get('params')} != {self.params}) — resuming "
+                f"would break byte parity; re-run without --resume")
+        payload["state"] = pickle.loads(payload["state"])
+        return payload
+
+    def complete(self) -> None:
+        """Remove the sidecar after a successful run (stale checkpoints
+        must never shadow a completed job's output)."""
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass

@@ -1,0 +1,268 @@
+"""Deterministic fault injection: seeded, config-driven fault plans.
+
+The reference substrate got fault tolerance for free — Hadoop re-executes
+failed map tasks, Storm replays tuples — so the original codebase has no
+recovery paths to test.  The TPU rebuild's recovery paths (retry with
+backoff, checkpoint/resume, quarantine, serving circuit breakers; see
+``core.resilience`` / ``core.checkpoint`` / ``serve.breaker``) only stay
+honest if every fault class they claim to handle can be produced ON
+DEMAND and REPRODUCIBLY.  This module is that switchboard: a fault plan
+parsed from the job config names which fault fires at which occurrence
+index of which instrumented point, so a recovery test is an ordinary
+deterministic test, not a race.
+
+Config surface (the .properties files every job loads):
+
+- ``fault.inject.plan`` — semicolon/comma-separated entries::
+
+      <point>@<index>[-<index2>|*][x<count>][:<arg>]
+
+  e.g. ``read@0-1`` (the first two file-read attempts raise a transient
+  I/O error, the third succeeds — the retry path; auto-indexed points
+  count every CALL, so consecutive failures are index ranges, while
+  ``x<count>`` repeats a fault at one explicit chunk index across
+  retries of that same chunk), ``corrupt@3`` (chunk 3's bytes
+  are mangled — the quarantine path), ``slow@5:50`` (a 50 ms stall at
+  chunk 5), ``h2d@4`` (chunk 4's device transfer raises — fail fast with
+  a resumable checkpoint), ``worker_death@6`` (the prefetch worker dies
+  WITHOUT relaying an error — the consumer watchdog path),
+  ``scorer@0-7`` (the first 8 scorer batches fail — opens the serving
+  circuit breaker), ``batcher_death@0`` (a batcher worker thread dies —
+  the serving watchdog restart path).
+- ``fault.inject.seed`` — seeds the corruption byte generator (default
+  2026) so a corrupted chunk is byte-identical across runs.
+
+Instrumented points (grep ``fire(`` / ``mangle(`` call sites):
+
+====================  =====================================================
+``read``              file-read attempts (``native._read_buffer``, the
+                      line-chunk reader) — raises ``InjectedReadError``
+                      (an ``OSError``: retryable)
+``corrupt``           byte chunks by chunk index — bytes are overwritten
+                      (``mangle``), not raised
+``slow``              byte chunks by chunk index — sleeps ``arg`` ms
+                      (default 20)
+``h2d``               host->device chunk transfers — raises
+                      ``InjectedFault`` (non-retryable)
+``worker_death``      byte chunks by chunk index, on the prefetch worker
+                      — raises ``SimulatedWorkerDeath`` (a BaseException
+                      the relay deliberately does NOT catch)
+``scorer``            serving scorer batches — raises
+                      ``InjectedScorerFault``
+``batcher_death``     serving batcher worker loop iterations — raises
+                      ``SimulatedWorkerDeath``
+====================  =====================================================
+
+Disabled-mode cost: ``get_injector()`` returns None until a plan is
+configured, and every call site guards on that — zero work on the hot
+path.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_SEED = 2026
+
+KEY_PLAN = "fault.inject.plan"
+KEY_SEED = "fault.inject.seed"
+
+#: the known instrumented points (parse-time typo guard)
+POINTS = ("read", "corrupt", "slow", "h2d", "worker_death", "scorer",
+          "batcher_death")
+
+
+class InjectedReadError(OSError):
+    """Injected transient I/O failure — an OSError, so the default
+    retry policy (core.resilience) retries it."""
+
+
+class InjectedFault(RuntimeError):
+    """Injected non-retryable failure (e.g. an H2D transfer error): the
+    job must fail fast, leaving any checkpoint behind for ``--resume``."""
+
+
+class InjectedScorerFault(RuntimeError):
+    """Injected serving scorer failure (feeds the circuit breaker)."""
+
+
+class SimulatedWorkerDeath(BaseException):
+    """Simulates a worker thread dying WITHOUT running its error relay
+    (the hard-death case: the relay itself is what failed).  Derives
+    from BaseException so ``except Exception`` handlers — including the
+    batcher's per-batch guard — do not swallow it."""
+
+
+class _Entry:
+    __slots__ = ("point", "lo", "hi", "count", "arg")
+
+    def __init__(self, point: str, lo: int, hi: Optional[int],
+                 count: int, arg: Optional[str]):
+        self.point = point
+        self.lo = lo
+        self.hi = hi          # None = unbounded (the `*` index)
+        self.count = count    # firings per matched index (x<count>)
+        self.arg = arg
+
+    def matches(self, index: int) -> bool:
+        return index >= self.lo and (self.hi is None or index <= self.hi)
+
+    def __repr__(self):
+        hi = "*" if self.hi is None else self.hi
+        return (f"_Entry({self.point}@{self.lo}-{hi}"
+                f"x{self.count}:{self.arg})")
+
+
+def parse_plan(text: str) -> List[_Entry]:
+    """Parse a ``fault.inject.plan`` value into entries (see module
+    docstring for the grammar)."""
+    entries: List[_Entry] = []
+    for raw in text.replace(";", ",").split(","):
+        s = raw.strip()
+        if not s:
+            continue
+        if "@" not in s:
+            raise ValueError(f"bad fault plan entry (no '@'): {s!r}")
+        point, _, spec = s.partition("@")
+        point = point.strip()
+        if point not in POINTS:
+            raise ValueError(
+                f"unknown fault point {point!r}; known: {', '.join(POINTS)}")
+        arg: Optional[str] = None
+        if ":" in spec:
+            spec, _, arg = spec.partition(":")
+        count = 1
+        if "x" in spec:
+            spec, _, cnt = spec.partition("x")
+            count = int(cnt)
+            if count < 1:
+                raise ValueError(f"bad fault count in {s!r}")
+        spec = spec.strip()
+        if spec == "*":
+            lo, hi = 0, None
+        elif "-" in spec:
+            a, _, b = spec.partition("-")
+            lo, hi = int(a), int(b)
+        else:
+            lo = hi = int(spec)
+        entries.append(_Entry(point, lo, hi, count, arg))
+    return entries
+
+
+class FaultInjector:
+    """Fires the planned faults; deterministic per (entry, index).
+
+    Call sites pass an explicit index when the point has a natural one
+    (chunk index); otherwise the injector keeps a per-point occurrence
+    counter (file reads, scorer batches).  Each matched (entry, index)
+    fires at most ``entry.count`` times — so a plan like ``read@0x2``
+    models a TRANSIENT fault (two failures, then success: the retry
+    path) while ``read@0x99`` models a persistent one (the retry budget
+    exhausts and the job fails)."""
+
+    def __init__(self, plan: List[_Entry], seed: int = DEFAULT_SEED):
+        self.plan = plan
+        self.seed = int(seed)
+        self._lock = threading.Lock()
+        self._auto: Dict[str, int] = {}
+        self._fired: Dict[Tuple[int, int], int] = {}
+        self.fired_log: List[Tuple[str, int]] = []
+
+    # -- index bookkeeping -------------------------------------------------
+    def _next_index(self, point: str) -> int:
+        with self._lock:
+            i = self._auto.get(point, 0)
+            self._auto[point] = i + 1
+            return i
+
+    def _due(self, point: str, index: Optional[int]):
+        """The first still-armed entry matching (point, index), consuming
+        one firing; None when nothing fires."""
+        if index is None:
+            index = self._next_index(point)
+        with self._lock:
+            for eid, e in enumerate(self.plan):
+                if e.point != point or not e.matches(index):
+                    continue
+                k = (eid, index)
+                if self._fired.get(k, 0) >= e.count:
+                    continue
+                self._fired[k] = self._fired.get(k, 0) + 1
+                self.fired_log.append((point, index))
+                return e
+        return None
+
+    # -- the injection points ----------------------------------------------
+    def fire(self, point: str, index: Optional[int] = None) -> None:
+        """Raise/sleep per the plan at an instrumented point (no-op when
+        no armed entry matches)."""
+        e = self._due(point, index)
+        if e is None:
+            return
+        where = f"{point}@{index if index is not None else 'auto'}"
+        if point == "read":
+            raise InjectedReadError(f"injected transient read error ({where})")
+        if point == "slow":
+            time.sleep(float(e.arg or 20) / 1000.0)
+            return
+        if point == "h2d":
+            raise InjectedFault(f"injected H2D transfer failure ({where})")
+        if point in ("worker_death", "batcher_death"):
+            raise SimulatedWorkerDeath(f"injected worker death ({where})")
+        if point == "scorer":
+            raise InjectedScorerFault(f"injected scorer failure ({where})")
+        raise InjectedFault(f"injected fault ({where})")     # corrupt via
+        #                                                      mangle() only
+
+    def mangle(self, point: str, index: int, data: bytes) -> bytes:
+        """Return ``data`` corrupted per the plan (identity when no armed
+        entry matches).  ``arg`` "truncate" drops the tail half of the
+        chunk mid-line; the default garbles a seeded window by
+        overwriting its alphanumeric bytes with non-ASCII garbage while
+        PRESERVING delimiters and newlines — every overlapped row keeps
+        its field structure but its numeric fields stop parsing, so the
+        corruption is reliably detected row-by-row (the quarantine
+        path) instead of occasionally fusing two rows into one
+        structurally-valid record that would slip through unlogged."""
+        e = self._due(point, index)
+        if e is None or not data:
+            return data
+        if e.arg == "truncate":
+            return data[:max(len(data) // 2, 1)]
+        rng = random.Random(self.seed * 1_000_003 + index)
+        span = min(len(data), 64)
+        start = rng.randrange(max(len(data) - span, 1))
+        window = bytearray(data[start:start + span])
+        for i, b in enumerate(window):
+            if (0x30 <= b <= 0x39 or 0x41 <= b <= 0x5A
+                    or 0x61 <= b <= 0x7A):
+                window[i] = rng.randrange(0x80, 0xFF)
+        return data[:start] + bytes(window) + data[start + span:]
+
+
+_INJECTOR: Optional[FaultInjector] = None
+
+
+def get_injector() -> Optional[FaultInjector]:
+    """The process-global injector, or None when no plan is configured
+    (the hot-path guard every call site uses)."""
+    return _INJECTOR
+
+
+def set_injector(inj: Optional[FaultInjector]) -> Optional[FaultInjector]:
+    global _INJECTOR
+    _INJECTOR = inj
+    return inj
+
+
+def configure_from_config(config) -> Optional[FaultInjector]:
+    """Install the injector described by ``fault.inject.plan`` (clears
+    any previous injector when the key is absent)."""
+    text = config.get(KEY_PLAN)
+    if not text:
+        return set_injector(None)
+    return set_injector(FaultInjector(
+        parse_plan(text), seed=config.get_int(KEY_SEED, DEFAULT_SEED)))

@@ -238,11 +238,25 @@ class MultiScanEngine:
         return self._encoders.setdefault(key, enc)
 
     # -- the shared scan ---------------------------------------------------
-    def run(self, in_path: str, delim_regex: str = ",") -> Dict[str, Counters]:
+    def run(self, in_path: str, delim_regex: str = ",",
+            checkpointer=None, resume_carries: Optional[dict] = None,
+            resume_offset: int = 0,
+            resume_fed: Sequence[str] = ()) -> Dict[str, Counters]:
         """One streamed pass over ``in_path`` feeding every registered
         spec; returns ``{spec.name: Counters}`` for specs that completed
         fused.  Withdrawn specs are in :attr:`failures` — the caller
-        re-runs those standalone."""
+        re-runs those standalone.
+
+        Checkpoint/resume (core.checkpoint): with a ``checkpointer``,
+        every ``interval`` chunks the produce side captures (pickles)
+        the registered specs + withdrawal list and the consume side
+        saves them with every fold's carry (an async on-device snapshot,
+        materialized one chunk later) and the chunk-end byte offset.  On
+        resume the CALLER re-registers the restored
+        spec objects (their mid-stream state rides the pickle) and
+        passes the saved carries/offset/fed-set here; chunk boundaries
+        derive from the whole buffer, so the resumed scan folds the
+        identical remaining chunks."""
         tracer = get_tracer()
         parent = tracer.current_span_id()
         stager = pipeline.HostStager()
@@ -256,11 +270,32 @@ class MultiScanEngine:
         # depth >= 1); the fold side learns about withdrawals implicitly
         # (a withdrawn spec stops appearing in chunk items)
         active: List[FoldSpec] = list(self.specs)
-        fed_any: set = set()
+        fed_any: set = {s for s in self.specs if s.name in set(resume_fed)}
+        produced: set = {s.name for s in fed_any}
 
-        def encode_chunk(raw: bytes) -> list:
-            """(spec, device tuple | None) pairs for one raw byte chunk —
-            the parse+encode+H2D half, run on the prefetch worker."""
+        def make_fold(spec: FoldSpec) -> pipeline.ChunkFold:
+            return pipeline.ChunkFold(
+                spec.local_fn, static_args=spec.static_args,
+                broadcast_args=spec.broadcast_args, mesh=self.mesh,
+                tracer=tracer, parent=parent,
+                span_name="multiscan.fold",
+                span_attrs={"job": spec.name})
+
+        # seed resumed carries eagerly: a spec may see no further chunks
+        # (the kill happened near EOF) and must still finalize from its
+        # checkpointed carry
+        for spec in self.specs:
+            carry = (resume_carries or {}).get(spec.name)
+            if carry is not None and spec.local_fn is not None:
+                cf = make_fold(spec)
+                cf.seed(carry)
+                folds[spec] = cf
+
+        def encode_chunk(item) -> tuple:
+            """((spec, device tuple | None) pairs, checkpoint token) for
+            one raw byte chunk — the parse+encode+H2D half, run on the
+            prefetch worker."""
+            raw, chunk_idx, end_offset = item
             ctx = ChunkContext(raw, delim_regex, tracer)
             items: list = []
             for spec in list(active):
@@ -296,7 +331,18 @@ class MultiScanEngine:
                     self.failures.append(_SpecFailure(spec, reason))
                     continue
                 items.append((spec, dev))
-            return items
+                produced.add(spec.name)
+            token = None
+            if checkpointer is not None and checkpointer.due(chunk_idx):
+                # produce-side capture: pickling here freezes every
+                # spec's host state as of THIS chunk, consistent with
+                # the carry snapshots the consumer takes after folding it
+                token = checkpointer.token(chunk_idx, end_offset, {
+                    "specs": {s.name: s for s in active},
+                    "failures": [(f.spec.name, f.reason)
+                                 for f in self.failures],
+                    "fed": sorted(produced)})
+            return items, token
 
         def fold_items(items: list) -> None:
             tracer.gauge("multiscan.fanout.width", len(items))
@@ -308,27 +354,42 @@ class MultiScanEngine:
                 if cf is None:
                     # created at the spec's FIRST fold, after its first
                     # encode sized static_args from chunk 0
-                    cf = folds[spec] = pipeline.ChunkFold(
-                        spec.local_fn, static_args=spec.static_args,
-                        broadcast_args=spec.broadcast_args, mesh=self.mesh,
-                        tracer=tracer, parent=parent,
-                        span_name="multiscan.fold",
-                        span_attrs={"job": spec.name})
+                    cf = folds[spec] = make_fold(spec)
                 cf.fold(dev)
 
-        chunks = pipeline.iter_byte_chunks(in_path, self.chunk_rows)
-        if self.prefetch_depth <= 0:
-            # strict serial reference: encode + fold + BLOCK, per chunk
-            def consume(items):
-                fold_items(items)
+        import jax
+
+        serial = self.prefetch_depth <= 0
+        # async checkpointing (pipeline.AsyncCheckpointSaver): per-spec
+        # carry snapshots (device copies) parked at the token's consume,
+        # materialized + written one consume later
+        saver = (pipeline.AsyncCheckpointSaver(
+            checkpointer, tracer,
+            lambda snaps: {name: jax.tree_util.tree_map(np.asarray, snap)
+                           for name, snap in snaps.items()})
+            if checkpointer is not None else None)
+
+        def consume(pair) -> None:
+            items, token = pair
+            fold_items(items)
+            if serial:
+                # strict serial reference: encode + fold + BLOCK
                 for cf in folds.values():
                     cf.block()
-        else:
-            consume = fold_items
+            if saver is not None:
+                saver.flush()
+                if token is not None:
+                    saver.push(token, {spec.name: cf.snapshot()
+                                       for spec, cf in folds.items()})
+
+        chunks = pipeline.iter_byte_chunks_meta(in_path, self.chunk_rows,
+                                                start_offset=resume_offset)
         pipeline.drive_prefetched(chunks, encode_chunk, consume,
                                   self.prefetch_depth, tracer=tracer,
                                   parent=parent,
                                   thread_name="avenir-multiscan-prefetch")
+        if saver is not None:
+            saver.flush()
 
         # -- finalize every surviving spec --------------------------------
         results: Dict[str, Counters] = {}
@@ -457,6 +518,8 @@ def run_multi(config: JobConfig, in_path: str, out_base: Optional[str],
     configs the specs cannot serve, mid-stream withdrawals) — the
     workflow's outputs are complete and byte-identical to running each
     job separately either way."""
+    from .checkpoint import StreamCheckpointer
+
     tracer = get_tracer()
     entries = load_manifest(config, out_base, resolver)
     engine = MultiScanEngine(
@@ -464,11 +527,50 @@ def run_multi(config: JobConfig, in_path: str, out_base: Optional[str],
         chunk_rows=config.pipeline_chunk_rows(
             default=pipeline.DEFAULT_CHUNK_ROWS),
         prefetch_depth=config.pipeline_prefetch_depth())
+
+    fused_ids = [e.jid for e in entries if e.spec is not None]
+    ck = StreamCheckpointer.from_config(
+        config, kind="multiscan", in_path=in_path,
+        default_path=(os.path.join(out_base, "_multiscan.ckpt")
+                      if out_base else in_path + ".multiscan.ckpt"),
+        params={"chunk_rows": engine.chunk_rows,
+                "jobs": ",".join(fused_ids),
+                "delim": config.field_delim_regex()})
+    resume_carries: Dict[str, object] = {}
+    resume_offset = 0
+    resume_fed: List[str] = []
+    restored_failures: Dict[str, str] = {}
+    if ck is not None and ck.resume:
+        payload = ck.load()
+        if payload is not None:
+            state = payload["state"]
+            # restored spec objects carry their mid-stream host state
+            # (vocabularies, caps, host-only buffers); specs pickled in
+            # one dump share encoders, so shared_encoder re-dedupes them
+            # identically on re-registration
+            for e in entries:
+                if e.spec is not None and e.jid in state["specs"]:
+                    e.spec = state["specs"][e.jid]
+            restored_failures = dict(state["failures"])
+            resume_fed = list(state["fed"])
+            resume_carries = payload["carry"] or {}
+            resume_offset = payload["offset"]
+            if log is not None:
+                log(f"multiscan: resuming from {ck.path} at chunk "
+                    f"{payload['chunk_index']} (byte offset "
+                    f"{resume_offset})")
+
     fused: Dict[str, JobEntry] = {}
     standalone: List[Tuple[JobEntry, str]] = []
     for e in entries:
         if e.spec is None:
             standalone.append((e, "no FoldSpec under this class/config"))
+            continue
+        if e.jid in restored_failures:
+            # withdrawn before the kill: the checkpoint remembers, so the
+            # resumed run goes straight to the standalone re-run
+            standalone.append(
+                (e, restored_failures[e.jid] + " (from checkpoint)"))
             continue
         e.spec.name = e.jid
         engine.register(e.spec)
@@ -476,7 +578,10 @@ def run_multi(config: JobConfig, in_path: str, out_base: Optional[str],
 
     results: Dict[str, Counters] = {}
     with tracer.span("multiscan.scan", jobs=",".join(fused)):
-        results.update(engine.run(in_path, config.field_delim_regex()))
+        results.update(engine.run(
+            in_path, config.field_delim_regex(), checkpointer=ck,
+            resume_carries=resume_carries, resume_offset=resume_offset,
+            resume_fed=resume_fed))
     for failure in engine.failures:
         standalone.append((fused[failure.spec.name], failure.reason))
 
@@ -497,5 +602,9 @@ def run_multi(config: JobConfig, in_path: str, out_base: Optional[str],
             if first_error is None:
                 first_error = exc
     if first_error is not None:
+        # the checkpoint sidecar (if any) stays on disk: a failed
+        # workflow is resumable
         raise first_error
+    if ck is not None:
+        ck.complete()
     return results

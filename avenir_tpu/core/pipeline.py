@@ -51,6 +51,7 @@ from typing import Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from . import faultinject
 from .obs import get_tracer
 
 # config keys (the .properties surface; JobConfig prefix fallback applies)
@@ -99,11 +100,21 @@ def rows_for_budget(budget_bytes: int, row_bytes: int,
 # chunk readers (host side)
 # ---------------------------------------------------------------------------
 
+def _open_text(fp: str):
+    """One file-open attempt on the ingest path (a ``read`` fault point;
+    runs under ``with_retries`` so transient failures back off)."""
+    fi = faultinject.get_injector()
+    if fi is not None:
+        fi.fire("read")
+    return open(fp, "r")
+
+
 def iter_line_chunks(path: str, chunk_rows: int) -> Iterator[List[str]]:
     """Yield non-empty record lines in chunks of ``chunk_rows`` — the
     row-chunked form of ``core.io.read_lines`` (same skip-blank contract),
     reading one buffered file at a time so memory is O(chunk)."""
     from .io import _input_files
+    from .resilience import with_retries
 
     if chunk_rows <= 0:
         raise ValueError(f"chunk_rows must be positive: {chunk_rows}")
@@ -111,7 +122,7 @@ def iter_line_chunks(path: str, chunk_rows: int) -> Iterator[List[str]]:
     buf: List[str] = []
     t0 = time.perf_counter_ns()
     for fp in _input_files(path):
-        with open(fp, "r") as fh:
+        with with_retries(_open_text, fp, op="ingest.open") as fh:
             for line in fh:
                 line = line.rstrip("\n")
                 if line:
@@ -197,10 +208,31 @@ def first_nonblank_line(chunk: bytes) -> bytes:
     return b""
 
 
-def iter_byte_chunks(path: str, chunk_rows: int) -> Iterator[bytes]:
-    """Raw byte chunks split at ``row_chunk_ends`` boundaries.  The whole
-    byte buffer is read once (host memory is O(file), matching the
-    native ingest; DEVICE residency stays O(chunk))."""
+def chunk_faults(chunk: bytes, index: int) -> bytes:
+    """Apply the per-chunk fault plan (core.faultinject) to one byte
+    chunk: ``slow`` stalls, ``worker_death`` kills the producing thread
+    without a relay, ``corrupt`` mangles the bytes.  Identity when no
+    injector is configured — the shared hook of every byte-chunk
+    reader, so the fault matrix drives the standalone and multi-scan
+    ingests with one plan vocabulary."""
+    fi = faultinject.get_injector()
+    if fi is None:
+        return chunk
+    fi.fire("slow", index)
+    fi.fire("worker_death", index)
+    return fi.mangle("corrupt", index, chunk)
+
+
+def iter_byte_chunks_meta(path: str, chunk_rows: int,
+                          start_offset: int = 0
+                          ) -> Iterator[Tuple[bytes, int, int]]:
+    """``(chunk, chunk_index, end_offset)`` triples split at
+    ``row_chunk_ends`` boundaries.  The whole byte buffer is read once
+    (host memory is O(file), matching the native ingest; DEVICE
+    residency stays O(chunk)).  ``start_offset`` (a previously
+    checkpointed chunk-end offset) skips whole chunks already folded —
+    boundaries derive from the full buffer, so a resumed scan sees the
+    IDENTICAL chunking as an uninterrupted one, shifted forward."""
     from ..native import _read_buffer
 
     if chunk_rows <= 0:
@@ -211,10 +243,17 @@ def iter_byte_chunks(path: str, chunk_rows: int) -> Iterator[bytes]:
     if not buf:
         return
     pos = 0
-    for end in row_chunk_ends(buf, chunk_rows):
-        if end > pos:
-            yield buf[pos:end]
+    for idx, end in enumerate(row_chunk_ends(buf, chunk_rows)):
+        if end > pos and end > start_offset:
+            yield chunk_faults(buf[pos:end], idx), idx, end
         pos = end
+
+
+def iter_byte_chunks(path: str, chunk_rows: int) -> Iterator[bytes]:
+    """Raw byte chunks (the offset-free view of
+    :func:`iter_byte_chunks_meta`)."""
+    for chunk, _, _ in iter_byte_chunks_meta(path, chunk_rows):
+        yield chunk
 
 
 def peek(it: Iterable):
@@ -333,7 +372,15 @@ def drive_prefetched(chunks: Iterable, produce: Callable, consume: Callable,
     producer/queue/shutdown protocol shared by ``streaming_fold`` and
     the multi-scan engine: exceptions from either side propagate to the
     caller, and teardown signals the producer then drains until any
-    blocked put frees."""
+    blocked put frees.
+
+    Worker-death contract: a producer exception is relayed through BOTH
+    a side cell (written first — it cannot block) and the queue; the
+    consumer's bounded-timeout ``get`` doubles as a liveness watchdog,
+    so a worker that dies WITHOUT managing to relay (the relay itself
+    failed, or an injected ``worker_death`` fault that deliberately
+    bypasses it) surfaces as an exception to the caller instead of the
+    consumer blocking on the queue forever (the pre-fix deadlock)."""
     tracer = tracer or get_tracer()
     if depth <= 0:
         for item in chunks:
@@ -342,6 +389,7 @@ def drive_prefetched(chunks: Iterable, produce: Callable, consume: Callable,
 
     q: queue.Queue = queue.Queue(maxsize=depth)
     stop = threading.Event()
+    worker_exc: list = [None]
 
     def worker():
         tracer.adopt(parent)
@@ -357,14 +405,32 @@ def drive_prefetched(chunks: Iterable, produce: Callable, consume: Callable,
                 q.put(produce(item))
                 tracer.gauge("ingest.prefetch.queue.depth", q.qsize())
             q.put(_DONE)
+        except faultinject.SimulatedWorkerDeath:
+            # the injected HARD death: the thread ends without relaying
+            # anything (as if the relay itself had failed) — the
+            # consumer's liveness watchdog below must catch it
+            return
         except BaseException as exc:  # noqa: BLE001 — relayed to caller
+            worker_exc[0] = exc      # side channel first: cannot block
             q.put(_PrefetchError(exc))
 
     t = threading.Thread(target=worker, daemon=True, name=thread_name)
     t.start()
     try:
         while True:
-            item = q.get()
+            try:
+                item = q.get(timeout=0.1)
+            except queue.Empty:
+                # liveness watchdog: queue empty AND worker gone means
+                # no sentinel is ever coming — surface the original
+                # exception (or a hard-death report) instead of hanging
+                if not t.is_alive():
+                    if worker_exc[0] is not None:
+                        raise worker_exc[0]
+                    raise RuntimeError(
+                        f"prefetch worker {thread_name!r} died without "
+                        f"signaling an error (hard thread death)")
+                continue
             if item is _DONE:
                 break
             if isinstance(item, _PrefetchError):
@@ -530,6 +596,12 @@ class ChunkTransfer:
     def __call__(self, arrs: Tuple[np.ndarray, ...]) -> tuple:
         import jax
 
+        fi = faultinject.get_injector()
+        if fi is not None:
+            # an H2D failure is NOT retryable (re-putting a buffer whose
+            # transfer half-completed is backend-undefined): it fails the
+            # job fast, leaving the checkpoint for --resume
+            fi.fire("h2d")
         with self.tracer.span("ingest.h2d",
                               staged_reuses=self.stager.reuses):
             arrs = tuple(np.asarray(a) for a in arrs)
@@ -579,6 +651,31 @@ class ChunkFold:
         self.carry = None
         self._fns = None
 
+    def seed(self, carry_host) -> None:
+        """Seed the carry from a host pytree (a checkpointed fold state,
+        replicated onto the mesh): subsequent chunks accumulate on top of
+        it, so a resumed stream continues exactly where the checkpointed
+        one stopped."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(self.mesh, P())
+        self.carry = jax.tree_util.tree_map(
+            lambda t: jax.device_put(np.asarray(t), sharding), carry_host)
+
+    def snapshot(self):
+        """An on-device COPY of the carry, dispatched asynchronously (no
+        host sync): the copy breaks the donation chain — the next fold
+        donates the original buffer, not this one — so the caller can
+        materialize it to host LATER, after further folds have been
+        dispatched, and the device never idles for a checkpoint (async
+        checkpointing; measured in bench resilience_overhead_pct)."""
+        import jax
+        import jax.numpy as jnp
+        if self.carry is None:
+            return None
+        return jax.tree_util.tree_map(jnp.copy, self.carry)
+
     def fold(self, dev: tuple) -> None:
         with self.tracer.span(self.span_name, parent=self.parent,
                               **self.span_attrs):
@@ -605,13 +702,60 @@ class ChunkFold:
         return jax.tree_util.tree_map(np.asarray, self.carry)
 
 
+class Checkpointed:
+    """A chunk item carrying a checkpoint token (core.checkpoint): the
+    producer wraps the chunk arrays it wants a checkpoint AFTER, and
+    ``streaming_fold`` snapshots the carry once that chunk's fold has
+    been dispatched (an async on-device copy, written out one chunk
+    later)."""
+
+    __slots__ = ("arrays", "token")
+
+    def __init__(self, arrays: tuple, token):
+        self.arrays = arrays
+        self.token = token
+
+
+class AsyncCheckpointSaver:
+    """The deferred-save half of async checkpointing, shared by
+    ``streaming_fold`` and the multi-scan engine: ``push`` parks a
+    (token, device-snapshot) pair; ``flush`` — called at every
+    subsequent consume and once after the stream ends — materializes the
+    snapshot to host and writes the sidecar.  By flush time the NEXT
+    fold has been dispatched, so the host sync overlaps useful device
+    work instead of draining the pipeline (the ordering contract lives
+    HERE, once, for both engines)."""
+
+    __slots__ = ("_ck", "_tracer", "_to_host", "_pending")
+
+    def __init__(self, checkpointer, tracer, to_host: Callable):
+        self._ck = checkpointer
+        self._tracer = tracer
+        self._to_host = to_host      # device snapshot -> host pytree
+        self._pending = None
+
+    def push(self, token, snapshot) -> None:
+        self.flush()                 # never hold more than one
+        self._pending = (token, snapshot)
+
+    def flush(self) -> None:
+        if self._pending is None:
+            return
+        tok, snap = self._pending
+        self._pending = None
+        with self._tracer.span("checkpoint.save", chunk=tok.chunk_index):
+            self._ck.save(tok, self._to_host(snap))
+
+
 def streaming_fold(chunks: Iterable[Tuple[np.ndarray, ...]],
                    local_fn: Callable,
                    static_args: tuple = (),
                    broadcast_args: Sequence[np.ndarray] = (),
                    mesh=None,
                    prefetch_depth: int = DEFAULT_PREFETCH_DEPTH,
-                   capacity: Optional[int] = None):
+                   capacity: Optional[int] = None,
+                   checkpointer=None,
+                   initial_carry=None):
     """Fold row chunks into one replicated count pytree on device.
 
     ``chunks`` yields tuples of host arrays sharing a leading row count
@@ -636,6 +780,16 @@ def streaming_fold(chunks: Iterable[Tuple[np.ndarray, ...]],
     was empty.  Exceptions in the generator (e.g. a cap-guard
     ``ChunkedEncodeUnsupported``) propagate to the caller regardless of
     which thread raised them.
+
+    Checkpoint/resume (core.checkpoint): items may be
+    :class:`Checkpointed` wrappers — after folding such a chunk the
+    engine snapshots the carry (an async on-device copy) and hands it,
+    materialized one consume later so the host sync overlaps the next
+    fold, with the token to ``checkpointer.save``.  ``initial_carry``
+    (a host pytree
+    from a loaded checkpoint) seeds the fold, so a resumed stream —
+    possibly empty, when the kill happened after the last chunk —
+    continues from the checkpointed state.
     """
     from ..parallel.mesh import get_mesh
 
@@ -649,14 +803,37 @@ def streaming_fold(chunks: Iterable[Tuple[np.ndarray, ...]],
     cf = ChunkFold(local_fn, static_args=static_args,
                    broadcast_args=broadcast_args, mesh=mesh, tracer=tracer,
                    parent=parent)
+    if initial_carry is not None:
+        cf.seed(initial_carry)
 
-    if prefetch_depth <= 0:
-        # strict serial: parse -> transfer -> fold -> BLOCK, per chunk
-        def consume(dev):
-            cf.fold(dev)
+    def produce(item):
+        if isinstance(item, Checkpointed):
+            return transfer(item.arrays), item.token
+        return transfer(item), None
+
+    import jax
+
+    serial = prefetch_depth <= 0
+    saver = (AsyncCheckpointSaver(
+        checkpointer, tracer,
+        lambda snap: jax.tree_util.tree_map(np.asarray, snap))
+        if checkpointer is not None else None)
+
+    def consume(pair):
+        dev, token = pair
+        cf.fold(dev)
+        if serial:
+            # strict serial: parse -> transfer -> fold -> BLOCK, per chunk
             cf.block()
-    else:
-        consume = cf.fold
-    drive_prefetched(chunks, transfer, consume, prefetch_depth,
+        if saver is not None:
+            saver.flush()
+            if token is not None:
+                # async checkpoint: snapshot now (device copy, no sync),
+                # write at the next consume / stream end
+                saver.push(token, cf.snapshot())
+
+    drive_prefetched(chunks, produce, consume, prefetch_depth,
                      tracer=tracer, parent=parent)
+    if saver is not None:
+        saver.flush()
     return cf.result()

@@ -281,18 +281,48 @@ class BayesianDistribution:
         tracer = get_tracer()
         with tracer.span("phase:train"):
             lines = self._train_streamed(in_path, delim_in, delim, counters,
-                                         mesh)
+                                         mesh, out_path=out_path)
             if lines is None:
                 with tracer.span("phase:load"):
-                    enc = DatasetEncoder(self.schema)
-                    ds = enc.encode_path(in_path, delim_in)
+                    ds = self._encode_monolithic(in_path, out_path, delim_in,
+                                                 counters)
                 lines = self.train_lines(ds, delim, counters, mesh=mesh)
         with tracer.span("phase:emit"):
             write_output(out_path, lines)
         return counters
 
+    def _encode_monolithic(self, in_path: str, out_path: str,
+                           delim_in: str, counters: Counters):
+        """One-shot fallback encode; with an ``ingest.error.budget``
+        configured it pre-filters malformed rows into the quarantine
+        sidecar (the streamed path quarantines per chunk — this keeps
+        the fallback's contract identical)."""
+        from ..core.io import read_lines, split_line
+        from ..core.resilience import RowQuarantine, row_guard
+
+        enc = DatasetEncoder(self.schema)
+        quarantine = RowQuarantine.from_config(
+            self.config, out_path + ".quarantine")
+        if quarantine is None:
+            return enc.encode_path(in_path, delim_in)
+        guard = row_guard(enc)
+        good, bad = [], []
+        for line in read_lines(in_path):
+            fields = split_line(line, delim_in)
+            if guard(fields):
+                good.append(fields)
+            else:
+                bad.append(line)
+        if bad:
+            quarantine.record(bad, "rows rejected by schema guard")
+        quarantine.admit(len(good))
+        quarantine.finish(counters)
+        return enc.encode(good)
+
     def _train_streamed(self, in_path: str, delim_in: str, delim: str,
-                        counters: Counters, mesh=None) -> Optional[List[str]]:
+                        counters: Counters, mesh=None,
+                        out_path: Optional[str] = None
+                        ) -> Optional[List[str]]:
         """Chunked streaming training through ``core.pipeline``: the C
         encode + host-moment pass of chunk c+1 runs on the prefetch
         worker while chunk c's H2D copy and jitted, donated count fold
@@ -305,9 +335,19 @@ class BayesianDistribution:
         (+headroom); data that overflows a cap — late-appearing
         categories, negative or beyond-declared bins — returns None and
         the caller re-runs the one-shot ``encode_path`` path, so results
-        are always identical to the serial encode."""
+        are always identical to the serial encode.
+
+        Resilience surface (core.checkpoint / core.resilience): with
+        ``checkpoint.interval.chunks`` set, a sidecar checkpoint (carry
+        + encoder vocabularies + stream state + byte offset) is written
+        every N folded chunks and ``--resume`` restarts mid-file with
+        byte-identical output; with ``ingest.error.budget`` set,
+        malformed rows quarantine to a sidecar instead of failing the
+        chunk."""
         from ..core import pipeline
         from ..core.binning import ChunkedEncodeUnsupported
+        from ..core.checkpoint import StreamCheckpointer
+        from ..core.resilience import RowQuarantine, salvage_chunk
 
         enc = DatasetEncoder(self.schema)
         F = len(enc.feature_fields)
@@ -316,37 +356,94 @@ class BayesianDistribution:
         # int8 narrowing only shrinks the live set under the budget)
         chunk_rows = self.config.pipeline_chunk_rows(row_bytes=4 * (F + 1))
         depth = self.config.pipeline_prefetch_depth()
+        sidecar_base = out_path if out_path is not None else in_path
+        ck = StreamCheckpointer.from_config(
+            self.config, kind="nb-train", in_path=in_path,
+            default_path=sidecar_base + ".ckpt",
+            params={"chunk_bytes": chunk_bytes, "chunk_rows": chunk_rows,
+                    "delim": delim_in})
+        quarantine = RowQuarantine.from_config(
+            self.config, sidecar_base + ".quarantine")
+
         st = _NBStreamState(enc)
+        start_offset = 0
+        initial_carry = None
+        resumed = False
+        if ck is not None and ck.resume:
+            payload = ck.load()
+            if payload is not None:
+                # the checkpointed encoder/stream state REPLACES the
+                # fresh one: vocabularies, caps, moment accumulators and
+                # budget counts continue exactly where the killed run's
+                # last checkpoint left them
+                enc = payload["state"]["enc"]
+                st = payload["state"]["st"]
+                if quarantine is not None and payload["state"].get("q"):
+                    quarantine.restore(payload["state"]["q"])
+                initial_carry = payload["carry"]
+                start_offset = payload["offset"]
+                resumed = True
+
+        salvage = (salvage_chunk(enc, quarantine, delim_in)
+                   if quarantine is not None else None)
         try:
             gen = enc.encode_path_chunks(in_path, delim_in,
                                          chunk_bytes=chunk_bytes,
-                                         chunk_rows=chunk_rows)
-            first, gen = pipeline.peek(gen)
-            if first is None:
-                return None
-            # declared categorical cardinalities are pre-seeded into the
-            # vocab, so the emit loop walks len(vocab) bins even when the
-            # data uses fewer — the count tensor must cover them
-            st.size_caps(first[0])
+                                         chunk_rows=chunk_rows,
+                                         start_offset=start_offset,
+                                         with_offsets=True,
+                                         salvage=salvage)
+            if not resumed:
+                first, gen = pipeline.peek(gen)
+                if first is None:
+                    return None
+                # declared categorical cardinalities are pre-seeded into
+                # the vocab, so the emit loop walks len(vocab) bins even
+                # when the data uses fewer — the count tensor must cover
+                # them
+                st.size_caps(first[0])
 
             def chunks():
                 # guards + dtype narrowing + host moments run HERE — on
                 # the prefetch worker when depth >= 1, overlapping the
-                # device fold of the previous chunk
-                for x, values, y, n in gen:
+                # device fold of the previous chunk.  Checkpoint tokens
+                # capture (pickle) the host state at produce time, so a
+                # prefetch worker running ahead cannot leak later-chunk
+                # state into an earlier checkpoint.
+                for x, values, y, n, idx, end in gen:
+                    if quarantine is not None:
+                        quarantine.admit(n)
                     out = st.accept(x, values, y, n)
-                    if out is not None:
+                    if out is None:
+                        continue
+                    if ck is not None and ck.due(idx):
+                        token = ck.token(idx, end, {
+                            "enc": enc, "st": st,
+                            "q": (quarantine.state()
+                                  if quarantine is not None else None)})
+                        yield pipeline.Checkpointed(out, token)
+                    else:
                         yield out
 
             total = pipeline.streaming_fold(
                 chunks(), _nb_local,
                 static_args=(st.n_class_cap, st.bins_cap),
-                mesh=mesh, prefetch_depth=depth, capacity=chunk_rows)
+                mesh=mesh, prefetch_depth=depth, capacity=chunk_rows,
+                checkpointer=ck, initial_carry=initial_carry)
         except ChunkedEncodeUnsupported:
+            if ck is not None:
+                # the fallback run supersedes any sidecar this attempt
+                # wrote — a stale checkpoint must not shadow it
+                ck.complete()
             return None
         if total is None:
             return None
-        return self._streamed_model_lines(enc, st, total, counters, delim)
+        if quarantine is not None:
+            quarantine.finish(counters)
+        lines = self._streamed_model_lines(enc, st, total, counters, delim)
+        if ck is not None:
+            ck.complete()
+        return lines
 
     def _streamed_model_lines(self, enc: DatasetEncoder,
                               st: _NBStreamState, total, counters: Counters,

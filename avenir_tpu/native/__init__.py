@@ -43,12 +43,21 @@ BUCKET, FLOATVAL, CAT = 1, 2, 4      # csv_encode column roles
 Y_DEST = -2                          # feat_idx routing a CAT column to ycol
 
 
+def _cc_run(cc: str):
+    """One compiler invocation (run under ``with_retries``: a transient
+    OSError — fork failure, tmpfs hiccup — backs off and reattempts
+    before the next compiler is tried)."""
+    return subprocess.run(
+        [cc, "-O3", "-pthread", "-shared", "-fPIC", "-o", _SO, _SRC],
+        capture_output=True, timeout=120)
+
+
 def _compile() -> bool:
+    from ..core.resilience import with_retries
+
     for cc in ("cc", "gcc", "g++"):
         try:
-            proc = subprocess.run(
-                [cc, "-O3", "-pthread", "-shared", "-fPIC", "-o", _SO, _SRC],
-                capture_output=True, timeout=120)
+            proc = with_retries(_cc_run, cc, op="native.compile")
         except (OSError, subprocess.TimeoutExpired):
             continue
         if proc.returncode == 0:
@@ -105,13 +114,26 @@ def get_lib():
     return _lib
 
 
+def _read_part(fp: str) -> bytes:
+    """One part-file read attempt (a ``read`` fault-injection point,
+    run under ``with_retries`` so transient I/O errors back off)."""
+    from ..core import faultinject
+    fi = faultinject.get_injector()
+    if fi is not None:
+        fi.fire("read")
+    with open(fp, "rb") as fh:
+        return fh.read()
+
+
 def _read_buffer(path: str) -> bytes:
-    """Concatenate a file or every part file of a job-output directory."""
+    """Concatenate a file or every part file of a job-output directory
+    (the bulk-ingest read: every chunked scan starts here, so this is
+    the retried read on the ingest hot path)."""
     from ..core.io import _input_files
+    from ..core.resilience import with_retries
     parts = []
     for fp in _input_files(path):
-        with open(fp, "rb") as fh:
-            parts.append(fh.read())
+        parts.append(with_retries(_read_part, fp, op="ingest.read"))
     return b"\n".join(parts)
 
 

@@ -21,12 +21,18 @@ PAPERS.md "Online serving").
 - ``server``   — stdlib JSON-lines TCP frontend + the ``python -m
   avenir_tpu serve`` CLI entry, exporting per-model counters (requests,
   batches, shed, batch-fill, p50/p95/p99 latency) through ``Counters``.
+- ``breaker``  — per-model circuit breaker (open after K consecutive
+  scorer failures, half-open probes) behind the graceful-degradation
+  surface: deadlines, degraded health, and a watchdog that restarts dead
+  batcher workers (README "Fault tolerance").
 """
 
 from .batcher import MicroBatcher, ShedError                    # noqa: F401
+from .breaker import CircuitBreaker, CircuitOpenError           # noqa: F401
 from .engine import ADAPTER_KINDS, pow2_bucket                  # noqa: F401
 from .registry import ModelRegistry                             # noqa: F401
 from .server import PredictionServer, serve_main                # noqa: F401
 
-__all__ = ["ADAPTER_KINDS", "MicroBatcher", "ModelRegistry",
-           "PredictionServer", "ShedError", "pow2_bucket", "serve_main"]
+__all__ = ["ADAPTER_KINDS", "CircuitBreaker", "CircuitOpenError",
+           "MicroBatcher", "ModelRegistry", "PredictionServer",
+           "ShedError", "pow2_bucket", "serve_main"]

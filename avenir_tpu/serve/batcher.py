@@ -24,6 +24,20 @@ p50/p95/p99 from log-bucket interpolation — replacing the old raw-sample
 sort that grew and re-sorted a window on every stats call), and the
 worker emits ``serve.batch`` / ``serve.queue.wait`` / ``serve.assemble``
 / ``serve.score`` spans plus a queue-depth gauge when tracing is on.
+
+Graceful degradation (this PR's resilience layer):
+
+- **Deadlines** — with ``serve.request.deadline.ms`` set, a request that
+  is still queued past its deadline gets a ``TimeoutError`` at drain
+  time (the frontend renders a timeout error response) instead of being
+  scored late; no client ever waits past its deadline for a response.
+- **Circuit breaker** — batch-level scorer failures feed the per-model
+  :class:`serve.breaker.CircuitBreaker`; while open, ``submit`` fails
+  fast with ``CircuitOpenError``.
+- **Worker watchdog** — :meth:`ensure_worker` restarts a dead dispatch
+  worker (called defensively on submit and periodically by the server's
+  watchdog thread), so a single escaped exception can never permanently
+  wedge the queue: pending requests are drained by the replacement.
 """
 
 from __future__ import annotations
@@ -34,8 +48,10 @@ from collections import deque
 from concurrent.futures import Future
 from typing import Callable, List, Optional
 
+from ..core import faultinject
 from ..core.metrics import Counters
 from ..core.obs import LatencyHistogram, get_tracer
+from .breaker import CircuitBreaker, CircuitOpenError
 
 SERVE_GROUP = "Serve"
 
@@ -45,12 +61,14 @@ class ShedError(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("line", "future", "t_enqueue")
+    __slots__ = ("line", "future", "t_enqueue", "deadline")
 
-    def __init__(self, line: str):
+    def __init__(self, line: str, deadline_s: float = 0.0):
         self.line = line
         self.future: Future = Future()
         self.t_enqueue = time.perf_counter()
+        # absolute drop-dead time on the same clock (0 = no deadline)
+        self.deadline = (self.t_enqueue + deadline_s) if deadline_s else 0.0
 
 
 class MicroBatcher:
@@ -62,13 +80,17 @@ class MicroBatcher:
                  max_batch: int = 64,
                  max_delay_ms: float = 2.0,
                  max_queue_depth: int = 256,
-                 hist_buckets: Optional[int] = None):
+                 hist_buckets: Optional[int] = None,
+                 deadline_ms: float = 0.0,
+                 breaker: Optional[CircuitBreaker] = None):
         self.name = name
         self.predict_fn = predict_fn
         self.counters = counters
         self.max_batch = max(1, int(max_batch))
         self.max_delay = max(0.0, float(max_delay_ms)) / 1000.0
         self.max_queue_depth = max(1, int(max_queue_depth))
+        self.deadline_s = max(0.0, float(deadline_ms)) / 1000.0
+        self.breaker = breaker
         self._q: deque = deque()
         self._cv = threading.Condition()
         self._closed = False
@@ -78,15 +100,27 @@ class MicroBatcher:
         hkw = {"n_buckets": hist_buckets} if hist_buckets else {}
         self.e2e_hist = LatencyHistogram(**hkw)
         self.queue_wait_hist = LatencyHistogram(**hkw)
-        self._worker = threading.Thread(
-            target=self._run, name=f"serve-batcher-{name}", daemon=True)
-        self._worker.start()
+        self._worker = self._start_worker()
+
+    def _start_worker(self) -> threading.Thread:
+        t = threading.Thread(
+            target=self._run, name=f"serve-batcher-{self.name}",
+            daemon=True)
+        t.start()
+        return t
 
     # -- client side -------------------------------------------------------
     def submit(self, line: str) -> Future:
         """Enqueue one request line; the Future resolves to the output
-        line (or raises).  Sheds with ShedError past the depth limit."""
-        req = _Request(line)
+        line (or raises).  Sheds with ShedError past the depth limit;
+        fails fast with CircuitOpenError while the model's breaker is
+        open."""
+        if self.breaker is not None and not self.breaker.allow():
+            self.counters.incr(SERVE_GROUP, "Breaker rejected")
+            raise CircuitOpenError(
+                f"model {self.name!r} circuit breaker is "
+                f"{self.breaker.state} after consecutive scorer failures")
+        req = _Request(line, self.deadline_s)
         with self._cv:
             if self._closed:
                 raise RuntimeError(f"batcher {self.name} is closed")
@@ -96,6 +130,9 @@ class MicroBatcher:
                     f"queue depth {len(self._q)} at serve.queue.max.depth")
             self._q.append(req)
             self._cv.notify()
+        # defensive liveness check: if the dispatch worker died, restart
+        # it now so this request is not parked behind a dead thread
+        self.ensure_worker()
         return req.future
 
     # -- worker side -------------------------------------------------------
@@ -123,9 +160,40 @@ class MicroBatcher:
                     batch.append(self._q.popleft())
                 return batch
 
+    def _expire(self, batch: List[_Request],
+                now: float) -> List[_Request]:
+        """Drop requests whose deadline passed while queued: they get a
+        TimeoutError NOW (the client is already gone or about to give
+        up) and the batch scores only live requests."""
+        live = []
+        for r in batch:
+            if r.deadline and now > r.deadline:
+                self.counters.incr(SERVE_GROUP, "Deadline expired")
+                if r.future.set_running_or_notify_cancel():
+                    r.future.set_exception(TimeoutError(
+                        "request deadline exceeded in queue "
+                        "(serve.request.deadline.ms)"))
+            else:
+                live.append(r)
+        return live
+
     def _run(self) -> None:
+        try:
+            self._run_loop()
+        except faultinject.SimulatedWorkerDeath:
+            # injected hard death: the thread ends abruptly (observably
+            # identical to any BaseException escaping the loop) — the
+            # watchdog restart path takes over
+            return
+
+    def _run_loop(self) -> None:
         tracer = get_tracer()
         while True:
+            fi = faultinject.get_injector()
+            if fi is not None:
+                # injected batcher worker death (BaseException: nothing
+                # below catches it) — the watchdog restart path
+                fi.fire("batcher_death")
             batch = self._drain_batch()
             if not batch:
                 with self._cv:
@@ -133,6 +201,9 @@ class MicroBatcher:
                         return
                 continue
             t_drain = time.perf_counter()
+            batch = self._expire(batch, t_drain)
+            if not batch:
+                continue
             oldest = min(r.t_enqueue for r in batch)
             for r in batch:
                 self.queue_wait_hist.record(t_drain - r.t_enqueue)
@@ -150,14 +221,21 @@ class MicroBatcher:
                 try:
                     with tracer.span("serve.score", model=self.name,
                                      batch=len(batch)):
+                        fi_score = faultinject.get_injector()
+                        if fi_score is not None:
+                            fi_score.fire("scorer")
                         outputs = self.predict_fn([r.line for r in batch])
                 except Exception as e:                 # noqa: BLE001
                     self.counters.incr(SERVE_GROUP, "Batch errors")
+                    if self.breaker is not None:
+                        self.breaker.record_failure()
                     for r in batch:
                         if not r.future.set_running_or_notify_cancel():
                             continue
                         r.future.set_exception(e)
                     continue
+                if self.breaker is not None:
+                    self.breaker.record_success()
                 done = time.perf_counter()
                 for r in batch:
                     self.e2e_hist.record(done - r.t_enqueue)
@@ -208,9 +286,32 @@ class MicroBatcher:
         with self._cv:
             return len(self._q)
 
+    def worker_alive(self) -> bool:
+        return self._worker.is_alive()
+
+    def ensure_worker(self) -> bool:
+        """Restart the dispatch worker if it died (an exception escaped
+        ``_run`` — e.g. a BaseException from a scorer); returns True
+        when a restart happened.  Requests already queued are drained by
+        the replacement worker, so a single worker death never wedges
+        the queue.  Called defensively from ``submit`` and periodically
+        by the server watchdog."""
+        with self._cv:
+            if self._closed or self._worker.is_alive():
+                return False
+            self.counters.incr(SERVE_GROUP, "Worker restarts")
+            self._worker = self._start_worker()
+            return True
+
     def close(self, drain: bool = True) -> None:
         """Stop the worker; with ``drain`` pending requests are scored
-        first, otherwise they fail."""
+        first, otherwise they fail.  A DEAD worker cannot drain — once
+        ``_closed`` is set ``ensure_worker`` refuses to restart, so
+        draining through a dead worker would leave the queued futures
+        unresolved until every client times out; fail them fast
+        instead."""
+        if drain and not self._worker.is_alive():
+            drain = False
         with self._cv:
             self._closed = True
             if not drain:

@@ -21,7 +21,11 @@ Config surface (serve.properties): ``serve.host`` (default 127.0.0.1),
 stderr), ``serve.batch.max.size``, ``serve.batch.max.delay.ms``,
 ``serve.queue.max.depth``, ``serve.request.timeout.sec``, plus the
 registry's ``serve.models`` / ``serve.model.<name>.*`` surface and
-``serve.warmup`` (default true) — see registry.py.
+``serve.warmup`` (default true) — see registry.py.  Graceful-degradation
+keys (README "Fault tolerance"): ``serve.request.deadline.ms``,
+``serve.breaker.failures`` / ``serve.breaker.reset.sec`` /
+``serve.breaker.probe.requests``, ``serve.watchdog.interval.sec``,
+``serve.max.line.bytes``.
 """
 
 from __future__ import annotations
@@ -31,45 +35,91 @@ import socket
 import socketserver
 import sys
 import threading
+import time
 from typing import Dict, Optional
 
 from ..core import obs
 from ..core.config import JobConfig, load_job_config, parse_cli_args
 from .batcher import MicroBatcher, ShedError
+from .breaker import CircuitBreaker, CircuitOpenError
 from .registry import ModelEntry, ModelRegistry
+
+# a distinct class pre-3.11, an alias of the builtin after
+from concurrent.futures import TimeoutError as _FutureTimeout
+
+DEFAULT_MAX_LINE_BYTES = 1 << 20
 
 
 class PredictionServer:
     """In-process serving stack: registry + per-model batchers + TCP
-    frontend.  Usable embedded (tests, bench) or via ``serve_main``."""
+    frontend.  Usable embedded (tests, bench) or via ``serve_main``.
+
+    Graceful-degradation surface (see batcher.py / breaker.py):
+    ``serve.request.deadline.ms`` (timeout responses instead of silent
+    waits), ``serve.breaker.*`` (per-model circuit breaker — ``health``
+    reports ``degraded`` models), ``serve.watchdog.interval.sec`` (a
+    watchdog restarts any dead batcher worker), and
+    ``serve.max.line.bytes`` (the frontend survives oversized or
+    malformed request lines with a structured error response)."""
 
     def __init__(self, config: JobConfig, mesh=None):
         self.config = config
         self.registry = ModelRegistry(config, mesh=mesh)
         self.timeout = config.get_float("serve.request.timeout.sec", 30.0)
+        self.deadline_s = max(
+            0.0, config.get_float("serve.request.deadline.ms", 0.0)) / 1000.0
+        self.max_line_bytes = config.get_int("serve.max.line.bytes",
+                                             DEFAULT_MAX_LINE_BYTES)
         self._batch_kw = dict(
             max_batch=config.get_int("serve.batch.max.size", 64),
             max_delay_ms=config.get_float("serve.batch.max.delay.ms", 2.0),
             max_queue_depth=config.get_int("serve.queue.max.depth", 256),
-            hist_buckets=obs.histogram_buckets_from_config(config))
+            hist_buckets=obs.histogram_buckets_from_config(config),
+            deadline_ms=config.get_float("serve.request.deadline.ms", 0.0))
         self._batchers: Dict[str, MicroBatcher] = {}
         self._lock = threading.Lock()
         self._tcp: Optional[socketserver.ThreadingTCPServer] = None
         self._tcp_thread: Optional[threading.Thread] = None
+        self._stop_watchdog = threading.Event()
         warm = config.get_boolean("serve.warmup", True)
         for entry in self.registry.load_all(warmup=warm):
             self._attach(entry)
+        self._watchdog_thread = self._start_watchdog(
+            config.get_float("serve.watchdog.interval.sec", 0.5))
 
     # -- model plumbing ----------------------------------------------------
     def _attach(self, entry: ModelEntry) -> None:
-        """(Re)wire a model's batcher to the given entry's adapter."""
+        """(Re)wire a model's batcher to the given entry's adapter (a
+        reload also gets a FRESH breaker: swapping in a repaired
+        artifact should not inherit the broken one's open circuit)."""
         with self._lock:
             old = self._batchers.get(entry.name)
             self._batchers[entry.name] = MicroBatcher(
                 entry.name, entry.adapter.predict_lines, entry.counters,
+                breaker=CircuitBreaker.from_config(self.config, entry.name),
                 **self._batch_kw)
         if old is not None:
             old.close(drain=True)
+
+    # -- watchdog ----------------------------------------------------------
+    def _start_watchdog(self, interval_s: float) -> Optional[threading.Thread]:
+        """A daemon thread that restarts any dead batcher worker every
+        ``interval_s`` (0 disables — the defensive restart in
+        ``submit`` still applies)."""
+        if interval_s <= 0:
+            return None
+
+        def watch():
+            while not self._stop_watchdog.wait(interval_s):
+                with self._lock:
+                    batchers = list(self._batchers.values())
+                for b in batchers:
+                    b.ensure_worker()
+
+        t = threading.Thread(target=watch, name="serve-watchdog",
+                             daemon=True)
+        t.start()
+        return t
 
     def batcher(self, name: str) -> MicroBatcher:
         with self._lock:
@@ -103,10 +153,7 @@ class PredictionServer:
             if cmd == "stats":
                 return self._stats()
             if cmd == "health":
-                return {"ok": True,
-                        "models": [{"name": e.name, "version": e.version,
-                                    "kind": e.kind}
-                                   for e in self.registry.entries()]}
+                return self._health()
             if cmd == "reload":
                 entry = self.registry.reload(
                     obj.get("model") or self._default_model())
@@ -140,25 +187,47 @@ class PredictionServer:
             # validate BEFORE submitting: one malformed entry must not
             # poison a shared micro-batch with other clients' requests
             return {"error": '"rows" must be a list of strings'}
-        futures, shed = [], 0
+        t0 = time.perf_counter()
+        # the client-side wait honors the request deadline when one is
+        # configured (the queue-side half lives in the batcher worker),
+        # bounded by the legacy serve.request.timeout.sec either way
+        wait_s = (min(self.deadline_s, self.timeout) if self.deadline_s
+                  else self.timeout)
+        futures, shed, degraded = [], 0, 0
+        last_err = "request failed"
         for row in rows:
             try:
                 futures.append(batcher.submit(row))
             except ShedError:
                 futures.append(None)
                 shed += 1
+            except CircuitOpenError as e:
+                # breaker open: fail fast and say so — the model is
+                # degraded, not the request
+                futures.append(None)
+                degraded += 1
+                last_err = str(e)
             except RuntimeError:
                 # the batcher was closed by a concurrent hot-swap reload;
                 # re-fetch the freshly attached one and retry once
                 batcher = self.batcher(name)
                 futures.append(batcher.submit(row))
-        outputs, errors = [], 0
+        outputs, errors, timeouts = [], 0, 0
         for f in futures:
             if f is None:
                 outputs.append(None)
                 continue
             try:
-                outputs.append(f.result(timeout=self.timeout))
+                remaining = max(wait_s - (time.perf_counter() - t0), 0.001)
+                outputs.append(f.result(timeout=remaining))
+            except (TimeoutError, _FutureTimeout) as e:
+                # queued past its deadline (worker-set TimeoutError) or
+                # still scoring when the client-side wait expired: a
+                # structured timeout response, never a silent wait
+                outputs.append(None)
+                errors += 1
+                timeouts += 1
+                last_err = str(e) or "request deadline exceeded"
             except Exception as e:                  # noqa: BLE001
                 outputs.append(None)
                 errors += 1
@@ -169,17 +238,44 @@ class PredictionServer:
                 return {"model": entry.name, "version": entry.version,
                         "error": "request shed: queue at "
                                  "serve.queue.max.depth", "shed": True}
-            if outputs[0] is None:
+            if degraded:
                 return {"model": entry.name, "version": entry.version,
-                        "error": last_err}
+                        "error": last_err, "degraded": True}
+            if outputs[0] is None:
+                resp["error"] = last_err
+                if timeouts:
+                    resp["timeout"] = True
+                return resp
             resp["output"] = outputs[0]
             return resp
         resp["outputs"] = outputs
         if shed:
             resp["shed"] = shed
+        if degraded:
+            resp["degraded"] = degraded
+        if timeouts:
+            resp["timeouts"] = timeouts
         if errors:
             resp["errors"] = errors
         return resp
+
+    def _health(self) -> dict:
+        """Health now reports DEGRADED models explicitly: a model whose
+        breaker is open/half-open, or whose batcher worker is down, is
+        still listed (requests fail fast with structured errors) but the
+        top-level ``ok`` drops to False so orchestrators can see it."""
+        models, degraded = [], []
+        for e in self.registry.entries():
+            b = self._batchers.get(e.name)
+            brk = b.breaker if b else None
+            state = brk.state if brk is not None else "closed"
+            worker_ok = b.worker_alive() if b else False
+            if state != "closed" or not worker_ok:
+                degraded.append(e.name)
+            models.append({"name": e.name, "version": e.version,
+                           "kind": e.kind, "breaker": state,
+                           "worker_alive": worker_ok})
+        return {"ok": not degraded, "degraded": degraded, "models": models}
 
     def _stats(self) -> dict:
         models = {}
@@ -197,6 +293,8 @@ class PredictionServer:
                                      if b and b.fill_ratio() is not None
                                      else None),
                 "queue_depth": b.depth() if b else 0,
+                "breaker": (b.breaker.state_dict()
+                            if b and b.breaker is not None else None),
             }
         return {"models": models, "obs": obs.get_tracer().stats()}
 
@@ -207,18 +305,54 @@ class PredictionServer:
         port = self.config.get_int("serve.port", 8650)
         app = self
 
+        limit = self.max_line_bytes
+
         class Handler(socketserver.StreamRequestHandler):
             def handle(self):
-                for raw in self.rfile:
-                    line = raw.decode("utf-8", errors="replace").strip()
-                    if not line:
-                        continue
-                    resp = app.handle_line(line)
+                # hardened line loop: the line length is BOUNDED (an
+                # attacker or buggy client streaming an endless line can
+                # no longer balloon memory), binary garbage decodes with
+                # replacement and yields a structured JSON error, and NO
+                # request failure tears down the connection thread —
+                # only socket errors do
+                while True:
+                    try:
+                        raw = self.rfile.readline(limit + 1)
+                    except OSError:
+                        return
+                    if not raw:
+                        return                       # client closed
+                    if len(raw) > limit and not raw.endswith(b"\n"):
+                        # genuinely oversized: readline stopped mid-line.
+                        # (limit+1 bytes ENDING in \n is a complete line
+                        # whose payload fits the limit — skimming there
+                        # would eat the NEXT request and desync the
+                        # connection's request/response pairing)
+                        self._skim_line()
+                        resp = {"error": f"request line exceeds "
+                                         f"serve.max.line.bytes ({limit})"}
+                    else:
+                        line = raw.decode("utf-8", errors="replace").strip()
+                        if not line:
+                            continue
+                        try:
+                            resp = app.handle_line(line)
+                        except Exception as e:       # noqa: BLE001
+                            resp = {"error": f"internal error: "
+                                             f"{type(e).__name__}: {e}"}
                     try:
                         self.wfile.write(
                             (json.dumps(resp) + "\n").encode())
                         self.wfile.flush()
-                    except (BrokenPipeError, ConnectionResetError):
+                    except OSError:
+                        return
+
+            def _skim_line(self):
+                """Discard the remainder of an oversized line so the
+                next readline starts at a real line boundary."""
+                while True:
+                    chunk = self.rfile.readline(limit + 1)
+                    if not chunk or chunk.endswith(b"\n"):
                         return
 
         class Server(socketserver.ThreadingTCPServer):
@@ -234,6 +368,7 @@ class PredictionServer:
         return self.port
 
     def stop(self) -> None:
+        self._stop_watchdog.set()
         if self._tcp is not None:
             self._tcp.shutdown()
             self._tcp.server_close()
@@ -262,7 +397,7 @@ def request(host: str, port: int, obj: dict, timeout: float = 30.0) -> dict:
 def serve_main(argv) -> int:
     """``python -m avenir_tpu serve -Dconf.path=serve.properties
     [--trace out.json]``."""
-    from ..cli import extract_trace_flag
+    from ..cli import configure_resilience, extract_trace_flag
 
     argv, trace_path = extract_trace_flag(list(argv))
     defines, positional = parse_cli_args(argv)
@@ -277,6 +412,7 @@ def serve_main(argv) -> int:
               file=sys.stderr)
         return 2
     obs.configure_from_config(config, force_enable=bool(trace_path))
+    configure_resilience(config)
     server = PredictionServer(config)
     port = server.start()
     names = ", ".join(

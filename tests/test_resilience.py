@@ -1,0 +1,530 @@
+"""Fault-injection matrix for the ingest resilience layer: retries with
+backoff, malformed-row quarantine under an error budget, checkpoint/resume
+byte-parity for the NB streamed trainer and a 3-job multiscan (at mesh=1
+and 8-way), and the prefetch worker-death regression (a dead worker must
+surface an exception, never deadlock the consumer)."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core import JobConfig
+from avenir_tpu.core import faultinject, pipeline, resilience
+from avenir_tpu.core.checkpoint import CheckpointMismatch, StreamCheckpointer
+from avenir_tpu.core.faultinject import (FaultInjector, InjectedFault,
+                                         InjectedReadError,
+                                         SimulatedWorkerDeath, parse_plan)
+from avenir_tpu.core.multiscan import run_multi
+from avenir_tpu.core.resilience import (ErrorBudgetExceeded, RetryPolicy,
+                                        RowQuarantine, with_retries)
+from avenir_tpu.cli import _job_resolver
+from avenir_tpu.datagen import gen_telecom_churn
+from avenir_tpu.models.bayesian import BayesianDistribution
+
+SCHEMA = {"fields": [
+    {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+    {"name": "plan", "ordinal": 1, "dataType": "categorical",
+     "feature": True, "cardinality": ["planA", "planB"]},
+    {"name": "minUsed", "ordinal": 2, "dataType": "int", "feature": True,
+     "min": 0, "max": 2200, "bucketWidth": 200},
+    {"name": "dataUsed", "ordinal": 3, "dataType": "int", "feature": True,
+     "min": 0, "max": 1000, "bucketWidth": 100},
+    {"name": "csCall", "ordinal": 4, "dataType": "int", "feature": True,
+     "min": 0, "max": 14, "bucketWidth": 2},
+    {"name": "csEmail", "ordinal": 5, "dataType": "int", "feature": True,
+     "min": 0, "max": 22, "bucketWidth": 4},
+    {"name": "network", "ordinal": 6, "dataType": "int", "feature": True,
+     "min": 0, "max": 12, "bucketWidth": 2},
+    {"name": "churned", "ordinal": 7, "dataType": "categorical",
+     "cardinality": ["N", "Y"]}]}
+
+
+@pytest.fixture(autouse=True)
+def _clear_injector():
+    """Every test leaves the process-global fault injector unset."""
+    yield
+    faultinject.set_injector(None)
+
+
+@pytest.fixture(scope="module")
+def data(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("resilience")
+    rows = gen_telecom_churn(4000, seed=5)
+    lines = [",".join(r) for r in rows]
+    (tmp / "in.csv").write_text("\n".join(lines) + "\n")
+    (tmp / "schema.json").write_text(json.dumps(SCHEMA))
+    dirty = []
+    for i, l in enumerate(lines):
+        dirty.append(l)
+        if i % 500 == 250:
+            dirty.append("garbage,row")                      # short row
+            dirty.append(l.rsplit(",", 2)[0] + ",noNum,Y")   # bad numeric
+    (tmp / "dirty.csv").write_text("\n".join(dirty) + "\n")
+    return {"dir": tmp, "in": str(tmp / "in.csv"),
+            "dirty": str(tmp / "dirty.csv"),
+            "schema": str(tmp / "schema.json"),
+            "n_dirty_rows": 2 * ((len(lines) + 249) // 500)}
+
+
+def _nb_config(data, **extra):
+    props = {"feature.schema.file.path": data["schema"],
+             "pipeline.chunk.rows": "256",
+             "pipeline.prefetch.depth": "2"}
+    props.update({k: str(v) for k, v in extra.items()})
+    return JobConfig(props)
+
+
+def _model(out_dir):
+    return (out_dir / "part-r-00000").read_text()
+
+
+# ---------------------------------------------------------------------------
+# fault plan parsing / firing
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_grammar():
+    plan = parse_plan("read@0-1, corrupt@3:truncate; slow@5x2:7,"
+                      "worker_death@*")
+    assert [e.point for e in plan] == ["read", "corrupt", "slow",
+                                      "worker_death"]
+    assert (plan[0].lo, plan[0].hi) == (0, 1)
+    assert plan[1].arg == "truncate"
+    assert (plan[2].count, plan[2].arg) == (2, "7")
+    assert plan[3].hi is None
+    with pytest.raises(ValueError):
+        parse_plan("nosuchpoint@1")
+    with pytest.raises(ValueError):
+        parse_plan("read")
+
+
+def test_fault_firing_is_deterministic_and_bounded():
+    fi = FaultInjector(parse_plan("read@1-2"))
+    fi.fire("read")                    # call 0: no match
+    with pytest.raises(InjectedReadError):
+        fi.fire("read")                # call 1
+    with pytest.raises(InjectedReadError):
+        fi.fire("read")                # call 2
+    fi.fire("read")                    # call 3: past the range
+    # explicit index + x2: fires twice at that index, then disarms
+    fi2 = FaultInjector(parse_plan("h2d@4x2"))
+    fi2.fire("h2d", 3)
+    for _ in range(2):
+        with pytest.raises(InjectedFault):
+            fi2.fire("h2d", 4)
+    fi2.fire("h2d", 4)
+
+
+def test_corrupt_mangle_is_seeded():
+    data = b"aaa,1,2\nbbb,3,4\n" * 64
+    a = FaultInjector(parse_plan("corrupt@2"), seed=7).mangle(
+        "corrupt", 2, data)
+    b = FaultInjector(parse_plan("corrupt@2"), seed=7).mangle(
+        "corrupt", 2, data)
+    c = FaultInjector(parse_plan("corrupt@2"), seed=8).mangle(
+        "corrupt", 2, data)
+    assert a == b != data
+    assert a != c
+    t = FaultInjector(parse_plan("corrupt@0:truncate")).mangle(
+        "corrupt", 0, data)
+    assert len(t) == len(data) // 2
+
+
+# ---------------------------------------------------------------------------
+# with_retries
+# ---------------------------------------------------------------------------
+
+def test_retry_recovers_from_transient_failures():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    pol = RetryPolicy(max_attempts=3, base_ms=0.1, jitter=0.0)
+    before = resilience.retry_counters().get("Retry", "attempts")
+    assert with_retries(flaky, policy=pol, op="test") == "ok"
+    assert len(calls) == 3
+    assert resilience.retry_counters().get("Retry", "attempts") == before + 2
+
+
+def test_retry_budget_exhausts_and_raises_original():
+    def always(): raise OSError("still down")
+    pol = RetryPolicy(max_attempts=3, base_ms=0.1)
+    with pytest.raises(OSError, match="still down"):
+        with_retries(always, policy=pol, op="test")
+
+
+def test_wrong_path_fails_fast_without_backoff():
+    """FileNotFoundError is an OSError but never transient for local
+    files: a mistyped input path must not sleep through the backoff
+    ladder before surfacing."""
+    calls = []
+
+    def missing():
+        calls.append(1)
+        raise FileNotFoundError("/no/such/input")
+
+    with pytest.raises(FileNotFoundError):
+        with_retries(missing,
+                     policy=RetryPolicy(max_attempts=5, base_ms=50))
+    assert len(calls) == 1
+
+
+def test_non_retryable_fails_immediately():
+    calls = []
+
+    def bad():
+        calls.append(1)
+        raise ValueError("semantic error")
+
+    with pytest.raises(ValueError):
+        with_retries(bad, policy=RetryPolicy(max_attempts=5, base_ms=0.1))
+    assert len(calls) == 1
+    # injected non-retryable faults are not OSErrors either
+    assert not RetryPolicy().is_retryable(InjectedFault("x"))
+
+
+def test_backoff_ladder_is_seeded_and_capped():
+    a = RetryPolicy(base_ms=10, max_ms=40, jitter=0.5, seed=3)
+    b = RetryPolicy(base_ms=10, max_ms=40, jitter=0.5, seed=3)
+    sa = [a.backoff_s(i) for i in range(1, 6)]
+    assert sa == [b.backoff_s(i) for i in range(1, 6)]
+    assert all(s <= 0.040 * 1.5 for s in sa)     # capped (+jitter)
+    assert sa[1] >= 0.020                        # doubling
+
+
+def test_transient_read_fault_is_retried_end_to_end(data, mesh8, tmp_path):
+    """A transient injected read error (two failing attempts, third
+    succeeds) is absorbed by the retry wrapper: the job completes with
+    normal output."""
+    resilience.set_policy(RetryPolicy(max_attempts=3, base_ms=0.5))
+    try:
+        BayesianDistribution(_nb_config(data)).run(
+            data["in"], str(tmp_path / "ref"), mesh=mesh8)
+        faultinject.set_injector(FaultInjector(parse_plan("read@0-1")))
+        BayesianDistribution(_nb_config(data)).run(
+            data["in"], str(tmp_path / "out"), mesh=mesh8)
+        assert _model(tmp_path / "out") == _model(tmp_path / "ref")
+        fi = faultinject.get_injector()
+        assert fi.fired_log == [("read", 0), ("read", 1)]
+    finally:
+        resilience.set_policy(RetryPolicy())
+
+
+def test_persistent_read_fault_exhausts_budget(data, mesh8, tmp_path):
+    resilience.set_policy(RetryPolicy(max_attempts=3, base_ms=0.5))
+    try:
+        faultinject.set_injector(FaultInjector(parse_plan("read@*")))
+        with pytest.raises(InjectedReadError):
+            BayesianDistribution(_nb_config(data)).run(
+                data["in"], str(tmp_path / "out"), mesh=mesh8)
+    finally:
+        resilience.set_policy(RetryPolicy())
+
+
+# ---------------------------------------------------------------------------
+# malformed-row quarantine
+# ---------------------------------------------------------------------------
+
+def test_quarantine_budget_math(tmp_path):
+    q = RowQuarantine(str(tmp_path / "q"), "2")
+    q.record(["bad1"], "r")
+    q.record(["bad2"], "r")
+    with pytest.raises(ErrorBudgetExceeded, match="inspect"):
+        q.record(["bad3"], "r")
+    qf = RowQuarantine(str(tmp_path / "qf"), "0.5")
+    qf.admit(10)
+    qf.record(["a", "b", "c"], "r")     # 3 of 13 seen: under 50%
+    qf.finish()
+    qe = RowQuarantine(str(tmp_path / "qe"), "0.1")
+    qe.admit(5)
+    qe.record(["a", "b"], "r")          # 2 of 7 > 10%, but below the
+    #                                     mid-stream denominator floor
+    with pytest.raises(ErrorBudgetExceeded):
+        qe.finish()                     # end-of-stream: unconditional
+    qm = RowQuarantine(str(tmp_path / "qm"), "0.001")
+    qm.admit(2000)
+    with pytest.raises(ErrorBudgetExceeded):
+        qm.record(["a", "b", "c"], "r")  # past the floor: fails mid-stream
+
+
+@pytest.mark.parametrize("mesh_name", ["mesh1", "mesh8"])
+def test_quarantine_parity_with_clean_input(data, tmp_path, request,
+                                            mesh_name):
+    """Malformed rows under budget quarantine away: the model trained on
+    the dirty file is byte-identical to one trained on the clean file,
+    and the quarantine sidecar holds exactly the bad rows."""
+    mesh = request.getfixturevalue(mesh_name)
+    BayesianDistribution(_nb_config(data)).run(
+        data["in"], str(tmp_path / "ref"), mesh=mesh)
+    c = BayesianDistribution(_nb_config(data, **{
+        "ingest.error.budget": "100"})).run(
+        data["dirty"], str(tmp_path / "out"), mesh=mesh)
+    assert _model(tmp_path / "out") == _model(tmp_path / "ref")
+    qpath = str(tmp_path / "out") + ".quarantine"
+    qrows = [l for l in open(qpath).read().splitlines()
+             if l and not l.startswith("#")]
+    assert len(qrows) == data["n_dirty_rows"]
+    assert c.get("Ingest", "Quarantined rows") == data["n_dirty_rows"]
+
+
+def test_quarantine_budget_exceeded_fails_fast(data, mesh8, tmp_path):
+    with pytest.raises(ErrorBudgetExceeded) as ei:
+        BayesianDistribution(_nb_config(data, **{
+            "ingest.error.budget": "3"})).run(
+            data["dirty"], str(tmp_path / "out"), mesh=mesh8)
+    assert ".quarantine" in str(ei.value)
+
+
+def test_corrupt_chunk_quarantines_and_completes(data, mesh8, tmp_path):
+    """A corrupted chunk (injected byte mangling) quarantines its
+    undecodable rows and the job still completes."""
+    faultinject.set_injector(FaultInjector(parse_plan("corrupt@2")))
+    c = BayesianDistribution(_nb_config(data, **{
+        "ingest.error.budget": "0.2"})).run(
+        data["in"], str(tmp_path / "out"), mesh=mesh8)
+    assert c.get("Ingest", "Quarantined rows") >= 1
+
+
+def test_corrupt_chunk_without_budget_falls_back_identically(
+        data, mesh8, tmp_path):
+    """Without an error budget a corrupted chunk aborts the streamed
+    path; the monolithic fallback re-reads the (clean) file, so output
+    still matches — the pre-existing fallback contract."""
+    BayesianDistribution(_nb_config(data)).run(
+        data["in"], str(tmp_path / "ref"), mesh=mesh8)
+    # corrupt only the STREAMED read (first read call is the chunked
+    # ingest; the fallback's own reads see clean bytes)
+    faultinject.set_injector(FaultInjector(parse_plan("corrupt@2")))
+    BayesianDistribution(_nb_config(data)).run(
+        data["in"], str(tmp_path / "out"), mesh=mesh8)
+    assert _model(tmp_path / "out") == _model(tmp_path / "ref")
+
+
+# ---------------------------------------------------------------------------
+# checkpoint/resume: NB streamed trainer
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mesh_name", ["mesh1", "mesh8"])
+def test_nb_kill_resume_byte_parity(data, tmp_path, request, mesh_name):
+    """Kill the streamed NB train with an injected H2D fault mid-file,
+    resume from the sidecar checkpoint, and the final model is
+    byte-identical to an uninterrupted run."""
+    mesh = request.getfixturevalue(mesh_name)
+    BayesianDistribution(_nb_config(data)).run(
+        data["in"], str(tmp_path / "ref"), mesh=mesh)
+    cfg = {"checkpoint.interval.chunks": "3"}
+    faultinject.set_injector(FaultInjector(parse_plan("h2d@9")))
+    with pytest.raises(InjectedFault):
+        BayesianDistribution(_nb_config(data, **cfg)).run(
+            data["in"], str(tmp_path / "out"), mesh=mesh)
+    faultinject.set_injector(None)
+    ckpt = str(tmp_path / "out") + ".ckpt"
+    assert os.path.exists(ckpt), "failed run must leave its checkpoint"
+    cfg["checkpoint.resume"] = "true"
+    BayesianDistribution(_nb_config(data, **cfg)).run(
+        data["in"], str(tmp_path / "out"), mesh=mesh)
+    assert _model(tmp_path / "out") == _model(tmp_path / "ref")
+    assert not os.path.exists(ckpt), "success must clear the checkpoint"
+
+
+def test_nb_resume_without_checkpoint_runs_fully(data, mesh8, tmp_path):
+    BayesianDistribution(_nb_config(data)).run(
+        data["in"], str(tmp_path / "ref"), mesh=mesh8)
+    cfg = _nb_config(data, **{"checkpoint.resume": "true"})
+    BayesianDistribution(cfg).run(data["in"], str(tmp_path / "out"),
+                                  mesh=mesh8)
+    assert _model(tmp_path / "out") == _model(tmp_path / "ref")
+
+
+def test_checkpoint_rejects_different_input(data, mesh8, tmp_path):
+    """A checkpoint written against one input must refuse to resume
+    against another (silent wrong-offset resume would corrupt output)."""
+    other = tmp_path / "other.csv"
+    other.write_text(open(data["in"]).read() + "x9999,planA,100,100,2,4,6,N\n")
+    cfg = {"checkpoint.interval.chunks": "3"}
+    faultinject.set_injector(FaultInjector(parse_plan("h2d@9")))
+    with pytest.raises(InjectedFault):
+        BayesianDistribution(_nb_config(data, **cfg)).run(
+            data["in"], str(tmp_path / "out"), mesh=mesh8)
+    faultinject.set_injector(None)
+    # point the resume at the other input but the same sidecar
+    cfg["checkpoint.resume"] = "true"
+    cfg["checkpoint.path"] = str(tmp_path / "out") + ".ckpt"
+    with pytest.raises(CheckpointMismatch):
+        BayesianDistribution(_nb_config(data, **cfg)).run(
+            str(other), str(tmp_path / "out2"), mesh=mesh8)
+
+
+def test_checkpoint_rejects_changed_chunking(data, mesh8, tmp_path):
+    cfg = {"checkpoint.interval.chunks": "3"}
+    faultinject.set_injector(FaultInjector(parse_plan("h2d@9")))
+    with pytest.raises(InjectedFault):
+        BayesianDistribution(_nb_config(data, **cfg)).run(
+            data["in"], str(tmp_path / "out"), mesh=mesh8)
+    faultinject.set_injector(None)
+    cfg["checkpoint.resume"] = "true"
+    with pytest.raises(CheckpointMismatch):
+        job = BayesianDistribution(JobConfig({
+            "feature.schema.file.path": data["schema"],
+            "pipeline.chunk.rows": "512",        # changed geometry
+            "pipeline.prefetch.depth": "2",
+            **{k: str(v) for k, v in cfg.items()}}))
+        job.run(data["in"], str(tmp_path / "out"), mesh=mesh8)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint/resume: multiscan (3-job shared scan)
+# ---------------------------------------------------------------------------
+
+def _manifest(data):
+    return {
+        "multi.jobs": "nb,mi,stats",
+        "multi.job.nb.class": "BayesianDistribution",
+        "multi.job.mi.class": "MutualInformation",
+        "multi.job.stats.class": "NumericalAttrStats",
+        "multi.job.stats.attr.list": "2,3",
+        "feature.schema.file.path": data["schema"],
+        "mi.schema.file.path": data["schema"],
+        "pipeline.chunk.rows": "256",
+        "pipeline.prefetch.depth": "2",
+    }
+
+
+def _multi_outputs(base):
+    return {jid: (base / jid / "part-r-00000").read_text()
+            for jid in ("nb", "mi", "stats")}
+
+
+@pytest.mark.parametrize("mesh_name", ["mesh1", "mesh8"])
+def test_multiscan_kill_resume_byte_parity(data, tmp_path, request,
+                                           mesh_name):
+    """Kill a 3-job fused scan mid-file with an injected prefetch-worker
+    death, resume, and every job's output is byte-identical to an
+    uninterrupted fused run."""
+    mesh = request.getfixturevalue(mesh_name)
+    run_multi(JobConfig(_manifest(data)), data["in"],
+              str(tmp_path / "ref"), _job_resolver, mesh=mesh)
+    ref = _multi_outputs(tmp_path / "ref")
+
+    props = _manifest(data)
+    props["checkpoint.interval.chunks"] = "3"
+    faultinject.set_injector(FaultInjector(parse_plan("worker_death@10")))
+    with pytest.raises(RuntimeError, match="died without signaling"):
+        run_multi(JobConfig(dict(props)), data["in"],
+                  str(tmp_path / "out"), _job_resolver, mesh=mesh)
+    faultinject.set_injector(None)
+    ckpt = tmp_path / "out" / "_multiscan.ckpt"
+    assert ckpt.exists()
+
+    props["checkpoint.resume"] = "true"
+    run_multi(JobConfig(dict(props)), data["in"], str(tmp_path / "out"),
+              _job_resolver, mesh=mesh)
+    assert _multi_outputs(tmp_path / "out") == ref
+    assert not ckpt.exists()
+
+
+# ---------------------------------------------------------------------------
+# prefetch worker-death regression (the satellite deadlock fix)
+# ---------------------------------------------------------------------------
+
+def _run_bounded(fn, timeout_s=30.0):
+    """Run fn on a thread with a hard bound: a regression back to the
+    consumer-deadlock behavior fails the test instead of hanging the
+    suite."""
+    result = {}
+
+    def target():
+        try:
+            fn()
+            result["ok"] = True
+        except BaseException as e:      # noqa: BLE001
+            result["exc"] = e
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    assert not t.is_alive(), "drive_prefetched deadlocked on worker death"
+    return result
+
+
+def test_drive_prefetched_surfaces_hard_worker_death():
+    def chunks():
+        yield 1
+        raise SimulatedWorkerDeath("injected")
+
+    def run():
+        pipeline.drive_prefetched(chunks(), lambda x: x, lambda x: None,
+                                  depth=2)
+
+    res = _run_bounded(run)
+    assert isinstance(res.get("exc"), RuntimeError)
+    assert "died without signaling" in str(res["exc"])
+
+
+def test_drive_prefetched_relays_ordinary_worker_errors():
+    def chunks():
+        yield 1
+        raise ValueError("worker boom")
+
+    consumed = []
+
+    def run():
+        pipeline.drive_prefetched(chunks(), lambda x: x, consumed.append,
+                                  depth=2)
+
+    res = _run_bounded(run)
+    assert isinstance(res.get("exc"), ValueError)
+    assert consumed == [1]
+
+
+def test_drive_prefetched_worker_death_mid_stream_with_full_queue():
+    """Death while the consumer is slow (queue full at the time the
+    worker dies) must still surface, not deadlock."""
+    def chunks():
+        for i in range(3):
+            yield i
+        raise SimulatedWorkerDeath("injected late")
+
+    def slow_consume(x):
+        import time
+        time.sleep(0.05)
+
+    def run():
+        pipeline.drive_prefetched(chunks(), lambda x: x, slow_consume,
+                                  depth=1)
+
+    res = _run_bounded(run)
+    assert isinstance(res.get("exc"), RuntimeError)
+
+
+# ---------------------------------------------------------------------------
+# checkpointer unit seams
+# ---------------------------------------------------------------------------
+
+def test_checkpointer_atomic_save_and_complete(tmp_path):
+    inp = tmp_path / "in.txt"
+    inp.write_text("a,b\n" * 100)
+    ck = StreamCheckpointer(str(tmp_path / "x.ckpt"), interval=2,
+                            kind="t", in_path=str(inp), params={"p": 1})
+    assert not ck.due(0) and ck.due(1) and not ck.due(2) and ck.due(3)
+    tok = ck.token(3, 40, {"state": np.arange(4)})
+    ck.save(tok, {"carry": np.ones(3)})
+    loaded = StreamCheckpointer(str(tmp_path / "x.ckpt"), interval=2,
+                                kind="t", in_path=str(inp),
+                                params={"p": 1}, resume=True).load()
+    assert loaded["offset"] == 40 and loaded["chunk_index"] == 3
+    np.testing.assert_array_equal(loaded["state"]["state"], np.arange(4))
+    ck.complete()
+    assert not os.path.exists(ck.path)
+    # kind mismatch
+    ck.save(tok, None)
+    with pytest.raises(CheckpointMismatch):
+        StreamCheckpointer(str(tmp_path / "x.ckpt"), interval=2,
+                           kind="other", in_path=str(inp),
+                           params={"p": 1}).load()

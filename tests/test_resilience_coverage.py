@@ -1,0 +1,136 @@
+"""Tier-2 resilience lint: every raw I/O call site (``open``,
+``subprocess.*``, ``os.fdopen``/``tempfile.mkstemp``) in the ingest-path
+modules must either run under ``core.resilience.with_retries`` (directly,
+or as a helper invoked through it) or appear on the explicit
+``NON_RETRYABLE`` exclusion registry with a written reason — so new I/O
+on the ingest path cannot silently skip the retry layer, and stale
+exclusions cannot linger after a call site is removed or wrapped."""
+
+import ast
+import os
+
+import avenir_tpu
+from avenir_tpu.core.resilience import NON_RETRYABLE
+
+PKG_DIR = os.path.dirname(avenir_tpu.__file__)
+
+#: the ingest-path modules the lint patrols (relative to the package)
+INGEST_MODULES = [
+    "core/io.py",
+    "core/config.py",
+    "core/pipeline.py",
+    "core/binning.py",
+    "core/multiscan.py",
+    "core/checkpoint.py",
+    "core/resilience.py",
+    "native/__init__.py",
+]
+
+#: call spellings that count as raw I/O
+RAW_NAME_CALLS = {"open"}
+RAW_ATTR_CALLS = {
+    ("subprocess", "run"), ("subprocess", "Popen"),
+    ("subprocess", "check_output"), ("subprocess", "check_call"),
+    ("os", "fdopen"), ("tempfile", "mkstemp"),
+}
+
+
+class _Scan(ast.NodeVisitor):
+    def __init__(self):
+        self.stack = []
+        self.raw_sites = {}          # qualname -> [lineno...]
+        self.wrapper_funcs = set()   # funcs whose body calls with_retries
+        self.retry_invoked = set()   # helper names passed to with_retries
+
+    def _qual(self):
+        return ".".join(self.stack) if self.stack else "<module>"
+
+    def visit_ClassDef(self, node):
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node):
+        fn = node.func
+        if isinstance(fn, ast.Name):
+            if fn.id == "open":
+                self.raw_sites.setdefault(self._qual(), []).append(
+                    node.lineno)
+            elif fn.id == "with_retries":
+                self.wrapper_funcs.add(self._qual())
+                if node.args and isinstance(node.args[0], ast.Name):
+                    self.retry_invoked.add(node.args[0].id)
+        elif isinstance(fn, ast.Attribute):
+            base = fn.value
+            if (isinstance(base, ast.Name)
+                    and (base.id, fn.attr) in RAW_ATTR_CALLS):
+                self.raw_sites.setdefault(self._qual(), []).append(
+                    node.lineno)
+            if fn.attr == "with_retries":
+                self.wrapper_funcs.add(self._qual())
+                if node.args and isinstance(node.args[0], ast.Name):
+                    self.retry_invoked.add(node.args[0].id)
+        self.generic_visit(node)
+
+
+def _scan_all():
+    sites = {}            # "module:qualname" -> [lineno...]
+    wrapped = set()       # "module:qualname" keys considered retry-covered
+    retry_invoked = set()
+    per_module = {}
+    for rel in INGEST_MODULES:
+        path = os.path.join(PKG_DIR, rel)
+        scan = _Scan()
+        scan.visit(ast.parse(open(path).read(), filename=path))
+        per_module[rel] = scan
+        retry_invoked |= scan.retry_invoked
+    for rel, scan in per_module.items():
+        for qual, lines in scan.raw_sites.items():
+            key = f"{rel}:{qual}"
+            sites[key] = lines
+            leaf = qual.rsplit(".", 1)[-1]
+            if qual in scan.wrapper_funcs or leaf in retry_invoked:
+                wrapped.add(key)
+    return sites, wrapped
+
+
+def test_ingest_raw_io_is_retried_or_excluded():
+    sites, wrapped = _scan_all()
+    bad = [f"{k} (lines {v})" for k, v in sorted(sites.items())
+           if k not in wrapped and k not in NON_RETRYABLE]
+    assert not bad, (
+        "raw I/O call sites on the ingest path that neither run under "
+        "with_retries nor sit on core.resilience.NON_RETRYABLE with a "
+        f"reason: {bad}")
+
+
+def test_exclusions_are_live_and_reasoned():
+    """A NON_RETRYABLE entry must (a) carry a non-empty reason and
+    (b) still name a real, UN-wrapped raw call site — an entry whose
+    call site was removed or wrapped is stale and must be dropped."""
+    sites, wrapped = _scan_all()
+    for key, reason in NON_RETRYABLE.items():
+        assert reason and reason.strip(), f"empty exclusion reason: {key}"
+        assert key in sites, (
+            f"stale NON_RETRYABLE entry {key!r}: no such raw I/O call "
+            f"site exists anymore — drop it")
+        assert key not in wrapped, (
+            f"stale NON_RETRYABLE entry {key!r}: the call site now runs "
+            f"under with_retries — drop the exclusion")
+
+
+def test_retry_wrappers_exist():
+    """The load-bearing ingest reads really are wrapped (guards the lint
+    itself against a refactor that silently stops invoking
+    with_retries anywhere)."""
+    sites, wrapped = _scan_all()
+    assert "native/__init__.py:_read_part" in wrapped
+    assert "native/__init__.py:_cc_run" in wrapped
+    assert "core/pipeline.py:_open_text" in wrapped

@@ -1,0 +1,335 @@
+"""Serving graceful-degradation tests: per-model circuit breaker (open /
+half-open probe / close), request deadlines (timeout responses instead of
+silent waits), the batcher worker watchdog restart, and the hardened
+JSON-lines connection loop (bounded line length, garbage-tolerant)."""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from avenir_tpu.core import JobConfig
+from avenir_tpu.core import faultinject
+from avenir_tpu.core.faultinject import FaultInjector, parse_plan
+from avenir_tpu.core.io import write_output
+from avenir_tpu.core.metrics import Counters
+from avenir_tpu.datagen import gen_telecom_churn
+from avenir_tpu.models.bayesian import BayesianDistribution
+from avenir_tpu.serve import (CircuitBreaker, CircuitOpenError, MicroBatcher,
+                              PredictionServer)
+from avenir_tpu.serve.breaker import CLOSED, HALF_OPEN, OPEN
+from avenir_tpu.serve.server import request
+
+CHURN_SCHEMA = {"fields": [
+    {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+    {"name": "plan", "ordinal": 1, "dataType": "categorical",
+     "feature": True, "cardinality": ["planA", "planB"]},
+    {"name": "minUsed", "ordinal": 2, "dataType": "int", "feature": True,
+     "min": 0, "max": 2200, "bucketWidth": 200},
+    {"name": "dataUsed", "ordinal": 3, "dataType": "int", "feature": True,
+     "min": 0, "max": 1000, "bucketWidth": 100},
+    {"name": "csCall", "ordinal": 4, "dataType": "int", "feature": True,
+     "min": 0, "max": 14, "bucketWidth": 2},
+    {"name": "csEmail", "ordinal": 5, "dataType": "int", "feature": True,
+     "min": 0, "max": 22, "bucketWidth": 4},
+    {"name": "network", "ordinal": 6, "dataType": "int", "feature": True},
+    {"name": "churned", "ordinal": 7, "dataType": "categorical",
+     "cardinality": ["N", "Y"]}]}
+
+
+@pytest.fixture(autouse=True)
+def _clear_injector():
+    yield
+    faultinject.set_injector(None)
+
+
+@pytest.fixture(scope="module")
+def nb_artifacts(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("serve_resilience")
+    schema_path = tmp / "schema.json"
+    schema_path.write_text(json.dumps(CHURN_SCHEMA))
+    rows = gen_telecom_churn(600, seed=11)
+    write_output(str(tmp / "train"), [",".join(r) for r in rows[:500]])
+    BayesianDistribution(JobConfig(
+        {"feature.schema.file.path": str(schema_path)})).run(
+        str(tmp / "train"), str(tmp / "model"))
+    return {"dir": tmp, "schema": str(schema_path),
+            "model": str(tmp / "model"),
+            "rows": [",".join(r) for r in rows[500:]]}
+
+
+def _server_config(art, **extra):
+    props = {
+        "serve.models": "churn",
+        "serve.model.churn.kind": "naiveBayes",
+        "serve.model.churn.feature.schema.file.path": art["schema"],
+        "serve.model.churn.bayesian.model.file.path": art["model"],
+        "serve.port": "0",
+        "serve.batch.max.delay.ms": "1",
+    }
+    props.update({k: str(v) for k, v in extra.items()})
+    return JobConfig(props)
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker state machine (fake clock: fully deterministic)
+# ---------------------------------------------------------------------------
+
+def test_breaker_opens_after_consecutive_failures():
+    now = [0.0]
+    b = CircuitBreaker("m", failure_threshold=3, reset_sec=5.0,
+                       probe_requests=2, clock=lambda: now[0])
+    assert b.state == CLOSED and b.allow()
+    for _ in range(2):
+        b.record_failure()
+    assert b.state == CLOSED            # 2 < threshold
+    b.record_success()                  # consecutive resets
+    for _ in range(3):
+        b.record_failure()
+    assert b.state == OPEN and b.trips == 1
+    assert not b.allow()                # open: fail fast
+    assert b.degraded()
+
+
+def test_breaker_half_open_probe_closes_or_reopens():
+    now = [0.0]
+    b = CircuitBreaker("m", failure_threshold=1, reset_sec=5.0,
+                       probe_requests=2, clock=lambda: now[0])
+    b.record_failure()
+    assert b.state == OPEN
+    now[0] = 4.9
+    assert not b.allow()
+    now[0] = 5.1
+    assert b.allow()                    # -> half-open, probe 1 admitted
+    assert b.state == HALF_OPEN
+    assert b.allow()                    # probe 2
+    assert not b.allow()                # probe window exhausted
+    b.record_failure()                  # probe failed -> reopen
+    assert b.state == OPEN and b.trips == 2
+    now[0] = 10.3
+    assert b.allow()
+    b.record_success()                  # probe succeeded -> close
+    assert b.state == CLOSED
+    assert b.allow()
+
+
+def test_breaker_from_config_disabled():
+    assert CircuitBreaker.from_config(
+        JobConfig({"serve.breaker.failures": "0"}), "m") is None
+    b = CircuitBreaker.from_config(
+        JobConfig({"serve.breaker.failures": "4",
+                   "serve.breaker.reset.sec": "0.5"}), "m")
+    assert b.failure_threshold == 4 and b.reset_sec == 0.5
+
+
+# ---------------------------------------------------------------------------
+# batcher integration: breaker + deadline + watchdog restart
+# ---------------------------------------------------------------------------
+
+def test_batcher_breaker_sheds_then_recovers():
+    fail = {"on": True}
+
+    def predict(lines):
+        if fail["on"]:
+            raise RuntimeError("scorer down")
+        return [l + ":ok" for l in lines]
+
+    b = MicroBatcher("m", predict, Counters(), max_delay_ms=0.5,
+                     breaker=CircuitBreaker("m", failure_threshold=2,
+                                            reset_sec=0.15))
+    try:
+        for _ in range(2):
+            with pytest.raises(RuntimeError, match="scorer down"):
+                b.submit("r").result(timeout=5)
+        with pytest.raises(CircuitOpenError):
+            b.submit("r")
+        assert b.counters.get("Serve", "Breaker rejected") == 1
+        fail["on"] = False
+        time.sleep(0.2)                 # past reset: next admit = probe
+        assert b.submit("probe").result(timeout=5) == "probe:ok"
+        assert b.breaker.state == CLOSED
+        assert b.submit("r2").result(timeout=5) == "r2:ok"
+    finally:
+        b.close(drain=False)
+
+
+def test_batcher_deadline_expires_queued_requests():
+    release = threading.Event()
+
+    def predict(lines):
+        # the first batch parks the worker so later submissions age in
+        # the queue past their deadline
+        if lines == ["slow"]:
+            release.wait(5)
+        return [l + ":ok" for l in lines]
+
+    b = MicroBatcher("m", predict, Counters(), max_batch=1,
+                     max_delay_ms=0.0, deadline_ms=50.0)
+    try:
+        slow = b.submit("slow")
+        time.sleep(0.01)                # let the worker drain batch 1
+        late = b.submit("late")
+        time.sleep(0.1)                 # "late" ages past its deadline
+        release.set()
+        assert slow.result(timeout=5) == "slow:ok"
+        with pytest.raises(TimeoutError, match="deadline"):
+            late.result(timeout=5)
+        assert b.counters.get("Serve", "Deadline expired") == 1
+    finally:
+        release.set()
+        b.close(drain=False)
+
+
+def test_batcher_watchdog_restarts_dead_worker():
+    """An injected worker death (BaseException out of the dispatch loop)
+    is healed by ensure_worker: queued work drains on the replacement
+    thread and the restart is counted."""
+    faultinject.set_injector(FaultInjector(parse_plan("batcher_death@0")))
+    b = MicroBatcher("m", lambda ls: [l + ":ok" for l in ls], Counters(),
+                     max_delay_ms=0.5)
+    try:
+        deadline = time.time() + 10
+        while b.worker_alive() and time.time() < deadline:
+            time.sleep(0.005)
+        assert not b.worker_alive(), "injected death did not fire"
+        # submit() performs the defensive restart; the request must
+        # complete on the replacement worker
+        assert b.submit("r").result(timeout=10) == "r:ok"
+        assert b.counters.get("Serve", "Worker restarts") == 1
+        assert b.worker_alive()
+    finally:
+        b.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# server end-to-end: scorer faults degrade + recover; hardened frontend
+# ---------------------------------------------------------------------------
+
+def test_server_breaker_degrades_and_recovers(nb_artifacts):
+    server = PredictionServer(_server_config(
+        nb_artifacts, **{"serve.breaker.failures": "2",
+                         "serve.breaker.reset.sec": "0.2",
+                         "serve.request.deadline.ms": "5000"}))
+    port = server.start()
+    row = nb_artifacts["rows"][0]
+    try:
+        # two injected scorer-batch failures trip the breaker
+        faultinject.set_injector(FaultInjector(parse_plan("scorer@0-1")))
+        for _ in range(2):
+            r = request("127.0.0.1", port, {"row": row})
+            assert "error" in r and "injected scorer failure" in r["error"]
+        # breaker open: fast structured degradation, health says so
+        r = request("127.0.0.1", port, {"row": row})
+        assert r.get("degraded") is True and "breaker" in r["error"]
+        h = request("127.0.0.1", port, {"cmd": "health"})
+        assert h["ok"] is False and h["degraded"] == ["churn"]
+        assert h["models"][0]["breaker"] == "open"
+        # after the reset window the half-open probe succeeds (the fault
+        # plan is exhausted) and the breaker closes
+        time.sleep(0.25)
+        r = request("127.0.0.1", port, {"row": row})
+        assert "output" in r, r
+        h = request("127.0.0.1", port, {"cmd": "health"})
+        assert h["ok"] is True and h["models"][0]["breaker"] == "closed"
+        s = request("127.0.0.1", port, {"cmd": "stats"})
+        assert s["models"]["churn"]["breaker"]["trips"] == 1
+    finally:
+        server.stop()
+
+
+def test_batcher_close_with_dead_worker_fails_pending_fast():
+    """close(drain=True) on a batcher whose worker already died must
+    fail the queued futures immediately (a dead worker cannot drain,
+    and ensure_worker refuses to restart once closed) — not leave them
+    to hang until every client times out."""
+    from avenir_tpu.serve.batcher import _Request
+
+    faultinject.set_injector(FaultInjector(parse_plan("batcher_death@0")))
+    b = MicroBatcher("m", lambda ls: ls, Counters(), max_delay_ms=0.5)
+    deadline = time.time() + 10
+    while b.worker_alive() and time.time() < deadline:
+        time.sleep(0.005)
+    assert not b.worker_alive()
+    faultinject.set_injector(None)
+    # park a request without submit() (whose defensive restart would
+    # heal the worker): the close() contract alone must resolve it
+    req = _Request("r")
+    with b._cv:
+        b._q.append(req)
+    b.close(drain=True)
+    with pytest.raises(RuntimeError, match="shutting down"):
+        req.future.result(timeout=1)
+
+
+def test_server_survives_garbage_client(nb_artifacts):
+    server = PredictionServer(_server_config(
+        nb_artifacts, **{"serve.max.line.bytes": "4096"}))
+    port = server.start()
+    try:
+        with socket.create_connection(("127.0.0.1", port), timeout=10) as s:
+            f = s.makefile("rwb")
+            # binary garbage -> structured JSON error, connection lives
+            f.write(b"\x00\xff\xfe garbage \x80\n")
+            f.flush()
+            resp = json.loads(f.readline())
+            assert "error" in resp
+            # oversized line -> bounded read + structured error
+            f.write(b"a" * 20000 + b"\n")
+            f.flush()
+            resp = json.loads(f.readline())
+            assert "serve.max.line.bytes" in resp["error"]
+            # a COMPLETE line whose payload is exactly the limit is NOT
+            # oversized: exactly one (JSON-error) response, and the next
+            # request must not be skimmed away with it
+            f.write(b"b" * 4096 + b"\n" + b'{"cmd": "health"}\n')
+            f.flush()
+            resp = json.loads(f.readline())
+            assert ("error" in resp
+                    and "serve.max.line.bytes" not in resp["error"])
+            assert json.loads(f.readline())["ok"] is True
+            # non-object JSON
+            f.write(b"[1,2,3]\n")
+            f.flush()
+            assert "error" in json.loads(f.readline())
+            # the SAME connection still serves a real command
+            f.write(b'{"cmd": "health"}\n')
+            f.flush()
+            assert json.loads(f.readline())["ok"] is True
+        # a partial line with no newline then close must not wedge the
+        # server: a fresh connection still works
+        with socket.create_connection(("127.0.0.1", port), timeout=10) as s:
+            s.sendall(b'{"cmd": "hea')
+        assert request("127.0.0.1", port, {"cmd": "health"})["ok"] is True
+    finally:
+        server.stop()
+
+
+def test_server_health_reports_dead_worker(nb_artifacts):
+    """A dead batcher worker degrades health until the watchdog restarts
+    it (watchdog disabled here to observe the degraded state
+    deterministically, then invoked by hand)."""
+    server = PredictionServer(_server_config(
+        nb_artifacts, **{"serve.watchdog.interval.sec": "0"}))
+    port = server.start()
+    try:
+        faultinject.set_injector(
+            FaultInjector(parse_plan("batcher_death@*")))
+        b = server.batcher("churn")
+        # the worker is parked waiting for work: wake it with a request
+        # (answered normally), after which the loop-top fault kills it
+        r = request("127.0.0.1", port, {"row": nb_artifacts["rows"][0]})
+        assert "output" in r or "error" in r
+        deadline = time.time() + 10
+        while b.worker_alive() and time.time() < deadline:
+            time.sleep(0.005)
+        assert not b.worker_alive()
+        faultinject.set_injector(None)
+        h = request("127.0.0.1", port, {"cmd": "health"})
+        assert h["ok"] is False and h["models"][0]["worker_alive"] is False
+        assert b.ensure_worker()        # what the watchdog thread does
+        h = request("127.0.0.1", port, {"cmd": "health"})
+        assert h["ok"] is True and h["models"][0]["worker_alive"] is True
+    finally:
+        server.stop()
