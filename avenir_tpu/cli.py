@@ -95,27 +95,42 @@ def register(fqcn: str, module: str, cls: str, prefix: str = "") -> None:
     JOBS[fqcn] = (module, cls, prefix)
 
 
-def extract_trace_flag(argv):
-    """Pull ``--trace <out.json>`` / ``--trace=<out.json>`` out of an arg
-    vector; returns (remaining argv, trace path or None)."""
-    out, trace_path, i = [], None, 0
+def _extract_value_flag(argv, flag: str):
+    """Pull ``<flag> <value>`` / ``<flag>=<value>`` out of an arg vector;
+    returns (remaining argv, value or None)."""
+    out, value, i = [], None, 0
     while i < len(argv):
         a = argv[i]
-        if a == "--trace":
+        if a == flag:
             if i + 1 >= len(argv):
-                raise SystemExit("--trace requires an output path")
-            trace_path = argv[i + 1]
+                raise SystemExit(f"{flag} requires an output path")
+            value = argv[i + 1]
             i += 2
             continue
-        if a.startswith("--trace="):
-            trace_path = a.partition("=")[2]
-            if not trace_path:
-                raise SystemExit("--trace requires an output path")
+        if a.startswith(flag + "="):
+            value = a.partition("=")[2]
+            if not value:
+                raise SystemExit(f"{flag} requires an output path")
             i += 1
             continue
         out.append(a)
         i += 1
-    return out, trace_path
+    return out, value
+
+
+def extract_trace_flag(argv):
+    """Pull ``--trace <out.json>`` / ``--trace=<out.json>`` out of an arg
+    vector; returns (remaining argv, trace path or None)."""
+    return _extract_value_flag(argv, "--trace")
+
+
+def extract_metrics_out_flag(argv):
+    """Pull ``--metrics-out <path>`` / ``--metrics-out=<path>`` out of an
+    arg vector; returns (remaining argv, path or None).  The flag starts
+    the periodic telemetry exporter (core.telemetry): one mergeable
+    JSONL snapshot of the global metrics registry per
+    ``telemetry.interval.sec``, plus a final one at job exit."""
+    return _extract_value_flag(argv, "--metrics-out")
 
 
 def extract_resume_flag(argv):
@@ -176,6 +191,7 @@ def multi_main(argv) -> int:
     standalone after the fused pass, so the workflow's outputs are
     always complete."""
     argv, trace_path = extract_trace_flag(argv)
+    argv, metrics_out = extract_metrics_out_flag(argv)
     argv, resume = extract_resume_flag(argv)
     defines, positional = parse_cli_args(argv)
     if not positional:
@@ -188,14 +204,21 @@ def multi_main(argv) -> int:
     config = load_job_config(defines, "")
     if resume:
         config.set("checkpoint.resume", "true")
-    from .core import obs
+    from .core import obs, telemetry
     from .core.multiscan import run_multi
     obs.configure_from_config(config, force_enable=bool(trace_path))
     configure_resilience(config)
+    telemetry.configure_from_config(config)
+    exporter = telemetry.exporter_for_job(config, metrics_out)
+    flusher = telemetry.flusher_for_job(config, trace_path)
     try:
         results = run_multi(config, in_path, out_base, _job_resolver,
                             log=lambda m: print(m, file=sys.stderr))
     finally:
+        if flusher is not None:
+            flusher.stop()
+        if exporter is not None:
+            exporter.stop()
         _export_trace(trace_path)
     for jid, counters in results.items():
         print(f"--- job {jid}", file=sys.stderr)
@@ -230,6 +253,9 @@ def main(argv=None) -> int:
     # --trace <out.json>: record core.obs spans for the whole job and
     # export them as Chrome/Perfetto trace_event JSON on exit
     rest, trace_path = extract_trace_flag(rest)
+    # --metrics-out <series.jsonl>: periodic mergeable metrics snapshots
+    # (core.telemetry) appended for the whole job, final one at exit
+    rest, metrics_out = extract_metrics_out_flag(rest)
     # --resume: restart from the job's sidecar checkpoint (core.checkpoint)
     rest, resume = extract_resume_flag(rest)
     # --profile-dir=<dir>: capture a jax.profiler trace of the whole job
@@ -256,11 +282,17 @@ def main(argv=None) -> int:
     config = load_job_config(defines, prefix)
     if resume:
         config.set("checkpoint.resume", "true")
-    from .core import obs
+    from .core import obs, telemetry
     obs.configure_from_config(config, force_enable=bool(trace_path))
     configure_resilience(config)
-    job = _lazy(modname, clsname)(config)
+    telemetry.configure_from_config(config)
+    exporter = telemetry.exporter_for_job(config, metrics_out)
+    flusher = telemetry.flusher_for_job(config, trace_path)
     try:
+        # job construction INSIDE the try: a driver __init__ failure
+        # (e.g. a missing must() key) must still stop the just-started
+        # telemetry threads and export what was recorded
+        job = _lazy(modname, clsname)(config)
         if profile_dir:
             import jax
             with jax.profiler.trace(profile_dir):
@@ -269,7 +301,12 @@ def main(argv=None) -> int:
             result = job.run(positional[0], positional[1])
     finally:
         # export even when the job raises or is interrupted — a trace of
-        # the failing/slow run is the one the user most needs
+        # the failing/slow run is the one the user most needs; the
+        # telemetry stop takes a final snapshot tick for the same reason
+        if flusher is not None:
+            flusher.stop()
+        if exporter is not None:
+            exporter.stop()
         _export_trace(trace_path)
     if isinstance(result, Counters):
         print(result.format(), file=sys.stderr)

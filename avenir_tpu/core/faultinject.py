@@ -49,6 +49,10 @@ Instrumented points (grep ``fire(`` / ``mangle(`` call sites):
                       the relay deliberately does NOT catch)
 ``scorer``            serving scorer batches — raises
                       ``InjectedScorerFault``
+``scorer_slow``       serving scorer batches — sleeps ``arg`` ms
+                      (default 20): the deterministic slow scorer that
+                      drives a windowed p99 past ``serve.slo.p99.ms``
+                      (the SLO-violation test in tests/test_slo.py)
 ``batcher_death``     serving batcher worker loop iterations — raises
                       ``SimulatedWorkerDeath``
 ====================  =====================================================
@@ -72,7 +76,7 @@ KEY_SEED = "fault.inject.seed"
 
 #: the known instrumented points (parse-time typo guard)
 POINTS = ("read", "corrupt", "slow", "h2d", "worker_death", "scorer",
-          "batcher_death")
+          "scorer_slow", "batcher_death")
 
 
 class InjectedReadError(OSError):
@@ -205,7 +209,7 @@ class FaultInjector:
         where = f"{point}@{index if index is not None else 'auto'}"
         if point == "read":
             raise InjectedReadError(f"injected transient read error ({where})")
-        if point == "slow":
+        if point in ("slow", "scorer_slow"):
             time.sleep(float(e.arg or 20) / 1000.0)
             return
         if point == "h2d":

@@ -53,7 +53,7 @@ from .binning import ChunkedEncodeUnsupported
 from .config import JobConfig, parse_properties
 from .metrics import Counters
 from .obs import get_tracer
-from . import pipeline
+from . import pipeline, telemetry
 
 
 class FoldSpec:
@@ -356,6 +356,10 @@ class MultiScanEngine:
                     # encode sized static_args from chunk 0
                     cf = folds[spec] = make_fold(spec)
                 cf.fold(dev)
+            # one residency sample per fanned-out chunk (rate-limited):
+            # a fused scan's live set is N jobs' carries + the shared
+            # chunk, exactly what the device.hbm.bytes gauge should see
+            telemetry.sample_device_memory()
 
         import jax
 

@@ -254,6 +254,35 @@ class Tracer:
         return {"count": n, "total_ms": total_ns / 1e6,
                 "mean_ms": (total_ns / n / 1e6) if n else 0.0}
 
+    def span_summaries(self) -> Dict[str, Dict[str, float]]:
+        """``span_summary`` for every span name currently buffered — the
+        shape the periodic telemetry exporter ships (count + total/mean
+        ms per name, both mergeable across snapshots by count-weighted
+        sum)."""
+        agg: Dict[str, list] = {}
+        for r in self.records():
+            if isinstance(r, Span):
+                e = agg.setdefault(r.name, [0, 0])
+                e[0] += 1
+                e[1] += r.dur_ns
+        return {k: {"count": c, "total_ms": t / 1e6,
+                    "mean_ms": (t / c / 1e6) if c else 0.0}
+                for k, (c, t) in sorted(agg.items())}
+
+    def records_since(self, since_total: int):
+        """``(new records, new total, dropped)`` — every record appended
+        after the ``since_total``-th, for incremental (tail-follow)
+        exporters.  ``dropped`` counts records that arrived but already
+        rotated out of the ring buffer between calls (the flusher's
+        interval bounds it)."""
+        with self._lock:
+            new = self._total - since_total
+            if new <= 0:
+                return [], self._total, 0
+            buf = list(self._buf)
+            have = min(new, len(buf))
+            return buf[len(buf) - have:], self._total, new - have
+
     def clear(self) -> None:
         with self._lock:
             self._buf.clear()
@@ -268,22 +297,23 @@ class Tracer:
                     "buffer_spans": self._buf.maxlen}
 
     # -- exporters ---------------------------------------------------------
+    def record_dict(self, r) -> dict:
+        """One record as the JSONL-exporter dict (shared by the one-shot
+        exporter and the periodic incremental trace flusher)."""
+        if isinstance(r, Span):
+            return {"type": "span", "name": r.name, "id": r.span_id,
+                    "parent": r.parent_id, "thread": r.thread,
+                    "t0_ns": r.t0_ns - self._epoch_ns,
+                    "dur_ns": r.dur_ns, "attrs": r.attrs}
+        return {"type": "gauge", "name": r.name,
+                "t_ns": r.t_ns - self._epoch_ns, "value": r.value}
+
     def export_jsonl(self, path: str) -> int:
         """One JSON object per buffered record; returns the line count."""
         recs = self.records()
         with open(path, "w") as fh:
             for r in recs:
-                if isinstance(r, Span):
-                    fh.write(json.dumps(
-                        {"type": "span", "name": r.name, "id": r.span_id,
-                         "parent": r.parent_id, "thread": r.thread,
-                         "t0_ns": r.t0_ns - self._epoch_ns,
-                         "dur_ns": r.dur_ns, "attrs": r.attrs}) + "\n")
-                else:
-                    fh.write(json.dumps(
-                        {"type": "gauge", "name": r.name,
-                         "t_ns": r.t_ns - self._epoch_ns,
-                         "value": r.value}) + "\n")
+                fh.write(json.dumps(self.record_dict(r)) + "\n")
         return len(recs)
 
     def export_chrome_trace(self, path: str) -> int:
@@ -330,6 +360,49 @@ class Tracer:
 def _log_bounds(n_buckets: int, lo: float, hi: float) -> List[float]:
     ratio = (hi / lo) ** (1.0 / n_buckets)
     return [lo * ratio ** i for i in range(n_buckets + 1)]
+
+
+def quantile_from_counts(bounds: Sequence[float], counts: Sequence[int],
+                         q: float, vmin: Optional[float] = None,
+                         vmax: Optional[float] = None) -> Optional[float]:
+    """Quantile estimate (seconds) from raw bucket counts against a
+    bound ladder — the module-level form of
+    :meth:`LatencyHistogram.quantile`, usable on DIFFED counts (the SLO
+    monitor's rolling windows subtract two cumulative snapshots, so the
+    window's distribution exists only as a counts list, never as a live
+    histogram instance)."""
+    n = sum(counts)
+    if n == 0:
+        return None
+    if vmin is None or vmax is None:
+        # the observed extrema are unknown (diffed counts): bound them by
+        # the occupied buckets' edges, so a tiny window's quantile lands
+        # in its own bucket instead of collapsing to bounds[0] (a 1-
+        # request window must still be able to violate a latency SLO)
+        occupied = [i for i, c in enumerate(counts) if c]
+        lo_i, hi_i = occupied[0], occupied[-1]
+        if vmin is None:
+            vmin = bounds[lo_i - 1] if lo_i >= 1 else bounds[0]
+        if vmax is None:
+            vmax = bounds[hi_i] if hi_i < len(bounds) else bounds[-1]
+    target = max(q, 0.0) * n
+    if target <= 1.0:
+        return vmin
+    cum = 0
+    for i, c in enumerate(counts):
+        if c == 0:
+            continue
+        if cum + c >= target:
+            lo_e = bounds[i - 1] if i >= 1 else vmin
+            hi_e = bounds[i] if i < len(bounds) else vmax
+            lo_e = max(lo_e, vmin)
+            hi_e = min(hi_e, vmax)
+            if hi_e <= lo_e or lo_e <= 0:
+                return min(max(hi_e, vmin), vmax)
+            frac = (target - cum) / c
+            return lo_e * (hi_e / lo_e) ** frac
+        cum += c
+    return vmax
 
 
 class LatencyHistogram:
@@ -413,24 +486,7 @@ class LatencyHistogram:
     def _quantile_from(self, counts, n, vmin, vmax, q: float):
         if n == 0:
             return None
-        target = max(q, 0.0) * n
-        if target <= 1.0:
-            return vmin
-        cum = 0
-        for i, c in enumerate(counts):
-            if c == 0:
-                continue
-            if cum + c >= target:
-                lo_e = self.bounds[i - 1] if i >= 1 else vmin
-                hi_e = self.bounds[i] if i < len(self.bounds) else vmax
-                lo_e = max(lo_e, vmin)
-                hi_e = min(hi_e, vmax)
-                if hi_e <= lo_e or lo_e <= 0:
-                    return min(max(hi_e, vmin), vmax)
-                frac = (target - cum) / c
-                return lo_e * (hi_e / lo_e) ** frac
-            cum += c
-        return vmax
+        return quantile_from_counts(self.bounds, counts, q, vmin, vmax)
 
     # -- surfaces ----------------------------------------------------------
     def percentiles_ms(self) -> dict:
@@ -464,6 +520,36 @@ class LatencyHistogram:
                 "p50_ms": pct(0.50), "p90_ms": pct(0.90),
                 "p95_ms": pct(0.95), "p99_ms": pct(0.99)}
 
+    def state_dict(self) -> dict:
+        """Mergeable raw state: sparse bucket counts + the shape params
+        that prove two states share one bound ladder.  This is the form
+        the telemetry exporter ships (counts ADD across processes —
+        multi-host aggregation is a fold over these dicts; see
+        ``core.telemetry.merge_snapshots``)."""
+        counts, n, total, vmin, vmax = self._state()
+        return {"n_buckets": len(self.bounds) - 1,
+                "lo": self.bounds[0], "hi": self.bounds[-1],
+                "counts": {str(i): c for i, c in enumerate(counts) if c},
+                "n": n, "total": total,
+                "vmin": (vmin if n else None),
+                "vmax": (vmax if n else None)}
+
+    @classmethod
+    def from_state(cls, state: dict) -> "LatencyHistogram":
+        """Rebuild a live histogram from a :meth:`state_dict` (exact
+        inverse — used by snapshot consumers that want quantiles out of
+        a merged multi-process state)."""
+        h = cls(int(state["n_buckets"]), float(state["lo"]),
+                float(state["hi"]))
+        for i, c in state.get("counts", {}).items():
+            h.counts[int(i)] = int(c)
+        h.n = int(state.get("n", 0))
+        h.total = float(state.get("total", 0.0))
+        if h.n:
+            h.vmin = float(state["vmin"])
+            h.vmax = float(state["vmax"])
+        return h
+
 
 # ---------------------------------------------------------------------------
 # metrics registry
@@ -482,7 +568,7 @@ class Metrics:
         self.counters = counters if counters is not None else Counters()
         self.hist_buckets = int(hist_buckets)
         self._hists: Dict[str, LatencyHistogram] = {}
-        self._gauges: Dict[str, float] = {}
+        self._gauges: Dict[str, tuple] = {}      # name -> (value, epoch ts)
         self._lock = threading.Lock()
 
     def histogram(self, name: str) -> LatencyHistogram:
@@ -493,18 +579,56 @@ class Metrics:
                 h = self._hists[name] = LatencyHistogram(self.hist_buckets)
             return h
 
-    def set_gauge(self, name: str, value) -> None:
+    def set_gauge(self, name: str, value, ts: Optional[float] = None) -> None:
+        """Record one gauge value, stamped with its epoch time — merging
+        two snapshots keeps the LATEST sample of each gauge, so every
+        set carries when it happened (``ts`` overrides for replayed or
+        cross-process samples)."""
         with self._lock:
-            self._gauges[name] = float(value)
+            self._gauges[name] = (float(value),
+                                  float(ts) if ts is not None else time.time())
+
+    def get_gauge(self, name: str, default=None):
+        with self._lock:
+            g = self._gauges.get(name)
+        return g[0] if g is not None else default
+
+    def clear(self) -> None:
+        """Drop every histogram and gauge and reset the counters (test
+        isolation for the process-global registry)."""
+        with self._lock:
+            self._hists.clear()
+            self._gauges.clear()
+            self.counters = Counters()
 
     def snapshot(self) -> dict:
+        """Human-readable snapshot: quantile summaries per histogram,
+        gauge values WITH their sample timestamps, and the snapshot's
+        own epoch + monotonic stamps (so exported series can be
+        plotted/joined — a snapshot knows *when*)."""
         with self._lock:
             hists = dict(self._hists)
             gauges = dict(self._gauges)
-        return {"counters": self.counters.as_dict(),
+        return {"ts": time.time(), "mono": time.monotonic(),
+                "counters": self.counters.as_dict(),
                 "histograms": {k: h.snapshot() for k, h in
                                sorted(hists.items())},
-                "gauges": gauges}
+                "gauges": {k: {"value": v, "ts": t}
+                           for k, (v, t) in sorted(gauges.items())}}
+
+    def mergeable_snapshot(self) -> dict:
+        """The cross-process form: raw histogram bucket states instead
+        of quantile summaries, so N processes' snapshots FOLD into one
+        (counters sum, buckets add, gauges latest-timestamp-wins) — see
+        ``core.telemetry.merge_snapshots``."""
+        with self._lock:
+            hists = dict(self._hists)
+            gauges = dict(self._gauges)
+        return {"ts": time.time(), "mono": time.monotonic(),
+                "counters": self.counters.as_dict(),
+                "hists": {k: h.state_dict() for k, h in sorted(hists.items())},
+                "gauges": {k: {"value": v, "ts": t}
+                           for k, (v, t) in sorted(gauges.items())}}
 
 
 # ---------------------------------------------------------------------------

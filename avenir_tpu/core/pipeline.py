@@ -299,6 +299,7 @@ def clear_fold_cache() -> None:
 def _fold_fns(local_fn: Callable, mesh, static_args: tuple,
               ndims: Tuple[int, ...], n_bcast: int):
     import jax
+    from . import telemetry
     from ..parallel.mesh import shard_map
     from ..utils.caches import bounded_cache_get, bounded_cache_put
     from jax.sharding import PartitionSpec as P
@@ -318,8 +319,13 @@ def _fold_fns(local_fn: Callable, mesh, static_args: tuple,
         return jax.tree_util.tree_map(
             lambda t: jax.lax.psum(t, axes), out)
 
-    first_fn = jax.jit(shard_map(first, mesh=mesh, in_specs=chunk_specs,
-                                 out_specs=P()))
+    # profiled_jit: any invocation that compiles (first chunk, or a new
+    # bucketed shape) bills its wall time to the cumulative
+    # ``Telemetry / xla.compile.ms`` counter + an ``xla.compile`` span
+    label = getattr(local_fn, "__name__", "fold")
+    first_fn = telemetry.profiled_jit(
+        shard_map(first, mesh=mesh, in_specs=chunk_specs, out_specs=P()),
+        f"pipeline.fold.first:{label}")
 
     def acc(carry, *args):
         shards, m = args[:len(ndims)], args[len(ndims)]
@@ -330,10 +336,10 @@ def _fold_fns(local_fn: Callable, mesh, static_args: tuple,
 
     # donate_argnums=0: the carry buffer is reused in place — the
     # accumulator costs zero copies however many chunks stream through
-    acc_fn = jax.jit(shard_map(acc, mesh=mesh,
-                               in_specs=(P(),) + chunk_specs,
-                               out_specs=P()),
-                     donate_argnums=0)
+    acc_fn = telemetry.profiled_jit(
+        shard_map(acc, mesh=mesh, in_specs=(P(),) + chunk_specs,
+                  out_specs=P()),
+        f"pipeline.fold.acc:{label}", donate_argnums=0)
     fns = (first_fn, acc_fn)
     bounded_cache_put(_fold_cache, key, fns, cap=_FOLD_CACHE_CAP)
     return fns
@@ -688,6 +694,10 @@ class ChunkFold:
                 self.carry = self._fns[0](*dev, *self.bcast_dev)
             else:
                 self.carry = self._fns[1](self.carry, *dev, *self.bcast_dev)
+        # rate-limited device residency sample per folded chunk (the
+        # ``device.hbm.bytes`` gauge; core.telemetry gates the frequency)
+        from . import telemetry
+        telemetry.sample_device_memory()
 
     def block(self) -> None:
         import jax

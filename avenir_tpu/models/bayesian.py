@@ -47,6 +47,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import telemetry
 from ..core.binning import DatasetEncoder, EncodedDataset
 from ..core.multiscan import FoldSpec as MultiScanFoldSpec
 from ..core.obs import get_tracer, traced_run
@@ -255,6 +256,29 @@ class _NBStreamState:
             self.mom_acc[j] = m.copy() if acc is None else acc + m
         self.n_chunks += 1
         return xs, ys
+
+
+def load_model_feature_counts(path: str, delim: str = ","
+                              ) -> Dict[int, Dict[str, int]]:
+    """Per-feature bin-count tables out of a written NB model file:
+    ``{ordinal: {bin_label: count}}`` summed across the per-class
+    feature-prior-binned lines (``<empty><delim>ord<delim>bin<delim>n``,
+    the empty-column tag dispatch the reference loader uses).  The
+    stored baseline side of the drift gauges — and the shape
+    :func:`core.telemetry.count_drift` consumes directly."""
+    out: Dict[int, Dict[str, int]] = defaultdict(lambda: defaultdict(int))
+    for line in read_lines(path):
+        parts = line.split(delim)
+        # feature prior binned: ["", ordinal, bin_label, count]; class
+        # priors have parts[1] == "", posteriors have parts[0] != "",
+        # continuous priors have 5 parts
+        if (len(parts) == 4 and parts[0] == ""
+                and parts[1] != "" and parts[2] != ""):
+            try:
+                out[int(parts[1])][parts[2]] += int(parts[3])
+            except ValueError:
+                continue
+    return {k: dict(v) for k, v in out.items()}
 
 
 class BayesianDistribution:
@@ -560,7 +584,50 @@ class BayesianDistribution:
             mean = _jdiv(int(vsum), int(cnt))
             std = _jstd(int(vsq), int(cnt), mean)
             lines.append(f"{delim}{ordinal}{delim}{delim}{mean}{delim}{std}")
+        self._emit_drift(ds, counts, counters, delim)
         return lines
+
+    def _emit_drift(self, ds: EncodedDataset, counts, counters: Counters,
+                    delim: str) -> None:
+        """Count-distribution drift gauges: with
+        ``telemetry.drift.baseline.path`` pointing at a previously
+        written NB model, diff each binned feature's marginal bin-count
+        distribution (this fold's counts summed over classes) against
+        the baseline's feature-prior table and emit the symmetrised-KL
+        divergence as a ``drift.<feature>`` gauge (+ a scaled ``Drift``
+        counter on the job's Counters).  This is the concrete sensor an
+        ``--update``-style re-scan reads to decide whether the delta is
+        material (ROADMAP item 4's retrain trigger)."""
+        base_path = self.config.get(telemetry.KEY_DRIFT_BASELINE)
+        if not base_path:
+            return
+        try:
+            baseline = load_model_feature_counts(base_path, delim)
+        except Exception as e:                          # noqa: BLE001
+            # an optional gauge must never fail the training run AFTER
+            # the whole fold completed — a missing, unreadable, or
+            # garbled (e.g. binary / non-UTF-8) baseline is surfaced on
+            # the counters, not raised
+            counters.set("Drift", "Baseline load failed", 1)
+            import sys
+            print(f"drift: cannot load baseline {base_path!r}: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            return
+        metrics = telemetry.get_metrics()
+        for j, f in enumerate(ds.feature_fields):
+            if not ds.binned_mask[j]:
+                continue            # Gaussian features carry no bin table
+            cur = {}
+            per_bin = np.asarray(counts)[:, j, :].sum(axis=0)
+            for b in range(ds.num_bins[j]):
+                c = int(per_bin[b])
+                if c:
+                    cur[ds.bin_label(j, b)] = c
+            div = telemetry.count_drift(baseline.get(f.ordinal, {}), cur)
+            name = f.name or str(f.ordinal)
+            metrics.set_gauge(f"drift.{name}", div)
+            counters.set("Drift", f"{name} (KL x1e6)",
+                         int(round(div * 1e6)))
 
     # -- text-classification mode -----------------------------------------
     TEXT_ORDINAL = 1   # fixed featureAttrOrdinal (BayesianDistribution.java:121)

@@ -32,7 +32,8 @@ from .breaker import CircuitBreaker, CircuitOpenError           # noqa: F401
 from .engine import ADAPTER_KINDS, pow2_bucket                  # noqa: F401
 from .registry import ModelRegistry                             # noqa: F401
 from .server import PredictionServer, serve_main                # noqa: F401
+from .slo import SLOBoard                                       # noqa: F401
 
 __all__ = ["ADAPTER_KINDS", "CircuitBreaker", "CircuitOpenError",
            "MicroBatcher", "ModelRegistry", "PredictionServer",
-           "ShedError", "pow2_bucket", "serve_main"]
+           "SLOBoard", "ShedError", "pow2_bucket", "serve_main"]

@@ -48,7 +48,7 @@ from collections import deque
 from concurrent.futures import Future
 from typing import Callable, List, Optional
 
-from ..core import faultinject
+from ..core import faultinject, telemetry
 from ..core.metrics import Counters
 from ..core.obs import LatencyHistogram, get_tracer
 from .breaker import CircuitBreaker, CircuitOpenError
@@ -224,9 +224,14 @@ class MicroBatcher:
                         fi_score = faultinject.get_injector()
                         if fi_score is not None:
                             fi_score.fire("scorer")
+                            fi_score.fire("scorer_slow")
                         outputs = self.predict_fn([r.line for r in batch])
                 except Exception as e:                 # noqa: BLE001
                     self.counters.incr(SERVE_GROUP, "Batch errors")
+                    # per-request failure accounting: the SLO monitor's
+                    # windowed error rate diffs this counter
+                    self.counters.incr(SERVE_GROUP, "Failed requests",
+                                       len(batch))
                     if self.breaker is not None:
                         self.breaker.record_failure()
                     for r in batch:
@@ -236,6 +241,8 @@ class MicroBatcher:
                     continue
                 if self.breaker is not None:
                     self.breaker.record_success()
+                # rate-limited device residency sample per scored batch
+                telemetry.sample_device_memory()
                 done = time.perf_counter()
                 for r in batch:
                     self.e2e_hist.record(done - r.t_enqueue)

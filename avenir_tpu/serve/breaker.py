@@ -67,6 +67,8 @@ class CircuitBreaker:
         self._opened_at: Optional[float] = None
         self._probes_admitted = 0
         self.trips = 0          # closed/half_open -> open transitions
+        self._slo_degraded = False   # soft-degrade (serve.slo monitor)
+        self._slo_reason: Optional[str] = None
 
     @classmethod
     def from_config(cls, config, name: str) -> Optional["CircuitBreaker"]:
@@ -120,6 +122,23 @@ class CircuitBreaker:
                 self._opened_at = self._clock()
                 self.trips += 1
 
+    # -- soft degrade (the SLO monitor's signal) ---------------------------
+    def set_soft_degraded(self, flag: bool,
+                          reason: Optional[str] = None) -> None:
+        """SLO-sustained-violation signal (serve/slo.py): does NOT gate
+        admission — requests keep flowing — but the model reports
+        degraded through ``health``/``stats``/the breaker-state gauge,
+        and ROADMAP item 2's variant router will read exactly this bit
+        to demote a variant before the hard breaker ever trips."""
+        with self._lock:
+            self._slo_degraded = bool(flag)
+            self._slo_reason = reason if flag else None
+
+    @property
+    def soft_degraded(self) -> bool:
+        with self._lock:
+            return self._slo_degraded
+
     # -- reporting ---------------------------------------------------------
     @property
     def state(self) -> str:
@@ -127,14 +146,23 @@ class CircuitBreaker:
             return self._state
 
     def degraded(self) -> bool:
-        return self.state != CLOSED
+        with self._lock:
+            return self._state != CLOSED or self._slo_degraded
+
+    def state_code(self) -> int:
+        """The breaker state as a gauge value: 0 closed, 1 half-open,
+        2 open (the telemetry exporter's 0/1/2 encoding)."""
+        return {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}[self.state]
 
     def state_dict(self) -> dict:
         with self._lock:
             d = {"state": self._state,
                  "consecutive_failures": self._consecutive,
                  "failure_threshold": self.failure_threshold,
-                 "trips": self.trips}
+                 "trips": self.trips,
+                 "slo_degraded": self._slo_degraded}
+            if self._slo_reason:
+                d["slo_reason"] = self._slo_reason
             if self._opened_at is not None:
                 d["open_age_sec"] = round(self._clock() - self._opened_at, 3)
             return d

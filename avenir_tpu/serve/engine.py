@@ -29,6 +29,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..core import telemetry
 from ..core.config import JobConfig
 from ..core.io import split_line
 from ..core.metrics import Counters
@@ -190,9 +191,12 @@ class NaiveBayesAdapter(ModelAdapter):
             + [self._cls_ord]) + 1
 
     def _compiled(self, bucket: int):
+        # profiled_jit: the (warmup or first-traffic) XLA compile of each
+        # bucket's scorer lands in the xla.compile.ms telemetry counter
         return self.cache.get(
             ("nb", id(self), bucket),
-            lambda: self._jax.jit(self._score_fn))
+            lambda: telemetry.profiled_jit(self._score_fn,
+                                           f"serve.nb.score.b{bucket}"))
 
     def warm(self, bucket: int) -> None:
         x = np.zeros((bucket, self._F), np.int32)
@@ -300,7 +304,9 @@ class MarkovClassifierAdapter(ModelAdapter):
         from ..models.markov import _mmc_pair_log_odds
         return self.cache.get(
             ("markov", id(self), bucket, len_bucket),
-            lambda: self._jax.jit(_mmc_pair_log_odds))
+            lambda: telemetry.profiled_jit(
+                _mmc_pair_log_odds,
+                f"serve.markov.score.b{bucket}.l{len_bucket}"))
 
     def warm(self, bucket: int) -> None:
         clf = self.classifier

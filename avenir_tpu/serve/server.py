@@ -10,7 +10,10 @@ handler thread, which parks on the micro-batcher future):
     {"model": "churn", "rows": ["...", "..."]}          # client-side batch
       -> {"model": "churn", "version": "1", "outputs": ["...", "..."]}
     {"cmd": "stats"}            -> per-model counters + latency percentiles
-    {"cmd": "health"}           -> {"ok": true, "models": [...]}
+    {"cmd": "health"}           -> {"ok": true, "models": [...], "slo": {...}}
+    {"cmd": "metrics"}          -> Prometheus TEXT exposition (multi-line,
+                                   terminated by "# EOF"; read it with
+                                   ``request_text`` / a scrape loop)
     {"cmd": "reload", "model": "churn"}   -> hot swap from updated artifacts
 
 Error responses carry {"error": "..."} (plus {"shed": true} when admission
@@ -25,7 +28,12 @@ registry's ``serve.models`` / ``serve.model.<name>.*`` surface and
 keys (README "Fault tolerance"): ``serve.request.deadline.ms``,
 ``serve.breaker.failures`` / ``serve.breaker.reset.sec`` /
 ``serve.breaker.probe.requests``, ``serve.watchdog.interval.sec``,
-``serve.max.line.bytes``.
+``serve.max.line.bytes``.  Telemetry keys (README "Telemetry & SLOs"):
+``telemetry.interval.sec`` / ``telemetry.jsonl.path`` (or the
+``--metrics-out`` flag) drive the periodic exporter, and the
+``serve.slo.*`` surface (slo.py) declares the rolling-window targets
+whose violation flips the SLO gauges, the ``health`` report, and the
+breaker's soft-degrade bit.
 """
 
 from __future__ import annotations
@@ -38,11 +46,12 @@ import threading
 import time
 from typing import Dict, Optional
 
-from ..core import obs
+from ..core import obs, telemetry
 from ..core.config import JobConfig, load_job_config, parse_cli_args
 from .batcher import MicroBatcher, ShedError
 from .breaker import CircuitBreaker, CircuitOpenError
 from .registry import ModelEntry, ModelRegistry
+from .slo import SLOBoard
 
 # a distinct class pre-3.11, an alias of the builtin after
 from concurrent.futures import TimeoutError as _FutureTimeout
@@ -86,6 +95,16 @@ class PredictionServer:
             self._attach(entry)
         self._watchdog_thread = self._start_watchdog(
             config.get_float("serve.watchdog.interval.sec", 0.5))
+        # telemetry: rolling SLO monitors + the periodic exporter whose
+        # snapshot backs the ``metrics`` command (Prometheus exposition)
+        # and the optional telemetry.jsonl.path time-series file
+        self.slo = SLOBoard(config)
+        telemetry.configure_from_config(config)
+        self.telemetry = telemetry.TelemetryExporter(
+            config.get_float(telemetry.KEY_INTERVAL,
+                             telemetry.DEFAULT_INTERVAL_SEC),
+            jsonl_path=config.get(telemetry.KEY_JSONL_PATH),
+            providers=[self._telemetry_overlay]).start()
 
     # -- model plumbing ----------------------------------------------------
     def _attach(self, entry: ModelEntry) -> None:
@@ -128,6 +147,65 @@ class PredictionServer:
             raise KeyError(f"model {name!r} is not loaded")
         return b
 
+    # -- telemetry ---------------------------------------------------------
+    def _observe_slo(self) -> Dict[str, dict]:
+        """Evaluate every model's rolling SLO window NOW (also feeds the
+        sustained-violation soft-degrade signal into the breakers)."""
+        with self._lock:
+            batchers = dict(self._batchers)
+        return {name: self.slo.observe(name, b)
+                for name, b in sorted(batchers.items())}
+
+    def _telemetry_overlay(self) -> dict:
+        """The per-model snapshot sections the exporter/`metrics` scrape
+        adds on top of the global registry: latency histogram states
+        (model-labeled), queue/breaker/worker gauges (breaker state as
+        the 0/1/2 encoding), per-model counters, and the SLO gauges."""
+        slo_stats = self._observe_slo()
+        with self._lock:
+            batchers = dict(self._batchers)
+        now = time.time()
+        gauges: Dict[str, dict] = {}
+        hists: Dict[str, dict] = {}
+        counters: Dict[str, dict] = {}
+
+        def g(name, model, value):
+            gauges[telemetry.labeled(name, model=model)] = {
+                "value": float(value), "ts": now}
+
+        for name, b in sorted(batchers.items()):
+            hists[telemetry.labeled("serve.e2e.latency", model=name)] = \
+                b.e2e_hist.state_dict()
+            hists[telemetry.labeled("serve.queue.wait", model=name)] = \
+                b.queue_wait_hist.state_dict()
+            g("serve.queue.depth", name, b.depth())
+            g("serve.worker.alive", name, 1 if b.worker_alive() else 0)
+            brk = b.breaker
+            g("serve.breaker.state", name,
+              brk.state_code() if brk is not None else 0)
+            g("serve.breaker.soft.degraded", name,
+              1 if (brk is not None and brk.soft_degraded) else 0)
+            counters[f"Serve.{name}"] = b.counters.as_dict().get(
+                "Serve", {})
+            stats = slo_stats.get(name) or {}
+            if stats.get("p50_ms") is not None:
+                g("serve.slo.p50.ms", name, stats["p50_ms"])
+            if stats.get("p99_ms") is not None:
+                g("serve.slo.p99.ms", name, stats["p99_ms"])
+            g("serve.slo.shed.pct", name, stats.get("shed_pct", 0.0))
+            g("serve.slo.error.pct", name, stats.get("error_pct", 0.0))
+            g("serve.slo.violation", name,
+              1 if stats.get("violation") else 0)
+            g("serve.slo.sustained", name,
+              1 if stats.get("sustained") else 0)
+        return {"gauges": gauges, "hists": hists, "counters": counters}
+
+    def metrics_text(self) -> str:
+        """The Prometheus text exposition of the current combined
+        snapshot (global registry + serve overlay) — what the ``metrics``
+        command returns and a scrape loop parses."""
+        return telemetry.prometheus_text(self.telemetry.snapshot())
+
     def _default_model(self) -> str:
         names = self.registry.model_names()
         if len(names) == 1:
@@ -154,6 +232,10 @@ class PredictionServer:
                 return self._stats()
             if cmd == "health":
                 return self._health()
+            if cmd == "metrics":
+                # Prometheus text exposition, NOT a JSON line: the
+                # frontend writes the raw text (terminated by "# EOF")
+                return {"_text": self.metrics_text()}
             if cmd == "reload":
                 entry = self.registry.reload(
                     obj.get("model") or self._default_model())
@@ -261,21 +343,30 @@ class PredictionServer:
 
     def _health(self) -> dict:
         """Health now reports DEGRADED models explicitly: a model whose
-        breaker is open/half-open, or whose batcher worker is down, is
-        still listed (requests fail fast with structured errors) but the
-        top-level ``ok`` drops to False so orchestrators can see it."""
+        breaker is open/half-open, whose batcher worker is down, or
+        whose rolling SLO window is in SUSTAINED violation (the
+        soft-degrade signal) is still listed (requests fail fast — or,
+        for SLO-only degradation, keep flowing — with the state
+        visible) but the top-level ``ok`` drops to False so
+        orchestrators can see it.  The ``slo`` section carries every
+        model's windowed p50/p99/shed/error stats vs its declared
+        targets."""
+        slo_stats = self._observe_slo()
         models, degraded = [], []
         for e in self.registry.entries():
             b = self._batchers.get(e.name)
             brk = b.breaker if b else None
             state = brk.state if brk is not None else "closed"
             worker_ok = b.worker_alive() if b else False
-            if state != "closed" or not worker_ok:
+            slo_bad = bool((slo_stats.get(e.name) or {}).get("sustained"))
+            if state != "closed" or not worker_ok or slo_bad:
                 degraded.append(e.name)
             models.append({"name": e.name, "version": e.version,
                            "kind": e.kind, "breaker": state,
+                           "slo_degraded": slo_bad,
                            "worker_alive": worker_ok})
-        return {"ok": not degraded, "degraded": degraded, "models": models}
+        return {"ok": not degraded, "degraded": degraded, "models": models,
+                "slo": slo_stats}
 
     def _stats(self) -> dict:
         models = {}
@@ -296,7 +387,8 @@ class PredictionServer:
                 "breaker": (b.breaker.state_dict()
                             if b and b.breaker is not None else None),
             }
-        return {"models": models, "obs": obs.get_tracer().stats()}
+        return {"models": models, "obs": obs.get_tracer().stats(),
+                "slo": self.slo.section()}
 
     # -- TCP frontend ------------------------------------------------------
     def start(self) -> int:
@@ -341,8 +433,16 @@ class PredictionServer:
                             resp = {"error": f"internal error: "
                                              f"{type(e).__name__}: {e}"}
                     try:
-                        self.wfile.write(
-                            (json.dumps(resp) + "\n").encode())
+                        if isinstance(resp, dict) and "_text" in resp:
+                            # raw text response (the `metrics` Prometheus
+                            # exposition): multi-line, "# EOF"-terminated
+                            text = resp["_text"]
+                            if not text.endswith("\n"):
+                                text += "\n"
+                            self.wfile.write(text.encode())
+                        else:
+                            self.wfile.write(
+                                (json.dumps(resp) + "\n").encode())
                         self.wfile.flush()
                     except OSError:
                         return
@@ -369,6 +469,10 @@ class PredictionServer:
 
     def stop(self) -> None:
         self._stop_watchdog.set()
+        # stop the telemetry thread FIRST (its final tick still sees the
+        # live batchers); verifiably gone afterwards — the shutdown lint
+        # hammers start/stop and asserts no leaked avenir-telemetry thread
+        self.telemetry.stop()
         if self._tcp is not None:
             self._tcp.shutdown()
             self._tcp.server_close()
@@ -394,16 +498,46 @@ def request(host: str, port: int, obj: dict, timeout: float = 30.0) -> dict:
     return json.loads(buf.decode())
 
 
+def request_text(host: str, port: int, obj: dict,
+                 timeout: float = 30.0) -> str:
+    """One-shot client for TEXT responses (the ``metrics`` Prometheus
+    exposition): sends one JSON request line, reads until the ``# EOF``
+    terminator line (or connection close) — the scrape-loop primitive
+    the telemetry runbook's client uses.  If the server answers with a
+    one-line JSON error instead of exposition (e.g. ``metrics_text``
+    itself failed, or the cmd was not ``metrics``), that line is
+    returned immediately — the caller gets the diagnostic instead of
+    blocking until the socket timeout waiting for a terminator that
+    will never come."""
+    terminator = b"# EOF\n"
+    with socket.create_connection((host, port), timeout=timeout) as sock:
+        sock.sendall((json.dumps(obj) + "\n").encode())
+        buf = b""
+        while True:
+            chunk = sock.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+            if buf.endswith(terminator):
+                break
+            if buf.startswith(b"{") and buf.endswith(b"\n"):
+                break                      # a JSON (error) response line
+    return buf.decode()
+
+
 def serve_main(argv) -> int:
     """``python -m avenir_tpu serve -Dconf.path=serve.properties
-    [--trace out.json]``."""
-    from ..cli import configure_resilience, extract_trace_flag
+    [--trace out.json] [--metrics-out series.jsonl]``."""
+    from ..cli import (configure_resilience, extract_metrics_out_flag,
+                       extract_trace_flag)
 
     argv, trace_path = extract_trace_flag(list(argv))
+    argv, metrics_out = extract_metrics_out_flag(argv)
     defines, positional = parse_cli_args(argv)
     if positional and positional[0] in ("-h", "--help"):
         print("usage: python -m avenir_tpu serve -Dconf.path=<serve."
-              "properties> [-Dserve.port=N ...] [--trace out.json]",
+              "properties> [-Dserve.port=N ...] [--trace out.json] "
+              "[--metrics-out series.jsonl]",
               file=sys.stderr)
         return 2
     config = load_job_config(defines)
@@ -411,9 +545,15 @@ def serve_main(argv) -> int:
         print("serve: no models configured (serve.models=...)",
               file=sys.stderr)
         return 2
+    if metrics_out:
+        # the server's own exporter reads the key; the flag just sets it
+        config.set(telemetry.KEY_JSONL_PATH, metrics_out)
     obs.configure_from_config(config, force_enable=bool(trace_path))
     configure_resilience(config)
     server = PredictionServer(config)
+    # started only after the server construction succeeded: a model-load
+    # failure above must not leak the trace-flush thread
+    flusher = telemetry.flusher_for_job(config, trace_path)
     port = server.start()
     names = ", ".join(
         f"{e.name}:{e.version}({e.kind})" for e in server.registry.entries())
@@ -437,6 +577,8 @@ def serve_main(argv) -> int:
         pass
     finally:
         server.stop()
+        if flusher is not None:
+            flusher.stop()
         if trace_path:
             n = obs.get_tracer().export_chrome_trace(trace_path)
             print(f"obs: wrote {n} trace events to {trace_path} "

@@ -1426,6 +1426,113 @@ def bench_obs_overhead():
     return finish_metric(out, bigger_is_better=False)
 
 
+def bench_telemetry_overhead():
+    """Telemetry tax (core.telemetry): the cold NB ingest->model path
+    with the production-telemetry surfaces ENABLED — periodic exporter
+    thread at a 4x-aggressive 0.25s interval appending JSONL snapshots,
+    device-memory sampling at the same rate (the per-chunk
+    ``device.hbm.bytes`` gauge), and drift gauges against a stored
+    baseline model — vs the all-off configuration.  The compile-probe in
+    ``profiled_jit`` (one C++ ``_cache_size`` call per chunk) is always
+    on, so both sides include it and the measured delta is the opt-in
+    cost: snapshot building + JSONL append + live-array walks + the
+    per-feature KL at emit.  Asserted < 2% (min-of-N both sides, the
+    contention-robust methodology of the other e2e metrics)."""
+    import shutil
+    import tempfile
+
+    from avenir_tpu.core import JobConfig, telemetry
+    from avenir_tpu.datagen import gen_telecom_churn
+    from avenir_tpu.models.bayesian import BayesianDistribution
+
+    tmp = tempfile.mkdtemp(prefix="telemetry_bench_")
+    try:
+        n_rows = 1_600_000
+        base = gen_telecom_churn(50_000, seed=9)
+        reps_factor = n_rows // len(base)
+        n_rows = reps_factor * len(base)
+        in_dir = os.path.join(tmp, "in")
+        os.makedirs(in_dir)
+        block = "\n".join(",".join(r) for r in base) + "\n"
+        with open(os.path.join(in_dir, "part-00000"), "w") as fh:
+            for _ in range(reps_factor):
+                fh.write(block)
+        schema_path = os.path.join(tmp, "schema.json")
+        with open(schema_path, "w") as fh:
+            fh.write(json.dumps(_CHURN_SCHEMA))
+        chunk_rows = 1 << 15
+        base_cfg = {"feature.schema.file.path": schema_path,
+                    "pipeline.chunk.rows": str(chunk_rows)}
+
+        def run_plain():
+            telemetry.set_device_sample_interval(0.0)
+            BayesianDistribution(JobConfig(dict(base_cfg))).run(
+                in_dir, os.path.join(tmp, "out_plain"))
+
+        series = os.path.join(tmp, "series.jsonl")
+
+        def run_telemetry():
+            # fresh series per run: the reported jsonl_snapshots count
+            # is ONE run's tick count, not an accumulation across reps
+            if os.path.exists(series):
+                os.remove(series)
+            telemetry.set_device_sample_interval(0.25)
+            cfg = dict(base_cfg)
+            cfg[telemetry.KEY_DRIFT_BASELINE] = os.path.join(tmp,
+                                                             "baseline")
+            cfg[telemetry.KEY_INTERVAL] = "0.25"
+            exp = telemetry.exporter_for_job(JobConfig(cfg),
+                                             metrics_out=series)
+            try:
+                BayesianDistribution(JobConfig(cfg)).run(
+                    in_dir, os.path.join(tmp, "out_tele"))
+            finally:
+                exp.stop()
+
+        # warmup (compiles) + the drift baseline artifact
+        BayesianDistribution(JobConfig(dict(base_cfg))).run(
+            in_dir, os.path.join(tmp, "baseline"))
+        run_telemetry()
+        # INTERLEAVED A/B: ambient load on the shared host drifts on the
+        # seconds scale, which can dwarf a ~1% effect when one whole
+        # sample set runs after the other — alternating runs exposes
+        # both sides to the same drift, and min-of-each still filters
+        # contention spikes
+        t_plain, t_tele = [], []
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            run_plain()
+            t_plain.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            run_telemetry()
+            t_tele.append(time.perf_counter() - t0)
+        telemetry.set_device_sample_interval(
+            telemetry.DEFAULT_DEVICE_SAMPLE_SEC)
+        with open(series) as fh:
+            n_lines = sum(1 for _ in fh)
+        overhead = max(
+            0.0, 100.0 * (min(t_tele) - min(t_plain)) / min(t_plain))
+        assert overhead < 2.0, (
+            f"telemetry-enabled overhead {overhead:.2f}% >= 2% "
+            f"(plain={min(t_plain):.3f}s telemetry={min(t_tele):.3f}s)")
+        out = {"metric": "telemetry_overhead_pct",
+               "value": round(overhead, 3),
+               "unit": "% cold NB ingest e2e wall time added by exporter@"
+                       "0.25s + device sampling + drift gauges "
+                       "(asserted < 2)",
+               "vs_baseline": None,
+               "plain_sec": round(min(t_plain), 4),
+               "telemetry_sec": round(min(t_tele), 4),
+               "jsonl_snapshots": n_lines,
+               "plain_spread_sec": {
+                   "min": round(min(t_plain), 4),
+                   "median": round(statistics.median(t_plain), 4),
+                   "max": round(max(t_plain), 4), "reps": len(t_plain)}}
+        return finish_metric(out, t_tele, bigger_is_better=False)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
     import avenir_tpu
     avenir_tpu.enable_x64()
@@ -1501,6 +1608,7 @@ def main():
                      ("nb_score", bench_nb_score),
                      ("serving", bench_serving),
                      ("obs_overhead", bench_obs_overhead),
+                     ("telemetry_overhead", bench_telemetry_overhead),
                      ("resilience_overhead", bench_resilience_overhead),
                      ("streaming", bench_streaming_rl)):
         print(f"[bench] {nm}...", file=sys.stderr, flush=True)

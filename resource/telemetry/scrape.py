@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Scrape-loop client for the telemetry runbook: waits for the server
+banner, drives load, and polls the ``metrics`` command the way a
+Prometheus scraper would — parsing the text exposition and printing the
+SLO/breaker/latency families each cycle.
+
+Usage: scrape.py <server.log> <test.csv> [cycles] [--expect-violation]
+"""
+
+import json
+import re
+import socket
+import sys
+import threading
+import time
+
+
+def wait_for_port(log_path: str, timeout: float = 60.0):
+    deadline = time.time() + timeout
+    pat = re.compile(r"serving .* on ([\w.]+):(\d+)")
+    while time.time() < deadline:
+        try:
+            m = pat.search(open(log_path).read())
+        except OSError:
+            m = None
+        if m:
+            return m.group(1), int(m.group(2))
+        time.sleep(0.2)
+    raise SystemExit(f"server did not come up (see {log_path})")
+
+
+def request(host, port, obj):
+    with socket.create_connection((host, port), timeout=30) as sock:
+        sock.sendall((json.dumps(obj) + "\n").encode())
+        buf = b""
+        while not buf.endswith(b"\n"):
+            chunk = sock.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    return json.loads(buf.decode())
+
+
+def scrape(host, port):
+    """One metrics scrape: returns {metric_line_name: value} for every
+    sample line of the exposition (read until the # EOF terminator)."""
+    with socket.create_connection((host, port), timeout=30) as sock:
+        sock.sendall((json.dumps({"cmd": "metrics"}) + "\n").encode())
+        buf = b""
+        while not buf.endswith(b"# EOF\n"):
+            chunk = sock.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    out = {}
+    for line in buf.decode().splitlines():
+        if line.startswith("#") or not line:
+            continue
+        name, _, value = line.rpartition(" ")
+        out[name] = float(value)
+    return out
+
+
+def main():
+    log_path, test_csv = sys.argv[1], sys.argv[2]
+    cycles = int(sys.argv[3]) if len(sys.argv) > 3 else 5
+    expect_violation = "--expect-violation" in sys.argv
+    host, port = wait_for_port(log_path)
+    rows = [l.strip() for l in open(test_csv) if l.strip()]
+
+    def fire(n):
+        def one(row):
+            request(host, port, {"model": "churn", "row": row})
+        threads = [threading.Thread(target=one, args=(rows[i % len(rows)],))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    saw_violation = False
+    for cycle in range(cycles):
+        fire(24)
+        m = scrape(host, port)
+        p99 = m.get('avenir_serve_slo_p99_ms{model="churn"}')
+        viol = m.get('avenir_serve_slo_violation{model="churn"}', 0)
+        sust = m.get('avenir_serve_slo_sustained{model="churn"}', 0)
+        brk = m.get('avenir_serve_breaker_state{model="churn"}')
+        e2e_n = m.get('avenir_serve_e2e_latency_seconds_count{model="churn"}')
+        compile_ms = m.get(
+            'avenir_counter_total{group="Telemetry",name="xla.compile.ms"}')
+        buckets = sum(1 for k in m
+                      if k.startswith("avenir_serve_e2e_latency_seconds_"
+                                      "bucket"))
+        print(f"scrape {cycle}: e2e n={e2e_n:.0f} ({buckets} buckets) "
+              f"p99={p99}ms violation={viol:.0f} sustained={sust:.0f} "
+              f"breaker={brk:.0f} xla.compile.ms={compile_ms:.0f}")
+        saw_violation |= bool(viol)
+        time.sleep(0.3)
+
+    health = request(host, port, {"cmd": "health"})
+    slo = health["slo"]["churn"]
+    print(f"health: ok={health['ok']} degraded={health['degraded']} "
+          f"slo.p99={slo['p99_ms']}ms target={slo['target_p99_ms']}ms "
+          f"sustained={slo['sustained']}")
+    if expect_violation:
+        assert saw_violation, "expected an SLO violation, saw none"
+        assert not health["ok"] and health["degraded"] == ["churn"], health
+        print("SLO violation -> degraded health: OK")
+    else:
+        assert health["ok"], health
+    print("scrape loop OK")
+
+
+if __name__ == "__main__":
+    main()

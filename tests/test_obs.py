@@ -221,6 +221,9 @@ def test_histogram_thread_safety_hammer():
 
 
 def test_metrics_registry_snapshot():
+    import time as _time
+
+    t_before = _time.time()
     m = Metrics()
     m.counters.incr("G", "n", 3)
     m.histogram("lat").record(0.002)
@@ -229,7 +232,12 @@ def test_metrics_registry_snapshot():
     snap = m.snapshot()
     assert snap["counters"] == {"G": {"n": 3}}
     assert snap["histograms"]["lat"]["n"] == 2
-    assert snap["gauges"] == {"depth": 5.0}
+    # gauges + the snapshot itself are timestamped (epoch + monotonic)
+    # so exported series can be plotted/joined
+    assert snap["gauges"]["depth"]["value"] == 5.0
+    assert t_before <= snap["gauges"]["depth"]["ts"] <= _time.time()
+    assert t_before <= snap["ts"] <= _time.time()
+    assert snap["mono"] <= _time.monotonic()
 
 
 # ---------------------------------------------------------------------------

@@ -1,12 +1,20 @@
 """Tier-2 observability lint: every registered batch driver must emit a
 top-level span from ``run()`` (the ``core.obs.traced_run`` decorator) and
 return a Counters metrics snapshot — so new drivers cannot silently opt
-out of the unified tracing + metrics surface."""
+out of the unified tracing + metrics surface.  The telemetry layer rides
+the same lint: every ``telemetry.*``/``serve.slo.*`` config key must be
+bound to a KEY_ constant, read through a JobConfig accessor, and
+documented in README, and the telemetry exporter thread must be
+verifiably stopped on shutdown."""
 
 import importlib
 import inspect
+import os
+import re
 
 from avenir_tpu.cli import JOBS
+
+_PKG_ROOT = os.path.join(os.path.dirname(__file__), "..", "avenir_tpu")
 
 # run() returns something other than Counters by DESIGN for these:
 # - LogisticRegressionJob.run returns the reference's convergence status
@@ -42,6 +50,94 @@ def test_every_registered_driver_run_returns_counters():
         if name != "Counters":
             bad.append((fqcn, name))
     assert not bad, f"drivers whose run() does not return Counters: {bad}"
+
+
+# ---------------------------------------------------------------------------
+# telemetry config-key lint
+# ---------------------------------------------------------------------------
+
+# a key literal READ directly through a JobConfig accessor (gauge/metric
+# NAMES reuse the dotted vocabulary but never flow through an accessor,
+# so they stay out of the config-key lint)
+_ACCESSOR_LITERAL_RE = re.compile(
+    r'\.(?:get|get_int|get_float|get_boolean|get_list|must|must_int|'
+    r'must_float|must_list)\(\s*"((?:telemetry|serve\.slo)\.[a-z0-9.]+)"')
+
+
+def _package_sources():
+    for root, _dirs, files in os.walk(_PKG_ROOT):
+        for fn in files:
+            if fn.endswith(".py"):
+                path = os.path.join(root, fn)
+                with open(path) as fh:
+                    yield path, fh.read()
+
+
+def _collect_config_keys():
+    """Every telemetry.*/serve.slo.* config key in the package: bound to
+    a KEY_ constant, or (a lint violation) read as a bare literal."""
+    keys = {}
+    const_re = re.compile(
+        r'^(KEY_[A-Z0-9_]+)\s*=\s*"((?:telemetry|serve\.slo)\.[a-z0-9.]+)"',
+        re.MULTILINE)
+    for path, text in _package_sources():
+        for m in const_re.finditer(text):
+            keys.setdefault(m.group(2), m.group(1))
+        for m in _ACCESSOR_LITERAL_RE.finditer(text):
+            keys.setdefault(m.group(1), None)
+    return keys
+
+
+def test_telemetry_keys_are_constants_read_through_jobconfig():
+    """Every telemetry.*/serve.slo.* key must be declared as a KEY_
+    constant AND read somewhere through a JobConfig accessor referencing
+    that constant — no ad-hoc string reads that drift from the docs."""
+    keys = _collect_config_keys()
+    assert keys, "no telemetry config keys found (lint broken?)"
+    sources = list(_package_sources())
+    bad = []
+    for key, const in sorted(keys.items()):
+        if const is None:
+            bad.append((key, "no KEY_ constant binds this literal"))
+            continue
+        accessor = re.compile(
+            r"\.(?:get|get_int|get_float|get_boolean|get_list|must|"
+            r"must_int|must_float|must_list)\(\s*(?:\w+\.)?" + const + r"\b")
+        if not any(accessor.search(text) for _p, text in sources):
+            bad.append((key, f"{const} never read via a JobConfig accessor"))
+    assert not bad, f"telemetry config keys failing the lint: {bad}"
+
+
+def test_telemetry_keys_documented_in_readme():
+    readme = open(os.path.join(_PKG_ROOT, "..", "README.md")).read()
+    missing = [k for k in sorted(_collect_config_keys())
+               if k not in readme]
+    assert not missing, (
+        f"telemetry/serve.slo config keys missing from README: {missing}")
+
+
+def test_telemetry_exporter_threads_stop_on_shutdown():
+    """Hammer: exporters and trace flushers started and stopped
+    repeatedly leave NO surviving threads (the serve-exit half of this
+    guarantee is hammered in tests/test_slo.py)."""
+    import threading
+
+    from avenir_tpu.core import obs, telemetry
+
+    def leaked():
+        return [t.name for t in threading.enumerate()
+                if t.name.startswith(telemetry.THREAD_PREFIXES)]
+
+    for _ in range(10):
+        exp = telemetry.TelemetryExporter(0.005).start()
+        fl = telemetry.TraceFlusher(obs.Tracer(enabled=True),
+                                    "/dev/null", 0.005)
+        fl.start()
+        assert sorted(leaked()) == ["avenir-telemetry",
+                                    "avenir-trace-flush"]
+        exp.stop(final_tick=False)
+        fl.stop()
+        assert leaked() == []
 
 
 def test_traced_run_emits_top_level_span():

@@ -1,0 +1,400 @@
+"""Rolling-window SLO monitors + serve telemetry surface
+(serve/slo.py, the `metrics` command, breaker soft-degrade):
+
+- window math on synthetic batcher state (diff-of-cumulative-snapshots),
+- the deterministic offered-load violation: a fault-injected slow scorer
+  (``scorer_slow@*``) drives windowed p99 past a declared
+  ``serve.slo.p99.ms``, flipping the SLO gauge, the ``health`` report,
+  and the breaker's soft-degrade bit — then clears on recovery,
+- a live serve session answering ``metrics`` with valid Prometheus
+  exposition (per-model histogram buckets, SLO gauges, breaker state,
+  xla.compile.ms),
+- shutdown hygiene: no leaked telemetry threads after serve exit
+  (hammer)."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from avenir_tpu.core import faultinject, telemetry
+from avenir_tpu.core.config import JobConfig
+from avenir_tpu.core.io import write_output
+from avenir_tpu.core.metrics import Counters
+from avenir_tpu.datagen import gen_telecom_churn
+from avenir_tpu.models.bayesian import BayesianDistribution
+from avenir_tpu.serve import MicroBatcher, PredictionServer
+from avenir_tpu.serve.breaker import CircuitBreaker
+from avenir_tpu.serve.server import request, request_text
+from avenir_tpu.serve.slo import ModelSLO, SLOBoard
+
+CHURN_SCHEMA = {"fields": [
+    {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+    {"name": "plan", "ordinal": 1, "dataType": "categorical",
+     "feature": True, "cardinality": ["planA", "planB"]},
+    {"name": "minUsed", "ordinal": 2, "dataType": "int", "feature": True,
+     "min": 0, "max": 2200, "bucketWidth": 200},
+    {"name": "dataUsed", "ordinal": 3, "dataType": "int", "feature": True,
+     "min": 0, "max": 1000, "bucketWidth": 100},
+    {"name": "csCall", "ordinal": 4, "dataType": "int", "feature": True,
+     "min": 0, "max": 14, "bucketWidth": 2},
+    {"name": "csEmail", "ordinal": 5, "dataType": "int", "feature": True,
+     "min": 0, "max": 22, "bucketWidth": 4},
+    {"name": "network", "ordinal": 6, "dataType": "int", "feature": True},
+    {"name": "churned", "ordinal": 7, "dataType": "categorical",
+     "cardinality": ["N", "Y"]},
+]}
+
+
+@pytest.fixture(autouse=True)
+def _no_injector():
+    yield
+    faultinject.set_injector(None)
+
+
+@pytest.fixture(scope="module")
+def nb_artifact(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("slo_artifacts")
+    sp = tmp / "schema.json"
+    sp.write_text(json.dumps(CHURN_SCHEMA))
+    rows = gen_telecom_churn(400, seed=17)
+    write_output(str(tmp / "train"), [",".join(r) for r in rows])
+    BayesianDistribution(JobConfig(
+        {"feature.schema.file.path": str(sp)})).run(
+        str(tmp / "train"), str(tmp / "model"))
+    return {"schema": str(sp), "model": str(tmp / "model"),
+            "lines": [",".join(r) for r in rows]}
+
+
+def _serve_config(art, **extra):
+    props = {"serve.models": "churn",
+             "serve.model.churn.kind": "naiveBayes",
+             "serve.model.churn.feature.schema.file.path": art["schema"],
+             "serve.model.churn.bayesian.model.file.path": art["model"],
+             "telemetry.interval.sec": "0"}
+    props.update({k: str(v) for k, v in extra.items()})
+    return JobConfig(props)
+
+
+class _FakeBatcher:
+    """A batcher stand-in with controllable cumulative state."""
+
+    def __init__(self):
+        from avenir_tpu.core.obs import LatencyHistogram
+        self.e2e_hist = LatencyHistogram()
+        self.counters = Counters()
+        self.breaker = CircuitBreaker("m")
+
+    def record(self, latencies_s, requests=None, shed=0, failed=0,
+               expired=0):
+        for v in latencies_s:
+            self.e2e_hist.record(v)
+        self.counters.incr("Serve", "Requests",
+                           len(latencies_s) if requests is None else requests)
+        if shed:
+            self.counters.incr("Serve", "Shed", shed)
+        if failed:
+            self.counters.incr("Serve", "Failed requests", failed)
+        if expired:
+            self.counters.incr("Serve", "Deadline expired", expired)
+
+
+# ---------------------------------------------------------------------------
+# window math
+# ---------------------------------------------------------------------------
+
+def test_rolling_window_diffs_cumulative_state():
+    mon = ModelSLO("m", p99_ms=50.0, window_sec=10.0, degrade_evals=2)
+    b = _FakeBatcher()
+    b.record([0.001] * 100)
+    s1 = mon.observe(b, now=0.0)
+    assert s1["n"] == 100 and s1["p99_ms"] < 50.0
+    assert not s1["violation"]
+    # 100 slow requests arrive: the window now holds fast + slow, and
+    # its p99 lands in the slow mass
+    b.record([0.2] * 100)
+    s2 = mon.observe(b, now=1.0)
+    assert s2["n"] == 200
+    assert s2["p99_ms"] > 150.0
+    assert s2["violation"] and not s2["sustained"]
+    # once the slow burst ages past window_sec with no new traffic, the
+    # evaluation is clean and the violation streak resets
+    s3 = mon.observe(b, now=15.0)
+    assert s3["n"] == 0 and not s3["violation"]
+    assert mon.consecutive == 0
+
+
+def test_single_request_window_still_violates():
+    """A 1-request window must report that request's latency bucket, not
+    collapse to the histogram's global lower bound — a slow trickle of
+    traffic can still violate the latency SLO."""
+    mon = ModelSLO("m", p99_ms=50.0, window_sec=10.0, degrade_evals=1)
+    b = _FakeBatcher()
+    b.record([0.5])                           # one 500ms request
+    s = mon.observe(b, now=0.0)
+    assert s["n"] == 1
+    assert s["p99_ms"] > 300.0                # its own bucket, not 0.001ms
+    assert s["violation"] and s["sustained"]
+
+
+def test_rolling_window_prunes_old_samples():
+    mon = ModelSLO("m", p99_ms=50.0, window_sec=10.0)
+    b = _FakeBatcher()
+    b.record([0.2] * 10)
+    mon.observe(b, now=0.0)
+    b.record([0.001] * 10)
+    mon.observe(b, now=6.0)
+    b.record([0.001] * 10)
+    # now=20: every sample containing the slow burst aged out of the
+    # window; only fast traffic remains -> no violation
+    s = mon.observe(b, now=20.0)
+    assert s["n"] == 10
+    assert s["p99_ms"] < 50.0
+    assert not s["violation"]
+
+
+def test_error_and_shed_rates():
+    mon = ModelSLO("m", error_pct=10.0, window_sec=60.0, degrade_evals=1)
+    b = _FakeBatcher()
+    b.record([0.001] * 80, failed=20, shed=25)
+    s = mon.observe(b, now=0.0)
+    assert s["error_pct"] == pytest.approx(25.0)     # 20 of 80
+    assert s["shed_pct"] == pytest.approx(100 * 25 / 105, abs=0.01)
+    assert s["violation"] and s["sustained"]
+
+
+def test_sustained_violation_feeds_breaker_soft_degrade():
+    board = SLOBoard(JobConfig({"serve.slo.p99.ms": "5",
+                                "serve.slo.degrade.evals": "2"}))
+    b = _FakeBatcher()
+    b.record([0.1] * 50)
+    s1 = board.observe("m", b, now=0.0)
+    assert s1["violation"] and not s1["sustained"]
+    assert not b.breaker.soft_degraded
+    # a violating re-evaluation INSIDE the streak-spacing gate (3s at
+    # the 30s default window) must not advance the streak — a fast
+    # health poller cannot accelerate soft-degrade
+    s1b = board.observe("m", b, now=1.0)
+    assert s1b["violation"] and not s1b["sustained"]
+    assert not b.breaker.soft_degraded
+    b.record([0.1] * 50)
+    s2 = board.observe("m", b, now=5.0)
+    assert s2["sustained"]
+    assert b.breaker.soft_degraded
+    assert b.breaker.degraded()
+    assert b.breaker.state_dict()["slo_degraded"]
+    assert "p99" in b.breaker.state_dict()["slo_reason"]
+    # hard state remains closed: requests keep flowing
+    assert b.breaker.state == "closed"
+    assert b.breaker.state_code() == 0
+    # recovery: once the slow traffic ages out of the window a clean
+    # evaluation clears the signal
+    s3 = board.observe("m", b, now=100.0)
+    assert not s3["violation"]
+    assert not b.breaker.soft_degraded
+
+
+def test_reload_resets_window():
+    mon = ModelSLO("m", p99_ms=5.0, window_sec=60.0, degrade_evals=1)
+    b = _FakeBatcher()
+    b.record([0.1] * 20)
+    assert mon.observe(b, now=0.0)["sustained"]
+    # hot swap: fresh histogram/counters (cumulative state regresses)
+    b2 = _FakeBatcher()
+    b2.record([0.001] * 5)
+    s = mon.observe(b2, now=1.0)
+    assert s["n"] == 5 and not s["violation"]
+    assert mon.consecutive == 0
+
+
+def test_reload_resets_window_even_when_replacement_overtakes():
+    """A busy replacement batcher can exceed the old one's cumulative
+    counts within one window — the reset must key on the histogram's
+    IDENTITY, not on counts regressing, or the diff mixes two
+    histograms and fabricates a garbage windowed p99."""
+    mon = ModelSLO("m", p99_ms=50.0, window_sec=60.0, degrade_evals=1)
+    b = _FakeBatcher()
+    b.record([0.2] * 10)                      # slow pre-reload traffic
+    mon.observe(b, now=0.0)
+    b2 = _FakeBatcher()
+    b2.record([0.001] * 100)                  # overtakes b's n=10 fast
+    s = mon.observe(b2, now=1.0)
+    assert s["n"] == 100
+    assert s["p99_ms"] < 50.0                 # only b2's own (fast) window
+    assert not s["violation"]
+
+
+def test_per_model_target_override():
+    board = SLOBoard(JobConfig({"serve.slo.p99.ms": "100",
+                                "serve.model.fast.slo.p99.ms": "1"}))
+    assert board.monitor("fast").p99_ms == 1.0
+    assert board.monitor("other").p99_ms == 100.0
+
+
+# ---------------------------------------------------------------------------
+# live serve: deterministic violation via fault-injected slow scorer
+# ---------------------------------------------------------------------------
+
+def test_slow_scorer_flips_slo_and_health(nb_artifact):
+    cfg = _serve_config(
+        nb_artifact, **{
+            "serve.slo.p99.ms": "5",
+            # window 5s -> streak spacing 0.5s: the two health probes
+            # below straddle the gate with a short real-clock sleep
+            "serve.slo.window.sec": "5",
+            "serve.slo.degrade.evals": "2",
+            "fault.inject.plan": "scorer_slow@*:40"})
+    faultinject.configure_from_config(cfg)
+    srv = PredictionServer(cfg)
+    try:
+        port = srv.start()
+        line = nb_artifact["lines"][0]
+        for _ in range(6):
+            r = request("127.0.0.1", port, {"model": "churn", "row": line})
+            assert "output" in r, r
+        h1 = request("127.0.0.1", port, {"cmd": "health"})
+        slo = h1["slo"]["churn"]
+        assert slo["n"] >= 6
+        assert slo["p99_ms"] > 5.0
+        assert slo["violation"] is True
+        assert slo["target_p99_ms"] == 5.0
+        assert h1["ok"] is True               # not sustained yet
+        time.sleep(0.6)                       # past the streak gate
+        h2 = request("127.0.0.1", port, {"cmd": "health"})
+        assert h2["slo"]["churn"]["sustained"] is True
+        assert h2["ok"] is False
+        assert h2["degraded"] == ["churn"]
+        assert h2["models"][0]["slo_degraded"] is True
+        # still soft: the hard breaker stays closed, requests still score
+        assert h2["models"][0]["breaker"] == "closed"
+        r = request("127.0.0.1", port, {"model": "churn", "row": line})
+        assert "output" in r
+        # the exposition carries the flipped gauge
+        txt = request_text("127.0.0.1", port, {"cmd": "metrics"})
+        assert 'avenir_serve_slo_violation{model="churn"} 1' in txt
+        assert 'avenir_serve_slo_sustained{model="churn"} 1' in txt
+        assert 'avenir_serve_breaker_soft_degraded{model="churn"} 1' in txt
+    finally:
+        srv.stop()
+        faultinject.set_injector(None)
+
+
+def test_fast_scorer_keeps_slo_clean(nb_artifact):
+    """Same SLO config, no fault: the gauge stays 0 and health stays ok
+    (the violation above is the scorer's doing, not the monitor's)."""
+    cfg = _serve_config(nb_artifact, **{"serve.slo.p99.ms": "5000",
+                                        "serve.slo.window.sec": "60"})
+    srv = PredictionServer(cfg)
+    try:
+        port = srv.start()
+        line = nb_artifact["lines"][1]
+        for _ in range(4):
+            request("127.0.0.1", port, {"model": "churn", "row": line})
+        h = request("127.0.0.1", port, {"cmd": "health"})
+        assert h["ok"] is True
+        assert h["slo"]["churn"]["violation"] is False
+        txt = request_text("127.0.0.1", port, {"cmd": "metrics"})
+        assert 'avenir_serve_slo_violation{model="churn"} 0' in txt
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# the metrics command: acceptance-grade exposition over live TCP
+# ---------------------------------------------------------------------------
+
+def test_metrics_command_full_exposition(nb_artifact):
+    from tests.test_telemetry import _parse_exposition
+
+    cfg = _serve_config(nb_artifact, **{"serve.slo.p99.ms": "5000"})
+    srv = PredictionServer(cfg)
+    try:
+        port = srv.start()
+        for line in nb_artifact["lines"][:16]:
+            r = request("127.0.0.1", port, {"model": "churn", "row": line})
+            assert "output" in r
+        txt = request_text("127.0.0.1", port, {"cmd": "metrics"})
+        types, samples = _parse_exposition(txt)
+        by_name = {}
+        for name, labels, value in samples:
+            by_name.setdefault(name, []).append((labels, value))
+        # per-model latency histogram buckets
+        fam = "avenir_serve_e2e_latency_seconds"
+        assert types[fam] == "histogram"
+        buckets = by_name[fam + "_bucket"]
+        assert all(lb["model"] == "churn" for lb, _ in buckets)
+        assert buckets[-1][0]["le"] == "+Inf"
+        assert buckets[-1][1] >= 16
+        (_, count), = by_name[fam + "_count"]
+        assert count == buckets[-1][1]
+        # SLO gauges + breaker state + worker liveness
+        assert by_name["avenir_serve_slo_violation"] == \
+            [({"model": "churn"}, 0.0)]
+        assert by_name["avenir_serve_breaker_state"] == \
+            [({"model": "churn"}, 0.0)]
+        assert by_name["avenir_serve_worker_alive"] == \
+            [({"model": "churn"}, 1.0)]
+        # scorer warmup compiles landed in the cumulative compile counter
+        compile_ms = [v for lb, v in by_name["avenir_counter_total"]
+                      if lb == {"group": "Telemetry",
+                                "name": "xla.compile.ms"}]
+        assert compile_ms and compile_ms[0] > 0
+        # per-model serve counters
+        assert ({"group": "Serve.churn", "name": "Requests"}, 16.0) \
+            in by_name["avenir_counter_total"]
+        # a JSON request on the SAME connection protocol still works
+        # after a text response (framing intact)
+        h = request("127.0.0.1", port, {"cmd": "health"})
+        assert h["ok"] is True
+    finally:
+        srv.stop()
+
+
+def test_serve_telemetry_jsonl_series(nb_artifact, tmp_path):
+    """telemetry.jsonl.path + a short interval: the serve process writes
+    mergeable snapshots with the per-model overlay sections."""
+    path = tmp_path / "serve_series.jsonl"
+    cfg = _serve_config(nb_artifact, **{
+        "telemetry.interval.sec": "0.05",
+        "telemetry.jsonl.path": str(path)})
+    srv = PredictionServer(cfg)
+    try:
+        port = srv.start()
+        for line in nb_artifact["lines"][:8]:
+            request("127.0.0.1", port, {"model": "churn", "row": line})
+        time.sleep(0.15)
+    finally:
+        srv.stop()
+    lines = [json.loads(l) for l in open(path)]
+    assert lines
+    last = lines[-1]
+    assert last["hists"]['serve.e2e.latency{model="churn"}']["n"] >= 8
+    assert 'serve.breaker.state{model="churn"}' in last["gauges"]
+    assert last["counters"]["Serve.churn"]["Requests"] >= 8
+
+
+# ---------------------------------------------------------------------------
+# shutdown hygiene
+# ---------------------------------------------------------------------------
+
+def test_no_leaked_telemetry_threads_after_serve_exit(nb_artifact,
+                                                      tmp_path):
+    """Hammer: serve sessions with an aggressive telemetry interval are
+    started and stopped repeatedly; afterwards no telemetry/trace-flush
+    thread survives (the exporter stop is part of server.stop())."""
+    def tele_threads():
+        return [t.name for t in threading.enumerate()
+                if t.name.startswith(telemetry.THREAD_PREFIXES)]
+
+    for i in range(3):
+        cfg = _serve_config(nb_artifact, **{
+            "telemetry.interval.sec": "0.01",
+            "telemetry.jsonl.path": str(tmp_path / f"s{i}.jsonl"),
+            "serve.warmup": "false"})
+        srv = PredictionServer(cfg)
+        srv.start()
+        request("127.0.0.1", srv.port, {"cmd": "health"})
+        assert tele_threads() == ["avenir-telemetry"]
+        srv.stop()
+        assert tele_threads() == []
