@@ -23,9 +23,15 @@ from .core.metrics import Counters
 
 def _lazy(modname: str, clsname: str) -> Callable[[JobConfig], object]:
     def factory(config: JobConfig):
+        return job_class()(config)
+
+    def job_class():
         import importlib
         mod = importlib.import_module(f"avenir_tpu.models.{modname}")
-        return getattr(mod, clsname)(config)
+        return getattr(mod, clsname)
+    # the class WITHOUT constructing a driver — core.dag probes it for
+    # shared-scan fusability (fold_spec) before deciding how to schedule
+    factory.job_class = job_class
     return factory
 
 
@@ -227,12 +233,57 @@ def multi_main(argv) -> int:
     return 0
 
 
+def dag_main(argv) -> int:
+    """``python -m avenir_tpu dag -Dconf.path=<workflow.properties> <in>
+    [<out base>] [--resume]``: run the ``workflow.*`` stage DAG
+    (core.dag) — topologically ordered stages, cost-decided shared scans
+    for same-input groups, in-memory artifact handoff, and
+    stage-granularity checkpoint/resume."""
+    argv, trace_path = extract_trace_flag(argv)
+    argv, metrics_out = extract_metrics_out_flag(argv)
+    argv, resume = extract_resume_flag(argv)
+    defines, positional = parse_cli_args(argv)
+    if not positional:
+        print("expected <input path> [<output base dir>]", file=sys.stderr)
+        return 2
+    in_path = positional[0]
+    out_base = positional[1] if len(positional) > 1 else None
+
+    _init_runtime()
+    config = load_job_config(defines, "")
+    if resume:
+        config.set("checkpoint.resume", "true")
+    from .core import obs, telemetry
+    from .core.dag import run_workflow
+    obs.configure_from_config(config, force_enable=bool(trace_path))
+    configure_resilience(config)
+    telemetry.configure_from_config(config)
+    exporter = telemetry.exporter_for_job(config, metrics_out)
+    flusher = telemetry.flusher_for_job(config, trace_path)
+    try:
+        results = run_workflow(config, in_path, out_base, _job_resolver,
+                               log=lambda m: print(m, file=sys.stderr))
+    finally:
+        if flusher is not None:
+            flusher.stop()
+        if exporter is not None:
+            exporter.stop()
+        _export_trace(trace_path)
+    for sid, counters in results.items():
+        print(f"--- stage {sid}", file=sys.stderr)
+        if isinstance(counters, Counters):
+            print(counters.format(), file=sys.stderr)
+    return 0
+
+
 def main(argv=None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
     if not argv:
         print("usage: python -m avenir_tpu <JobClass> -Dconf.path=<props> <in> <out>",
               file=sys.stderr)
         print("       python -m avenir_tpu multi -Dconf.path=<manifest.properties> <in> [<out base>]",
+              file=sys.stderr)
+        print("       python -m avenir_tpu dag -Dconf.path=<workflow.properties> <in> [<out base>]",
               file=sys.stderr)
         print("       python -m avenir_tpu serve -Dconf.path=<serve.properties>",
               file=sys.stderr)
@@ -244,6 +295,10 @@ def main(argv=None) -> int:
         # shared-scan job fusion (core.multiscan): one streamed ingest
         # pass feeding every job named by the multi.* manifest
         return multi_main(rest)
+    if job_name == "dag":
+        # cost-based workflow DAG (core.dag): stage scheduling over
+        # shared scans with artifact handoff and stage checkpoints
+        return dag_main(rest)
     if job_name == "serve":
         # online prediction service (model registry + micro-batching
         # frontend) — net-new surface, no reference driver class

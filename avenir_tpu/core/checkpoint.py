@@ -211,3 +211,128 @@ class StreamCheckpointer:
             os.unlink(self.path)
         except FileNotFoundError:
             pass
+
+
+# ---------------------------------------------------------------------------
+# stage-granularity checkpointing (the core.dag workflow sidecar)
+# ---------------------------------------------------------------------------
+
+WF_CKPT_VERSION = 1
+
+
+class WorkflowCheckpointer:
+    """Stage-completion sidecar for a core.dag workflow run.
+
+    After every completed stage the workflow records the stage's params
+    key (a hash of its resolved config + class + paths), a fingerprint
+    of EVERY input artifact it consumed — the declared input plus each
+    ``@<stage>``-referenced dependency artifact — and its OUTPUT
+    fingerprint, then atomically rewrites the sidecar.  A ``--resume``
+    run skips a stage only when all three still validate — the stage's
+    config is unchanged, every input file (including a dependency
+    artifact an upstream stage may have REWRITTEN on this resume) is
+    the one it consumed, and its outputs are still on disk intact —
+    otherwise the stage re-runs (and its own mid-scan
+    :class:`StreamCheckpointer` sidecar, if one survived the kill,
+    restarts it mid-file).  A successful workflow deletes the sidecar.
+    """
+
+    def __init__(self, path: str, in_path: str, resume: bool = False):
+        self.path = path
+        self.in_path = in_path
+        self.resume = bool(resume)
+        self._stages: Dict[str, Dict[str, Any]] = {}
+        if resume and os.path.exists(path):
+            with open(path, "rb") as fh:
+                payload = pickle.load(fh)
+            if payload.get("version") != WF_CKPT_VERSION:
+                raise CheckpointMismatch(
+                    f"workflow checkpoint {path}: version "
+                    f"{payload.get('version')} != {WF_CKPT_VERSION}")
+            if payload.get("fingerprint") != input_fingerprint(in_path):
+                raise CheckpointMismatch(
+                    f"workflow checkpoint {path} was written against a "
+                    f"different input than {in_path!r} — re-run without "
+                    f"--resume")
+            self._stages = payload["stages"]
+
+    @staticmethod
+    def params_key(obj: Any) -> str:
+        import json
+        return hashlib.sha1(
+            json.dumps(obj, sort_keys=True, default=str).encode()
+        ).hexdigest()
+
+    def _fingerprint_ok(self, path: str, recorded) -> bool:
+        try:
+            return input_fingerprint(path) == recorded
+        except OSError:
+            return False
+
+    def stage_done(self, sid: str, params_key: str,
+                   in_paths: Dict[str, str],
+                   out_paths: Dict[str, str]) -> bool:
+        """True when ``sid`` completed under the SAME params and EVERY
+        recorded input/output still matches its on-disk fingerprint —
+        the resume-time skip test.  ``in_paths`` carries the declared
+        input plus every dependency artifact path (an upstream stage
+        that re-ran and rewrote its artifact at the same path changes
+        that fingerprint, so this consumer re-runs too).  Outputs that
+        were memory-only (no file sink) record an empty fingerprint and
+        validate trivially; a memory-only INPUT never validates — the
+        artifact died with the killed process."""
+        rec = self._stages.get(sid)
+        if rec is None or rec["params"] != params_key:
+            return False
+        for label, p in in_paths.items():
+            want = rec["inputs"].get(label)
+            if want is None or want == {}:
+                return False
+            if not self._fingerprint_ok(p, want):
+                return False
+        for label, p in out_paths.items():
+            want = rec["outputs"].get(label)
+            if want is None:
+                return False
+            if want != {} and not self._fingerprint_ok(p, want):
+                return False
+        return True
+
+    def record(self, sid: str, params_key: str, in_paths: Dict[str, str],
+               out_paths: Dict[str, str]) -> None:
+        """Record ``sid`` complete and atomically rewrite the sidecar."""
+        outputs = {}
+        for label, p in out_paths.items():
+            outputs[label] = (input_fingerprint(p)
+                              if os.path.exists(p) else {})
+        self._stages[sid] = {
+            "params": params_key,
+            # {} when an input was a memory-only artifact: such a
+            # stage can never be skipped on resume (see stage_done)
+            "inputs": {label: (input_fingerprint(p)
+                               if os.path.exists(p) else {})
+                       for label, p in in_paths.items()},
+            "outputs": outputs,
+        }
+        payload = {"version": WF_CKPT_VERSION,
+                   "fingerprint": input_fingerprint(self.in_path),
+                   "stages": self._stages}
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(prefix=".wfckpt-", dir=d)
+        try:
+            with os.fdopen(fd, "wb") as fh:
+                pickle.dump(payload, fh, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, self.path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def complete(self) -> None:
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass

@@ -1,0 +1,789 @@
+"""Cost-based workflow DAG engine: stage scheduling over shared scans,
+in-memory artifact handoff, and stage-granularity checkpoint/resume.
+
+Avenir's real user surface is multi-stage workflows — the reference
+``resource/*.sh`` runbooks chain bin -> train -> feature-select ->
+retrain -> validate by hand, round-tripping every intermediate through
+text files, exactly the shape MapReduce workflows inherited (Dean &
+Ghemawat, OSDI 2004, PAPERS.md).  PR 4's ``multi`` manifest fused
+same-input jobs into one scan but knew nothing about ORDER; this module
+generalizes it into a DAG scheduler (ROADMAP item 5):
+
+- **Manifest** (``workflow.*`` keys, :func:`load_workflow`): a DAG of
+  stages, each an existing job driver (or one of the built-in stage
+  classes below) with a declared input — the workflow input
+  (``$input``), another stage's output (the stage id), or an external
+  path (``path:<p>``) — plus ``@<stage>`` artifact references inside
+  stage config values (e.g. ``bayesian.model.file.path=@retrain``).
+  Unknown stage names, dependency cycles, undeclared artifact
+  references, and duplicate output paths all fail fast with an error
+  naming the offending key (:class:`WorkflowConfigError`).
+
+- **Cost-based fusion** (:func:`fusion_decision`): at each scheduling
+  wave, ready stages sharing one input and exporting a
+  ``core.multiscan.FoldSpec`` are grouped into ONE shared scan when the
+  MRShare-style model says fusion wins — estimated scan seconds
+  (``workflow.cost.scan.mb.per.sec``) vs summed per-stage fold seconds.
+  Fold estimates come from REAL per-spec timings when available (the
+  PR-3 ``multiscan.fold`` spans recorded earlier in this process), else
+  the per-stage ``workflow.stage.<id>.cost.fold.sec`` override, else
+  ``workflow.cost.fold.sec.default``.  The model:
+
+      separate = sum_i max(scan_sec, fold_i)      # folds overlap their
+      fused    = max(scan_sec, sum_i fold_i)      # own scan; one scan
+                 + n * workflow.cost.fuse.overhead.sec   # serializes them
+
+  so a scan-dominated workflow fuses (one read amortizes N jobs) while
+  a tiny-scan/heavy-fold workflow runs its stages separately (the
+  shared-chunk coordination would cost more than the saved read).
+  ``workflow.fuse=always|never`` overrides for operators.
+
+- **In-memory artifact handoff** (``core.io.ArtifactStore``): every
+  stage output path is registered in a process overlay; a stage's
+  ``write_output`` ALSO records the lines in memory and downstream
+  ``read_lines``/model loads consume them without re-reading disk —
+  the text file becomes a sink, not the transport.  The first memory
+  read of each artifact is asserted byte-identical to the file
+  round-trip (``workflow.handoff.verify``); ``sink.file=false`` skips
+  the disk write entirely for intermediates nobody keeps.
+
+- **Stage checkpointing** (``core.checkpoint.WorkflowCheckpointer``):
+  after every completed stage the workflow records (params hash, input
+  fingerprint, output fingerprints) in a sidecar; ``--resume`` skips
+  stages whose record still validates and restarts the failed stage —
+  MID-SCAN when the stage's own ``checkpoint.interval.chunks`` sidecar
+  survived the kill (the PR-5 StreamCheckpointer, both standalone and
+  fused-scan).  Fault injection (``core.faultinject``) makes every
+  stage-failure/resume path a deterministic test.
+
+Built-in stage classes (resolvable only inside a workflow manifest):
+
+- :class:`FeatureSelect` — consumes a MutualInformation output artifact
+  and emits a rewritten feature-schema JSON keeping the
+  ``select.top.features`` best-ranked features (the rest are demoted to
+  non-features; the class attribute is pinned explicitly) — the bridge
+  between the MI ranking and a retrain-on-selected-features stage.
+- :class:`RegistryPublish` — loads the input model artifact into a
+  ``serve.registry.ModelRegistry`` entry (the TF-Serving-style publish:
+  a complete adapter is built before anything is swapped in) and emits
+  the exact bytes the registry serves.
+
+CLI: ``python -m avenir_tpu dag -Dconf.path=<workflow.properties>
+<in> [<out base>] [--resume]`` (see resource/workflow/ for the
+canonical bin -> train{NB+MI+correlation} -> feature-select -> retrain
+-> validate -> publish runbook).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .config import JobConfig, parse_properties
+from .io import ArtifactStore, read_lines, set_artifact_store, write_output
+from .metrics import Counters
+from .obs import get_tracer, traced_run
+from . import telemetry
+
+# -- config surface (tier-2 lint: tests/test_dag_coverage.py) --------------
+KEY_STAGES = "workflow.stages"
+KEY_FUSE = "workflow.fuse"
+KEY_COST_SCAN_MBPS = "workflow.cost.scan.mb.per.sec"
+KEY_COST_FOLD_DEFAULT = "workflow.cost.fold.sec.default"
+KEY_COST_FUSE_OVERHEAD = "workflow.cost.fuse.overhead.sec"
+KEY_CKPT_PATH = "workflow.checkpoint.path"
+KEY_HANDOFF_VERIFY = "workflow.handoff.verify"
+
+DEFAULT_SCAN_MBPS = 200.0
+DEFAULT_FOLD_SEC = 0.02
+DEFAULT_FUSE_OVERHEAD_SEC = 0.005
+
+#: per-stage keys consumed by the manifest itself (everything else under
+#: ``workflow.stage.<id>.`` overlays the stage's job config)
+STAGE_RESERVED = ("class", "conf.path", "output.path", "input",
+                  "sink.file", "cost.fold.sec")
+
+#: the workflow input sentinel and the external-path input prefix
+INPUT_SENTINEL = "$input"
+PATH_PREFIX = "path:"
+
+
+class WorkflowConfigError(ValueError):
+    """A ``workflow.*`` manifest error — always names the offending
+    key/stage so the operator can fix the properties file directly."""
+
+
+class Stage:
+    """One declared stage: id, driver class, resolved config props,
+    input reference, output path, and the dependency edges derived from
+    its input + ``@<stage>`` artifact references."""
+
+    __slots__ = ("sid", "cls_name", "props", "input_ref", "out_path",
+                 "sink_file", "cost_fold_sec", "deps", "ref_deps")
+
+    def __init__(self, sid: str, cls_name: str, props: Dict[str, str],
+                 input_ref: str, out_path: str, sink_file: bool,
+                 cost_fold_sec: Optional[float], deps: List[str],
+                 ref_deps: Optional[List[str]] = None):
+        self.sid = sid
+        self.cls_name = cls_name
+        self.props = props
+        self.input_ref = input_ref
+        self.out_path = out_path
+        self.sink_file = sink_file
+        self.cost_fold_sec = cost_fold_sec
+        self.deps = deps
+        #: the subset of deps referenced via ``@<stage>`` config values —
+        #: those artifacts are consumed through read_lines-style loads
+        #: (schema/model parses), i.e. through the in-memory overlay
+        self.ref_deps = ref_deps if ref_deps is not None else []
+
+    #: config families that never change a stage's OUTPUT bytes —
+    #: excluded from the checkpoint identity hash so e.g. the --resume
+    #: flag itself (checkpoint.resume=true) or a fault plan cannot
+    #: invalidate every completed stage's record
+    _VOLATILE_PREFIXES = ("checkpoint.", "fault.", "retry.", "obs.",
+                          "telemetry.")
+
+    def params_obj(self) -> dict:
+        """The identity the stage checkpoint hashes: a changed class,
+        config, input wiring, or output path invalidates the record."""
+        props = {k: v for k, v in self.props.items()
+                 if not k.startswith(self._VOLATILE_PREFIXES)}
+        return {"class": self.cls_name, "props": props,
+                "input": self.input_ref, "out": self.out_path}
+
+
+# ---------------------------------------------------------------------------
+# built-in stage classes (workflow-only drivers)
+# ---------------------------------------------------------------------------
+
+class FeatureSelect:
+    """Feature-selection stage: MI ranking artifact -> rewritten schema.
+
+    Input: a ``MutualInformation`` output (file or in-memory artifact).
+    Config: ``select.schema.file.path`` (the base schema to rewrite),
+    ``select.top.features`` (how many best-ranked features to keep),
+    ``select.algorithm`` (optional ``mutualInformationScoreAlgorithm``
+    section; default: the artifact's first).  Output: the base schema
+    JSON with non-selected features demoted (``feature: false``) and the
+    class attribute pinned (``classAttr: true``) so demotion cannot
+    change which field the implicit class-attribute rule picks — a
+    schema any downstream trainer/predictor loads unchanged.
+    """
+
+    def __init__(self, config: JobConfig):
+        self.config = config
+
+    @traced_run
+    def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
+        import json
+
+        from ..models.mutual_info import MutualInformation
+        from .schema import FeatureSchema
+
+        cfg = self.config
+        counters = Counters()
+        k = cfg.must_int("select.top.features")
+        if k < 1:
+            raise WorkflowConfigError(
+                f"select.top.features must be >= 1: {k}")
+        schema_path = cfg.must("select.schema.file.path")
+        scores = MutualInformation.parse_scores(
+            read_lines(in_path), algorithm=cfg.get("select.algorithm"),
+            delim=cfg.field_delim_out())
+        ranked = sorted(scores, key=lambda s: (-s[1], s[0]))
+        doc = json.loads("\n".join(read_lines(schema_path)))
+        fields = doc.get("fields", [])
+        feature_ords = {f["ordinal"] for f in fields if f.get("feature")}
+        unknown = [o for o, _ in ranked if o not in feature_ords]
+        if unknown:
+            raise WorkflowConfigError(
+                f"FeatureSelect: MI ranking names ordinals {unknown} that "
+                f"are not feature fields of {schema_path}")
+        if k > len(ranked):
+            raise WorkflowConfigError(
+                f"select.top.features={k} but the MI artifact ranks only "
+                f"{len(ranked)} features")
+        keep = {o for o, _ in ranked[:k]}
+        # the implicit class-attribute rule is "neither feature nor id":
+        # demoting features would add candidates, so pin the REAL class
+        # field explicitly before any demotion
+        class_ord = FeatureSchema.from_json(
+            json.dumps(doc)).class_attr_field().ordinal
+        for f in fields:
+            if f["ordinal"] == class_ord:
+                f["classAttr"] = True
+            elif f.get("feature") and f["ordinal"] not in keep:
+                f["feature"] = False
+                counters.incr("Select", "Features dropped")
+            elif f.get("feature"):
+                counters.incr("Select", "Features kept")
+        write_output(out_path, json.dumps(doc, indent=1).split("\n"),
+                     as_dir=False)
+        return counters
+
+
+class RegistryPublish:
+    """Terminal publish stage: input model artifact -> serving registry.
+
+    Builds a complete ``serve.registry.ModelRegistry`` entry from the
+    stage config (``publish.model.name``, ``publish.kind``, optional
+    ``publish.version``/``publish.warmup``; every other stage key passes
+    through as the model's scoring config, with
+    ``bayesian.model.file.path`` defaulting to the stage input) — the
+    TF-Serving-style atomic publish: the adapter is fully constructed
+    (model lines parsed, tables built) before the entry is visible, and
+    a live ``serve`` process pointed at the same artifact picks the
+    version up with its ``reload`` command.  The stage output is the
+    exact model bytes the registry serves (byte-identical to the
+    training stage's artifact — asserted by the workflow tests).
+    """
+
+    #: keys the publish stage consumes itself (not model config)
+    _RESERVED_PREFIXES = ("publish.", "pipeline.", "checkpoint.",
+                          "workflow.", "fault.", "retry.", "obs.",
+                          "telemetry.")
+
+    def __init__(self, config: JobConfig):
+        self.config = config
+
+    @traced_run
+    def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
+        from ..serve.registry import ModelRegistry
+
+        cfg = self.config
+        counters = Counters()
+        name = cfg.must("publish.model.name")
+        props = {"serve.models": name,
+                 f"serve.model.{name}.kind": cfg.get("publish.kind",
+                                                     "naiveBayes"),
+                 f"serve.model.{name}.version": cfg.get("publish.version",
+                                                        "1")}
+        for k, v in cfg.props.items():
+            if not k.startswith(self._RESERVED_PREFIXES):
+                props.setdefault(f"serve.model.{name}.{k}", v)
+        props.setdefault(f"serve.model.{name}.bayesian.model.file.path",
+                         in_path)
+        registry = ModelRegistry(JobConfig(props), mesh=mesh)
+        entry = registry.load(name,
+                              warmup=cfg.get_boolean("publish.warmup",
+                                                     False))
+        # the published artifact: the exact lines the adapter was built
+        # from (served-model parity is byte-level, not approximate)
+        write_output(out_path, list(read_lines(in_path)))
+        counters.incr("Registry", "Published versions")
+        counters.set("Registry", "Warmup buckets",
+                     entry.counters.get("Serve", "Warmup buckets"))
+        return counters
+
+
+#: built-in workflow-only stage classes (checked before the CLI registry)
+BUILTIN_STAGES: Dict[str, type] = {
+    "FeatureSelect": FeatureSelect,
+    "RegistryPublish": RegistryPublish,
+}
+
+#: drivers exporting a multiscan FoldSpec that are deliberately NOT
+#: usable as DAG stages — the tier-2 lint (tests/test_dag_coverage.py)
+#: requires every other FoldSpec exporter to be DAG-registrable (in the
+#: CLI registry with the standard run(in, out, mesh) driver surface)
+NON_DAG_STAGES: Dict[str, str] = {}
+
+
+# ---------------------------------------------------------------------------
+# manifest loading + validation
+# ---------------------------------------------------------------------------
+
+def _stage_ids(config: JobConfig) -> List[str]:
+    ids = [s.strip() for s in config.must(KEY_STAGES).split(",")
+           if s.strip()]
+    if not ids:
+        raise WorkflowConfigError(f"{KEY_STAGES} is empty")
+    if len(set(ids)) != len(ids):
+        raise WorkflowConfigError(
+            f"duplicate stage ids in {KEY_STAGES}: {ids}")
+    for sid in ids:
+        if not sid.replace("_", "").replace("-", "").isalnum():
+            raise WorkflowConfigError(
+                f"bad stage id {sid!r} in {KEY_STAGES} (use letters, "
+                f"digits, '-', '_')")
+    return ids
+
+
+def _check_orphan_stage_keys(config: JobConfig, ids: Sequence[str]) -> None:
+    """Every ``workflow.stage.<id>.*`` key must name a declared stage —
+    a typo'd id silently configuring nothing is the classic manifest
+    footgun."""
+    known = set(ids)
+    for key in config.props:
+        if not key.startswith("workflow.stage."):
+            continue
+        rest = key[len("workflow.stage."):]
+        sid = rest.split(".", 1)[0]
+        if sid not in known:
+            raise WorkflowConfigError(
+                f"{key}: stage {sid!r} is not declared in {KEY_STAGES} "
+                f"({', '.join(ids)})")
+
+
+def load_workflow(config: JobConfig, in_path: str,
+                  out_base: Optional[str]) -> List[Stage]:
+    """Parse + validate the ``workflow.*`` manifest into Stage objects
+    (declaration order preserved; dependency edges resolved).
+
+    Raises :class:`WorkflowConfigError` naming the offending key for:
+    unknown stage names (orphan ``workflow.stage.<id>.*`` keys, or an
+    ``input=``/``@`` reference to an undeclared stage), dependency
+    cycles, and duplicate output paths.
+    """
+    ids = _stage_ids(config)
+    _check_orphan_stage_keys(config, ids)
+    known = set(ids)
+    base_props = {k: v for k, v in config.props.items()
+                  if not k.startswith("workflow.")}
+
+    stages: List[Stage] = []
+    out_seen: Dict[str, str] = {}
+    for sid in ids:
+        skey = f"workflow.stage.{sid}"
+        try:
+            cls_name = config.must(f"{skey}.class")
+        except KeyError as exc:
+            raise WorkflowConfigError(str(exc)) from None
+        props = dict(base_props)
+        conf_path = config.get(f"{skey}.conf.path")
+        if conf_path:
+            with open(conf_path, "r") as fh:
+                props.update(parse_properties(fh.read()))
+        sub = config.subkeys(skey)
+        for k, v in sub.items():
+            if k not in STAGE_RESERVED:
+                props[k] = v
+
+        input_ref = sub.get("input", INPUT_SENTINEL)
+        deps: List[str] = []
+        ref_deps: List[str] = []
+        if input_ref == INPUT_SENTINEL or input_ref.startswith(PATH_PREFIX):
+            pass
+        elif input_ref in known:
+            deps.append(input_ref)
+        else:
+            raise WorkflowConfigError(
+                f"{skey}.input={input_ref!r}: not {INPUT_SENTINEL!r}, not "
+                f"'{PATH_PREFIX}<path>', and not a declared stage id "
+                f"({', '.join(ids)})")
+
+        # @<stage> artifact references inside stage config values
+        for k, v in sorted(props.items()):
+            if not v.startswith("@"):
+                continue
+            ref = v[1:]
+            if ref not in known:
+                raise WorkflowConfigError(
+                    f"{skey}.{k}={v!r}: artifact reference to undeclared "
+                    f"stage {ref!r} (declared: {', '.join(ids)})")
+            if ref == sid:
+                raise WorkflowConfigError(
+                    f"{skey}.{k}={v!r}: a stage cannot reference its own "
+                    f"output")
+            if ref not in deps:
+                deps.append(ref)
+            if ref not in ref_deps:
+                ref_deps.append(ref)
+
+        out_path = sub.get("output.path")
+        if out_path is None:
+            if out_base is None:
+                raise WorkflowConfigError(
+                    f"stage {sid!r}: no {skey}.output.path and no <out> "
+                    f"CLI argument to derive it from")
+            out_path = os.path.join(out_base, sid)
+        ap = os.path.abspath(out_path)
+        if ap in out_seen:
+            raise WorkflowConfigError(
+                f"{skey}.output.path={out_path!r} duplicates stage "
+                f"{out_seen[ap]!r}'s output path")
+        out_seen[ap] = sid
+
+        sink_file = str(sub.get("sink.file", "true")).lower() != "false"
+        cost_fold = sub.get("cost.fold.sec")
+        stages.append(Stage(sid, cls_name, props, input_ref, out_path,
+                            sink_file,
+                            float(cost_fold) if cost_fold else None, deps,
+                            ref_deps))
+
+    _check_acyclic(stages)
+    # sink.file=false is only valid for artifacts consumed THROUGH the
+    # in-memory overlay (see overlay_consumed): a byte-chunk-scanning
+    # consumer (a regular driver's input=) reads the file directly, so
+    # skipping the write would hand it nothing
+    overlay = overlay_consumed(stages)
+    for s in stages:
+        if not s.sink_file and s.sid not in overlay:
+            raise WorkflowConfigError(
+                f"workflow.stage.{s.sid}.sink.file=false: stage "
+                f"{s.sid!r}'s output is not consumed through the "
+                f"in-memory overlay (only @{s.sid} config references and "
+                f"built-in-stage inputs are), so its consumers need the "
+                f"file on disk")
+    by_id = {s.sid: s for s in stages}
+    # resolve @refs to output paths now that every stage is validated
+    for s in stages:
+        for k, v in list(s.props.items()):
+            if v.startswith("@"):
+                s.props[k] = by_id[v[1:]].out_path
+    return stages
+
+
+def overlay_consumed(stages: Sequence[Stage]) -> set:
+    """Stage ids whose output some downstream stage consumes THROUGH the
+    in-memory artifact overlay — ``@<stage>`` config references (loaded
+    via read_lines-style schema/model parses) and built-in stage inputs
+    (FeatureSelect/RegistryPublish read their input with read_lines).
+    Regular drivers byte-scan their ``input=`` from disk, so registering
+    those outputs would only pin dataset-sized intermediates in host
+    memory for the workflow's lifetime with zero handoff benefit."""
+    known = {s.sid for s in stages}
+    out = {d for s in stages for d in s.ref_deps}
+    out |= {s.input_ref for s in stages
+            if s.cls_name in BUILTIN_STAGES and s.input_ref in known}
+    return out
+
+
+def _check_acyclic(stages: Sequence[Stage]) -> None:
+    """Kahn's algorithm; leftover stages form the cycle we report."""
+    indeg = {s.sid: len(s.deps) for s in stages}
+    children: Dict[str, List[str]] = {s.sid: [] for s in stages}
+    for s in stages:
+        for d in s.deps:
+            children[d].append(s.sid)
+    ready = [sid for sid, n in indeg.items() if n == 0]
+    done = 0
+    while ready:
+        sid = ready.pop()
+        done += 1
+        for c in children[sid]:
+            indeg[c] -= 1
+            if indeg[c] == 0:
+                ready.append(c)
+    if done != len(stages):
+        cyc = sorted(sid for sid, n in indeg.items() if n > 0)
+        raise WorkflowConfigError(
+            f"dependency cycle among workflow stages: {', '.join(cyc)} "
+            f"(check their workflow.stage.<id>.input/@ references)")
+
+
+# ---------------------------------------------------------------------------
+# the cost model
+# ---------------------------------------------------------------------------
+
+def _scan_bytes(path: str, store: Optional[ArtifactStore]) -> int:
+    """Bytes one scan of ``path`` reads: on-disk part sizes, or the
+    in-memory artifact's line bytes for a sink-less upstream output."""
+    from .io import _input_files
+
+    if store is not None:
+        lines = store.peek(path)
+        if lines is not None and not os.path.exists(path):
+            return sum(len(l) + 1 for l in lines)
+    try:
+        return sum(os.path.getsize(fp) for fp in _input_files(path))
+    except OSError:
+        return 0
+
+
+def measured_fold_sec(sid: str, cls_name: str, scan_bytes: int,
+                      chunk_rows: int, row_bytes: int) -> Optional[float]:
+    """Per-stage fold-time estimate from REAL span timings recorded
+    earlier in this process (the PR-3 obs substrate): mean
+    ``multiscan.fold`` span ms for this stage id or driver class,
+    scaled to the estimated chunk count of the scan at hand.  None when
+    no matching spans exist (tracer disabled or first encounter)."""
+    tracer = get_tracer()
+    spans = [s for s in tracer.spans("multiscan.fold")
+             if s.attrs.get("job") in (sid, cls_name)]
+    if not spans:
+        return None
+    mean_chunk_sec = (sum(s.dur_ns for s in spans) / len(spans)) / 1e9
+    est_rows = scan_bytes / max(row_bytes, 1)
+    est_chunks = max(est_rows / max(chunk_rows, 1), 1.0)
+    return mean_chunk_sec * est_chunks
+
+
+def fusion_decision(stages: Sequence[Stage], scan_bytes: int,
+                    config: JobConfig, row_bytes: int = 64) -> Tuple[bool, dict]:
+    """Fuse these same-input ready stages into one shared scan, or run
+    them separately?  Returns ``(fuse, detail)`` where detail carries
+    every estimate (for logs/tests).  See the module docstring for the
+    model; ``workflow.fuse=always|never`` short-circuits it."""
+    mode = (config.get(KEY_FUSE, "auto") or "auto").lower()
+    if mode not in ("auto", "always", "never"):
+        raise WorkflowConfigError(
+            f"{KEY_FUSE}={mode!r}: use auto, always, or never")
+    mbps = config.get_float(KEY_COST_SCAN_MBPS, DEFAULT_SCAN_MBPS)
+    fold_default = config.get_float(KEY_COST_FOLD_DEFAULT, DEFAULT_FOLD_SEC)
+    overhead = config.get_float(KEY_COST_FUSE_OVERHEAD,
+                                DEFAULT_FUSE_OVERHEAD_SEC)
+    scan_sec = scan_bytes / (mbps * 1e6) if mbps > 0 else 0.0
+    chunk_rows = config.pipeline_chunk_rows(default=1 << 16) or (1 << 16)
+
+    folds: Dict[str, float] = {}
+    sources: Dict[str, str] = {}
+    for s in stages:
+        measured = measured_fold_sec(s.sid, s.cls_name, scan_bytes,
+                                     chunk_rows, row_bytes)
+        if s.cost_fold_sec is not None:
+            folds[s.sid], sources[s.sid] = s.cost_fold_sec, "configured"
+        elif measured is not None:
+            folds[s.sid], sources[s.sid] = measured, "measured"
+        else:
+            folds[s.sid], sources[s.sid] = fold_default, "default"
+
+    separate_sec = sum(max(scan_sec, f) for f in folds.values())
+    fused_sec = (max(scan_sec, sum(folds.values()))
+                 + overhead * len(folds))
+    if mode == "always":
+        fuse = True
+    elif mode == "never":
+        fuse = False
+    else:
+        fuse = fused_sec < separate_sec
+    return fuse, {"mode": mode, "scan_bytes": scan_bytes,
+                  "scan_sec": scan_sec, "fold_sec": folds,
+                  "fold_source": sources, "separate_sec": separate_sec,
+                  "fused_sec": fused_sec, "fuse": fuse}
+
+
+# ---------------------------------------------------------------------------
+# execution
+# ---------------------------------------------------------------------------
+
+def _builtin_or_resolve(cls_name: str, resolver: Callable):
+    """(factory, prefix) for a stage class: workflow built-ins first,
+    then the CLI job registry."""
+    if cls_name in BUILTIN_STAGES:
+        return BUILTIN_STAGES[cls_name], ""
+    return resolver(cls_name)
+
+
+def _group_ckpt_path(out_base: Optional[str], in_path: str,
+                     sids: Sequence[str]) -> str:
+    """The fused group's mid-scan sidecar path.  Membership is part of
+    the NAME (not just the checkpoint params) so a resume that
+    re-groups differently — some members already recorded done — never
+    collides with a stale sidecar written by the old grouping."""
+    tag = "_dag_scan_" + "+".join(sorted(sids)) + ".ckpt"
+    return (os.path.join(out_base, tag) if out_base
+            else in_path + "." + tag)
+
+
+def run_workflow(config: JobConfig, in_path: str, out_base: Optional[str],
+                 resolver: Callable, mesh=None,
+                 log: Optional[Callable] = None) -> Dict[str, Counters]:
+    """Execute a ``workflow.*`` manifest: topologically ordered stages,
+    cost-decided shared scans for same-input ready groups, in-memory
+    artifact handoff between stages, and stage-granularity
+    checkpoint/resume.  Returns ``{stage id: Counters}``."""
+    from .checkpoint import KEY_RESUME, WorkflowCheckpointer
+
+    def say(msg: str) -> None:
+        if log is not None:
+            log(msg)
+
+    tracer = get_tracer()
+    metrics = telemetry.get_metrics()
+    stages = load_workflow(config, in_path, out_base)
+    by_id = {s.sid: s for s in stages}
+    resume = config.get_boolean(KEY_RESUME, False)
+    ck_path = config.get(KEY_CKPT_PATH,
+                         os.path.join(out_base, "_workflow.ckpt")
+                         if out_base else in_path + ".workflow.ckpt")
+    ck = WorkflowCheckpointer(ck_path, in_path, resume=resume)
+
+    store = ArtifactStore(
+        verify=config.get_boolean(KEY_HANDOFF_VERIFY, True))
+    for s in stages:
+        if s.sid in overlay_consumed(stages):
+            store.register(s.out_path, sink_file=s.sink_file)
+
+    def stage_in(s: Stage) -> str:
+        if s.input_ref == INPUT_SENTINEL:
+            return in_path
+        if s.input_ref.startswith(PATH_PREFIX):
+            return s.input_ref[len(PATH_PREFIX):]
+        return by_id[s.input_ref].out_path
+
+    def stage_inputs(s: Stage) -> Dict[str, str]:
+        """Every artifact path the stage consumes, for the checkpoint:
+        the declared input plus each @ref dependency's output — an
+        upstream re-run that rewrites a dependency artifact at the same
+        path must invalidate this stage's completion record."""
+        ins = {"$input": stage_in(s)}
+        for d in s.deps:
+            ins[d] = by_id[d].out_path
+        return ins
+
+    def record_done(s: Stage, t0: float) -> None:
+        ck.record(s.sid, WorkflowCheckpointer.params_key(s.params_obj()),
+                  stage_inputs(s), {"out": s.out_path})
+        metrics.counters.incr("Dag", "Stages completed")
+        metrics.histogram("dag.stage.sec").record(
+            max(_now() - t0, 0.0))
+
+    def _now() -> float:
+        import time
+        return time.monotonic()
+
+    results: Dict[str, Counters] = {}
+    done: set = set()
+    prev_store = set_artifact_store(store)
+    try:
+        with tracer.span("dag.run", stages=",".join(by_id)):
+            while len(done) < len(stages):
+                ready = [s for s in stages if s.sid not in done
+                         and all(d in done for d in s.deps)]
+                assert ready, "scheduler stalled (cycle missed?)"
+
+                # resume-time skip: completed stages whose params/input/
+                # output fingerprints still validate (memory-only
+                # outputs cannot be skipped — the artifact died with
+                # the killed process and downstream needs it re-made)
+                ran_any = False
+                for s in list(ready):
+                    if not (resume and s.sink_file):
+                        continue
+                    if ck.stage_done(
+                            s.sid,
+                            WorkflowCheckpointer.params_key(s.params_obj()),
+                            stage_inputs(s), {"out": s.out_path}):
+                        say(f"dag: skipping completed stage {s.sid!r} "
+                            f"(checkpoint validated)")
+                        metrics.counters.incr("Dag", "Stages skipped")
+                        results[s.sid] = Counters()
+                        done.add(s.sid)
+                        ready.remove(s)
+                        ran_any = True
+                if not ready:
+                    continue
+
+                # group fusable same-input ready stages.  The probe is
+                # class-level so no driver is constructed twice (the
+                # fused path's run_multi builds its own): a spec that
+                # still turns out None at runtime (e.g. NB text mode)
+                # is caught by run_multi, which re-runs that job
+                # standalone after the fused pass — outputs identical
+                # either way.
+                groups: Dict[str, List[Stage]] = {}
+                solos: List[Stage] = []
+                factories: Dict[str, tuple] = {}
+                for s in ready:
+                    factory, prefix = _builtin_or_resolve(s.cls_name,
+                                                          resolver)
+                    factories[s.sid] = (factory, prefix)
+                    cls = (factory.job_class()
+                           if hasattr(factory, "job_class") else factory)
+                    if callable(getattr(cls, "fold_spec", None)):
+                        groups.setdefault(
+                            os.path.abspath(stage_in(s)), []).append(s)
+                    else:
+                        solos.append(s)
+
+                units: List[Tuple[str, List[Stage]]] = []
+                for key, members in groups.items():
+                    if len(members) < 2:
+                        solos.extend(members)
+                        continue
+                    fuse, detail = fusion_decision(
+                        members, _scan_bytes(stage_in(members[0]), store),
+                        config)
+                    sids = ",".join(m.sid for m in members)
+                    say(f"dag: cost model ({detail['mode']}): stages "
+                        f"[{sids}] scan={detail['scan_sec']:.4f}s "
+                        f"separate={detail['separate_sec']:.4f}s "
+                        f"fused={detail['fused_sec']:.4f}s -> "
+                        f"{'FUSE into one shared scan' if fuse else 'run separately'}")
+                    if fuse:
+                        units.append(("fused", members))
+                    else:
+                        solos.extend(members)
+                for s in solos:
+                    units.append(("solo", [s]))
+
+                for mode, members in units:
+                    if mode == "fused":
+                        t0 = _now()
+                        _run_fused(members, config, stage_in(members[0]),
+                                   out_base, in_path, resolver, mesh, say,
+                                   results, resume)
+                        metrics.counters.incr("Dag", "Shared scans")
+                        for m in members:
+                            record_done(m, t0)
+                            done.add(m.sid)
+                    else:
+                        s = members[0]
+                        t0 = _now()
+                        factory, prefix = factories[s.sid]
+                        job = factory(JobConfig(s.props, prefix))
+                        say(f"dag: running stage {s.sid!r} "
+                            f"({s.cls_name}) standalone")
+                        with tracer.span("dag.stage.run", stage=s.sid,
+                                         cls=s.cls_name, mode="solo"):
+                            results[s.sid] = job.run(stage_in(s),
+                                                     s.out_path, mesh=mesh)
+                        record_done(s, t0)
+                        done.add(s.sid)
+                    ran_any = True
+                assert ran_any
+        ck.complete()
+        # fused-group sidecars are named by group MEMBERSHIP, so a
+        # resume that grouped differently (fuse flag flipped, measured
+        # timings changed the auto decision) completes without ever
+        # loading the old grouping's file — sweep them all here so a
+        # successful workflow leaves no sidecar behind
+        import glob as _glob
+        for p in _glob.glob(_group_ckpt_path(out_base, in_path, ["*"])):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+    finally:
+        set_artifact_store(prev_store)
+    metrics.counters.set("Dag", "Memory handoffs", store.memory_reads)
+    say(f"dag: workflow complete — {len(stages)} stages, "
+        f"{store.memory_reads} in-memory artifact reads")
+    return results
+
+
+def _run_fused(members: List[Stage], config: JobConfig, scan_in: str,
+               out_base: Optional[str], wf_in: str, resolver: Callable,
+               mesh, say, results: Dict[str, Counters],
+               resume: bool) -> None:
+    """One shared scan over ``scan_in`` feeding every member stage —
+    delegated to ``core.multiscan.run_multi`` via a synthetic ``multi.*``
+    manifest, which brings the fused path's mid-scan checkpoint/resume,
+    per-spec withdrawal + standalone re-run, and byte-parity guarantees
+    along for free."""
+    from .multiscan import run_multi
+
+    sids = [m.sid for m in members]
+    props: Dict[str, str] = {"multi.jobs": ",".join(sids)}
+    # shared scan geometry + resilience keys ride along unchanged
+    for k, v in config.props.items():
+        if k.startswith(("pipeline.", "checkpoint.", "fault.", "retry.",
+                         "ingest.")) or k in ("field.delim.regex",
+                                              "field.delim.out",
+                                              "field.delim"):
+            props[k] = v
+    props["checkpoint.path"] = _group_ckpt_path(out_base, wf_in, sids)
+    if resume:
+        props["checkpoint.resume"] = "true"
+    for m in members:
+        props[f"multi.job.{m.sid}.class"] = m.cls_name
+        props[f"multi.job.{m.sid}.output.path"] = m.out_path
+        for k, v in m.props.items():
+            props[f"multi.job.{m.sid}.{k}"] = v
+    tracer = get_tracer()
+    with tracer.span("dag.stage.run", stage=",".join(sids), mode="fused"):
+        results.update(run_multi(JobConfig(props), scan_in, None, resolver,
+                                 mesh=mesh, log=say))

@@ -16,7 +16,105 @@ from __future__ import annotations
 
 import os
 import re
-from typing import Iterable, Iterator, List, Optional
+from typing import Dict, Iterable, Iterator, List, Optional
+
+
+class ArtifactStore:
+    """In-memory overlay for job-output artifacts (the core.dag handoff).
+
+    A workflow DAG chains jobs whose intermediate artifacts (a trained
+    NB model, an MI feature ranking) are text files only because the
+    reference's MR stages had no other channel.  While a store is
+    installed (``set_artifact_store``), ``write_output`` to a REGISTERED
+    stage output path also records the lines in memory, and
+    ``read_lines`` on that path serves them from memory — the downstream
+    stage consumes the producer's in-memory artifact and the text file
+    becomes a sink, not the transport.  Only registered paths
+    participate: unrelated outputs (quarantine sidecars, checkpoints,
+    non-workflow jobs in the same process) never enter the overlay.
+
+    ``verify=True`` (the default) asserts, on the FIRST memory read of
+    each artifact whose file sink was also written, that the in-memory
+    lines are byte-identical to the file round-trip — the parity
+    contract that makes skipping the file safe.  Paths registered with
+    ``sink_file=False`` skip the file write entirely (the "optional
+    sink" mode); their artifacts exist only in memory.
+    """
+
+    def __init__(self, verify: bool = True):
+        self.verify = verify
+        self._registered: Dict[str, bool] = {}     # abspath -> sink_file
+        self._lines: Dict[str, List[str]] = {}
+        self._verified: set = set()
+        self.memory_reads = 0
+
+    # -- registration ------------------------------------------------------
+    def register(self, out_path: str, sink_file: bool = True) -> None:
+        self._registered[os.path.abspath(out_path)] = sink_file
+
+    def _owner(self, path: str) -> Optional[str]:
+        """The registered path governing ``path`` (itself or its
+        directory), or None."""
+        ap = os.path.abspath(path)
+        if ap in self._registered:
+            return ap
+        parent = os.path.dirname(ap)
+        if parent in self._registered:
+            return parent
+        return None
+
+    # -- producer side (write_output) --------------------------------------
+    def wants(self, out_path: str) -> bool:
+        return self._owner(out_path) is not None
+
+    def sink_file(self, out_path: str) -> bool:
+        owner = self._owner(out_path)
+        return True if owner is None else self._registered[owner]
+
+    def put(self, out_path: str, file_path: str, lines: List[str]) -> None:
+        for key in {os.path.abspath(out_path), os.path.abspath(file_path)}:
+            self._lines[key] = lines
+
+    def peek(self, path: str) -> Optional[List[str]]:
+        """The stored lines for ``path`` WITHOUT counting a memory read
+        or running the parity check — for size estimation (the core.dag
+        cost model measuring a sink-less upstream artifact)."""
+        return self._lines.get(os.path.abspath(path))
+
+    # -- consumer side (read_lines) ----------------------------------------
+    def get(self, path: str) -> Optional[List[str]]:
+        ap = os.path.abspath(path)
+        lines = self._lines.get(ap)
+        if lines is None:
+            return None
+        self.memory_reads += 1
+        if self.verify and ap not in self._verified:
+            self._verified.add(ap)
+            if os.path.exists(ap):
+                on_disk = list(_read_lines_files(ap))
+                if on_disk != lines:
+                    raise AssertionError(
+                        f"artifact store: in-memory lines for {ap} differ "
+                        f"from the file round-trip ({len(lines)} vs "
+                        f"{len(on_disk)} lines) — handoff parity broken")
+        return lines
+
+
+_ARTIFACTS: Optional[ArtifactStore] = None
+
+
+def set_artifact_store(store: Optional[ArtifactStore]
+                       ) -> Optional[ArtifactStore]:
+    """Install (or clear, with None) the process-global artifact overlay;
+    returns the previous store so callers can restore it."""
+    global _ARTIFACTS
+    prev = _ARTIFACTS
+    _ARTIFACTS = store
+    return prev
+
+
+def get_artifact_store() -> Optional[ArtifactStore]:
+    return _ARTIFACTS
 
 
 def _input_files(path: str) -> List[str]:
@@ -29,14 +127,27 @@ def _input_files(path: str) -> List[str]:
     return [path]
 
 
-def read_lines(path: str) -> Iterator[str]:
-    """Yield every record line from a file or job-output directory."""
+def _read_lines_files(path: str) -> Iterator[str]:
     for fp in _input_files(path):
         with open(fp, "r") as fh:
             for line in fh:
                 line = line.rstrip("\n")
                 if line:
                     yield line
+
+
+def read_lines(path: str) -> Iterator[str]:
+    """Yield every record line from a file or job-output directory.
+
+    With an :class:`ArtifactStore` installed holding ``path``, the lines
+    come from the in-memory artifact instead of disk (the core.dag
+    stage-to-stage handoff); all other paths read normally."""
+    store = _ARTIFACTS
+    if store is not None:
+        lines = store.get(path)
+        if lines is not None:
+            return iter(lines)
+    return _read_lines_files(path)
 
 
 def is_plain_delim(delim_regex: str) -> bool:
@@ -135,7 +246,20 @@ class OutputWriter:
 
 def write_output(out_path: str, lines: Iterable[str], shard: Optional[int] = None,
                  as_dir: bool = True) -> str:
-    """One-shot job-output write; returns the part file path."""
+    """One-shot job-output write; returns the part file path.
+
+    With an :class:`ArtifactStore` installed and ``out_path`` registered,
+    the lines are ALSO recorded in memory for downstream stages; a path
+    registered with ``sink_file=False`` skips the disk write entirely
+    (the artifact lives only in the overlay)."""
+    store = _ARTIFACTS
+    if store is not None and store.wants(out_path):
+        lines = list(lines)
+        file_path = (os.path.join(out_path, f"part-r-{(shard or 0):05d}")
+                     if as_dir else out_path)
+        store.put(out_path, file_path, lines)
+        if not store.sink_file(out_path):
+            return file_path
     with OutputWriter(out_path, shard=shard, as_dir=as_dir) as w:
         w.write_all(lines)
     return w.file_path

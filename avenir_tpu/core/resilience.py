@@ -180,11 +180,12 @@ def with_retries(fn: Callable, *args, op: str = "io",
 #: is neither wrapped nor listed here — and when an entry here no longer
 #: matches a raw call site (stale exclusion).
 NON_RETRYABLE: Dict[str, str] = {
-    "core/io.py:read_lines":
-        "model/config artifact loads at job setup: a missing or unreadable "
-        "model file is a fail-fast user error, not a transient fault (the "
-        "bulk ingest hot path reads through native._read_buffer, which "
-        "retries)",
+    "core/io.py:_read_lines_files":
+        "model/config artifact loads at job setup (the read_lines file "
+        "path; its in-memory ArtifactStore overlay path does no I/O at "
+        "all): a missing or unreadable model file is a fail-fast user "
+        "error, not a transient fault (the bulk ingest hot path reads "
+        "through native._read_buffer, which retries)",
     "core/io.py:read_field_matrix":
         "monolithic fallback loader, same fail-fast artifact-read contract "
         "as read_lines; the streaming hot path retries via _read_buffer",
@@ -213,6 +214,15 @@ NON_RETRYABLE: Dict[str, str] = {
     "core/checkpoint.py:StreamCheckpointer.load":
         "resume-time sidecar read: a missing/unreadable checkpoint falls "
         "back to a full re-run, which is always correct",
+    "core/checkpoint.py:WorkflowCheckpointer.__init__":
+        "resume-time workflow sidecar read, same contract as "
+        "StreamCheckpointer.load: a missing sidecar falls back to a full "
+        "re-run; an unreadable one fails fast with the path named",
+    "core/checkpoint.py:WorkflowCheckpointer.record":
+        "stage-completion sidecar write, same contract as "
+        "StreamCheckpointer.save: atomic via tmp+rename, and a failed "
+        "record must fail the workflow loudly (resume correctness depends "
+        "on the record) rather than retry-stall between stages",
     "core/checkpoint.py:input_fingerprint":
         "fingerprint hash read runs at checkpoint save/load next to the "
         "retried bulk read of the same file; a transient fault surfaces "

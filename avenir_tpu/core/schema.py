@@ -113,8 +113,11 @@ class FeatureSchema:
 
     @classmethod
     def from_file(cls, path: str) -> "FeatureSchema":
-        with open(path, "r") as fh:
-            return cls.from_json(fh.read())
+        # routed through core.io.read_lines so a schema produced by a
+        # workflow stage (core.dag FeatureSelect) is consumed from the
+        # in-memory artifact overlay when one is installed
+        from .io import read_lines
+        return cls.from_json("\n".join(read_lines(path)))
 
     def get_fields(self) -> List[FeatureField]:
         return self.fields

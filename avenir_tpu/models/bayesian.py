@@ -764,8 +764,19 @@ class NaiveBayesModel:
 
     @classmethod
     def load(cls, path: str, delim_regex: str = ",") -> "NaiveBayesModel":
+        """Load from the model text file (or an in-memory artifact when
+        a ``core.io.ArtifactStore`` overlay holds the path — the DAG
+        stage handoff)."""
+        return cls.from_lines(read_lines(path), delim_regex)
+
+    @classmethod
+    def from_lines(cls, lines, delim_regex: str = ",") -> "NaiveBayesModel":
+        """Build the model from an iterable of model-format lines — the
+        artifact-import hook core.dag uses to hand a just-trained model
+        to a predictor or the serving registry without a file
+        round-trip."""
         m = cls()
-        for line in read_lines(path):
+        for line in lines:
             items = split_line(line, delim_regex)
             ordinal = int(items[1]) if items[1] != "" else -1
             if items[0] == "":

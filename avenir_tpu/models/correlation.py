@@ -182,6 +182,29 @@ class CategoricalCorrelation:
         """Export this job's shared-scan ``core.multiscan.FoldSpec``."""
         return _CatCorrFoldSpec(self, out_path)
 
+    # -- artifact import (core.dag consumers) ------------------------------
+    @staticmethod
+    def parse_output(lines, delim: str = ","
+                     ) -> List[Tuple[str, str, float]]:
+        """``(src_name, dst_name, statistic)`` triples out of this job
+        family's output lines — the artifact-import hook a DAG stage
+        uses to consume correlation results in memory (e.g. to audit a
+        feature selection against plan/churn correlation).  Malformed
+        lines raise naming the line (a truncated artifact must not
+        silently yield a shorter result)."""
+        out = []
+        for line in lines:
+            parts = line.split(delim)
+            try:
+                if len(parts) != 3:
+                    raise ValueError
+                out.append((parts[0], parts[1], float(parts[2])))
+            except ValueError:
+                raise ValueError(
+                    f"malformed correlation output line (want "
+                    f"src{delim}dst{delim}statistic): {line!r}") from None
+        return out
+
 
 class CramerCorrelation(CategoricalCorrelation):
     pass

@@ -200,6 +200,39 @@ class _MIStreamState:
         return x, y
 
 
+def pair_table_bytes(F: int, B: int, C: int) -> int:
+    """Estimated device bytes of the MI count tables: the dominant
+    ``PC[pair, b1, b2, class]`` int32 over all i<j feature pairs plus
+    the ``FC[class, feature, bin]`` table — the quadratic-in-features,
+    quadratic-in-bins residency this job materializes per device."""
+    n_pairs = F * (F - 1) // 2
+    return 4 * (n_pairs * B * B * C + C * F * B)
+
+
+def check_pair_table_budget(cfg, F: int, B: int, C: int) -> None:
+    """Fail fast — BEFORE any device allocation — when the estimated MI
+    pair-table residency exceeds the configured
+    ``pipeline.device.budget.bytes``.  The PC table grows as
+    F^2/2 * B^2 * C int32 cells, so a wide or finely-binned schema turns
+    into an opaque device OOM mid-fold; this guard turns it into an
+    actionable error naming the estimate and the knobs (no guard when no
+    budget is declared)."""
+    from ..core import pipeline
+
+    budget = cfg.get_int(pipeline.KEY_DEVICE_BUDGET, None)
+    if budget is None:
+        return
+    est = pair_table_bytes(F, B, C)
+    if est > budget:
+        n_pairs = F * (F - 1) // 2
+        raise ValueError(
+            f"MutualInformation pair tables need ~{est} bytes per device "
+            f"({n_pairs} feature pairs x {B}x{B} bins x {C} classes, "
+            f"int32) which exceeds {pipeline.KEY_DEVICE_BUDGET}={budget}. "
+            f"Raise the budget, coarsen bucketWidth (fewer bins), or "
+            f"reduce the feature set (e.g. a prior feature-select stage).")
+
+
 class MutualInformation:
     """The MI job."""
 
@@ -212,6 +245,16 @@ class MutualInformation:
                 raise ValueError(
                     f"MutualInformation requires bucketWidth on numeric "
                     f"feature {f.name!r} (reference has no unbinned path)")
+        # early ceiling check from DECLARED extents alone (discovered
+        # extents re-check at cap sizing): constructing the job against
+        # an over-budget schema fails before any input is read
+        ffields = self.schema.feature_fields()
+        decl_bins = [f.num_bins() for f in ffields
+                     if f.is_categorical() or f.max is not None]
+        cls = self.schema.class_attr_field()
+        check_pair_table_budget(
+            config, len(ffields), max(decl_bins, default=1),
+            max(len(cls.cardinality), 1))
 
     @traced_run
     def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
@@ -235,6 +278,7 @@ class MutualInformation:
         F = ds.n_features
         C = len(ds.class_vocab)
         B = max(ds.num_bins)
+        check_pair_table_budget(cfg, F, B, C)
         pair_i, pair_j = map(tuple, np.triu_indices(F, k=1))
         res = sharded_reduce(_mi_local, ds.x, ds.y, mesh=mesh,
                              static_args=(C, B, pair_i, pair_j))
@@ -277,6 +321,7 @@ class MutualInformation:
             if first is None:
                 return None
             st.size_caps()
+            check_pair_table_budget(cfg, st.F, st.caps["B"], st.caps["C"])
             res = pipeline.streaming_fold(
                 stream, _mi_local,
                 static_args=(st.caps["C"], st.caps["B"],
@@ -319,6 +364,55 @@ class MutualInformation:
     def fold_spec(self, out_path: str):
         """Export this job's shared-scan ``core.multiscan.FoldSpec``."""
         return _MIFoldSpec(self, out_path)
+
+    # -- artifact import (core.dag feature-select stage) -------------------
+    @staticmethod
+    def parse_scores(lines, algorithm: Optional[str] = None,
+                     delim: str = ",") -> List[Tuple[int, float]]:
+        """The ranked ``(ordinal, score)`` list out of this job's output
+        lines — the artifact-import hook a DAG feature-select stage uses
+        to consume the ranking in memory.  ``algorithm`` picks one
+        ``mutualInformationScoreAlgorithm:`` section (default: the
+        first); unknown algorithm -> KeyError naming what the artifact
+        does contain."""
+        sections: Dict[str, List[Tuple[int, float]]] = {}
+        current: Optional[str] = None
+        for line in lines:
+            if line.startswith("mutualInformationScoreAlgorithm:"):
+                current = line.split(":", 1)[1].strip()
+                sections[current] = []
+                continue
+            if current is None:
+                continue
+            if ":" in line and delim not in line:
+                current = None          # a following non-score header
+                continue
+            parts = line.split(delim)
+            if len(parts) == 2:
+                try:
+                    parsed = (int(parts[0]), float(parts[1]))
+                except ValueError:
+                    # score sections are the LAST sections of the
+                    # artifact, so a non-`ordinal,score` line here is
+                    # corruption (partial write, hand edit) — fail
+                    # loudly instead of silently truncating the
+                    # ranking a feature-select stage will consume
+                    raise ValueError(
+                        f"malformed score line in MI artifact section "
+                        f"{current!r}: {line!r}") from None
+                sections[current].append(parsed)
+        if not sections:
+            raise ValueError(
+                "no mutualInformationScoreAlgorithm section in the MI "
+                "artifact (was the job run with "
+                "mutual.info.score.algorithms set?)")
+        if algorithm is None:
+            return next(iter(sections.values()))
+        if algorithm not in sections:
+            raise KeyError(
+                f"MI artifact has no score section {algorithm!r}; "
+                f"present: {sorted(sections)}")
+        return sections[algorithm]
 
     # -- host post-processing ----------------------------------------------
     def _emit(self, ds: EncodedDataset, fc, pc, pair_i, pair_j, delim,
@@ -526,6 +620,8 @@ class _MIFoldSpec(MultiScanFoldSpec):
         out = self.st.accept(x, y, n)
         if out is not None and not self.st.caps:
             self.st.size_caps()
+            check_pair_table_budget(self.job.config, self.st.F,
+                                    self.st.caps["B"], self.st.caps["C"])
             self.static_args = (self.st.caps["C"], self.st.caps["B"],
                                 self.st.pair_i, self.st.pair_j)
         return out

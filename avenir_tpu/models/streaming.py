@@ -87,14 +87,49 @@ class InMemoryTransport(Transport):
         self.actions.append(message)
 
 
+class FakeRedis:
+    """fakeredis-style in-process double of the redis-py list commands
+    :class:`RedisTransport` uses — same lpush/rpop semantics and
+    decoded-string returns, no server.  Producers/consumers standing in
+    for the reference's Redis peers (and the round-trip tests in
+    ``tests/test_reinforce.py``) drive the REAL transport against this
+    client, so the queue protocol is covered without the optional
+    ``redis`` dependency."""
+
+    def __init__(self):
+        self._lists: Dict[str, deque] = {}
+
+    def lpush(self, key: str, *values) -> int:
+        q = self._lists.setdefault(key, deque())
+        for v in values:
+            q.appendleft(str(v))
+        return len(q)
+
+    def rpop(self, key: str) -> Optional[str]:
+        q = self._lists.get(key)
+        return q.pop() if q else None
+
+    def llen(self, key: str) -> int:
+        return len(self._lists.get(key) or ())
+
+    def lrange(self, key: str, start: int, stop: int) -> List[str]:
+        items = list(self._lists.get(key) or ())
+        return items[start:None if stop == -1 else stop + 1]
+
+
 class RedisTransport(Transport):
     """Redis-list transport matching the reference's queue protocol
-    (``rpop`` events, reward list, ``lpush`` actions)."""
+    (``rpop`` events, reward list, ``lpush`` actions).  ``client``
+    injects a ready client (e.g. :class:`FakeRedis`); otherwise the
+    optional ``redis`` package connects to ``host:port``."""
 
     def __init__(self, host: str, port: int, event_queue: str,
-                 reward_queue: str, action_queue: str):
-        import redis  # optional dependency; gate at construction
-        self._r = redis.Redis(host=host, port=port, decode_responses=True)
+                 reward_queue: str, action_queue: str, client=None):
+        if client is None:
+            import redis  # optional dependency; gate at construction
+            client = redis.Redis(host=host, port=port,
+                                 decode_responses=True)
+        self._r = client
         self.event_queue = event_queue
         self.reward_queue = reward_queue
         self.action_queue = action_queue

@@ -611,6 +611,146 @@ def bench_shared_scan():
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_dag_workflow():
+    """Workflow DAG engine (core.dag): wall-clock of the canonical
+    bin -> train{NB + MI + Cramer} -> feature-select -> retrain
+    pipeline SCHEDULED AS ONE DAG (cost-decided shared scan over the
+    three same-input trainers + in-memory artifact handoff between
+    stages) vs running the constituent jobs SEQUENTIALLY STANDALONE
+    with text-file handoff — the way the reference's resource/*.sh
+    runbooks chain them.  Every stage output of the DAG run is asserted
+    byte-identical to the standalone chain before anything is timed;
+    both sides compile-warm first, then >= REPS repeats each, min-time
+    values."""
+    import shutil
+    import tempfile
+
+    from avenir_tpu.cli import _job_resolver, _lazy, resolve
+    from avenir_tpu.core import JobConfig
+    from avenir_tpu.core.dag import FeatureSelect, run_workflow
+    from avenir_tpu.datagen import gen_telecom_churn
+    from avenir_tpu.parallel.mesh import make_mesh
+
+    tmp = tempfile.mkdtemp(prefix="dag_workflow_")
+    try:
+        n_rows = 400_000
+        base = gen_telecom_churn(50_000, seed=7)
+        reps_factor = n_rows // len(base)
+        n_rows = reps_factor * len(base)
+        in_dir = os.path.join(tmp, "in")
+        os.makedirs(in_dir)
+        block = "\n".join(",".join(r) for r in base) + "\n"
+        with open(os.path.join(in_dir, "part-00000"), "w") as fh:
+            for _ in range(reps_factor):
+                fh.write(block)
+        schema_path = os.path.join(tmp, "schema.json")
+        with open(schema_path, "w") as fh:
+            fh.write(json.dumps(_SHARED_SCAN_SCHEMA))
+        mesh = make_mesh()
+        pipe = {"pipeline.chunk.rows": str(1 << 16),
+                "pipeline.prefetch.depth": "2"}
+        stage_ids = ("bin", "nb", "mi", "corr", "select", "retrain")
+
+        def run_standalone(base_dir):
+            """The reference runbook shape: one job at a time, every
+            intermediate round-tripped through its text file."""
+            def run(cls, props, inp, out):
+                modname, clsname, prefix = resolve(cls)
+                job = _lazy(modname, clsname)(
+                    JobConfig(dict(props, **pipe), prefix))
+                job.run(inp, os.path.join(base_dir, out), mesh=mesh)
+
+            j = os.path.join
+            run("org.chombo.mr.Projection",
+                {"projection.operation": "project",
+                 "projection.field": "0,1,2,3,4,5,6,7"}, in_dir, "bin")
+            run("BayesianDistribution",
+                {"feature.schema.file.path": schema_path},
+                j(base_dir, "bin"), "nb")
+            run("MutualInformation",
+                {"feature.schema.file.path": schema_path},
+                j(base_dir, "bin"), "mi")
+            run("CramerCorrelation",
+                {"feature.schema.file.path": schema_path,
+                 "source.attributes": "1", "dest.attributes": "7"},
+                j(base_dir, "bin"), "corr")
+            FeatureSelect(JobConfig({
+                "select.schema.file.path": schema_path,
+                "select.top.features": "4"})).run(
+                    j(base_dir, "mi"), j(base_dir, "select"))
+            run("BayesianDistribution",
+                {"feature.schema.file.path": j(base_dir, "select")},
+                j(base_dir, "bin"), "retrain")
+
+        manifest = dict(pipe)
+        manifest.update({
+            "workflow.stages": ",".join(stage_ids),
+            "workflow.stage.bin.class": "org.chombo.mr.Projection",
+            "workflow.stage.bin.projection.operation": "project",
+            "workflow.stage.bin.projection.field": "0,1,2,3,4,5,6,7",
+            "workflow.stage.nb.class": "BayesianDistribution",
+            "workflow.stage.nb.input": "bin",
+            "workflow.stage.nb.feature.schema.file.path": schema_path,
+            "workflow.stage.mi.class": "MutualInformation",
+            "workflow.stage.mi.input": "bin",
+            "workflow.stage.mi.feature.schema.file.path": schema_path,
+            "workflow.stage.corr.class": "CramerCorrelation",
+            "workflow.stage.corr.input": "bin",
+            "workflow.stage.corr.feature.schema.file.path": schema_path,
+            "workflow.stage.corr.source.attributes": "1",
+            "workflow.stage.corr.dest.attributes": "7",
+            "workflow.stage.select.class": "FeatureSelect",
+            "workflow.stage.select.input": "mi",
+            "workflow.stage.select.select.schema.file.path": schema_path,
+            "workflow.stage.select.select.top.features": "4",
+            "workflow.stage.retrain.class": "BayesianDistribution",
+            "workflow.stage.retrain.input": "bin",
+            "workflow.stage.retrain.feature.schema.file.path": "@select",
+        })
+        dag_base = os.path.join(tmp, "dag")
+        decisions = []
+
+        def run_dag():
+            run_workflow(JobConfig(dict(manifest)), in_dir, dag_base,
+                         _job_resolver, mesh=mesh,
+                         log=lambda m: decisions.append(m)
+                         if "cost model" in m else None)
+
+        # compile warmup both sides, then the byte-parity gate
+        alone_base = os.path.join(tmp, "alone")
+        run_standalone(alone_base)
+        run_dag()
+        fused = any("FUSE into one shared scan" in m for m in decisions)
+
+        def read_out(base_dir, sid):
+            p = os.path.join(base_dir, sid)
+            if os.path.isfile(p):
+                return open(p).read()
+            return open(os.path.join(p, "part-r-00000")).read()
+
+        parity_ok = all(read_out(dag_base, sid) == read_out(alone_base, sid)
+                        for sid in stage_ids)
+        assert parity_ok, "DAG outputs differ from the standalone chain"
+
+        alone_samples = samples_of(lambda: run_standalone(alone_base))
+        dag_samples = samples_of(run_dag)
+        t_alone, t_dag = min(alone_samples), min(dag_samples)
+        out = {"metric": "dag_workflow_speedup",
+               "value": round(t_alone / t_dag, 3),
+               "unit": f"x (6-stage bin->train{{NB+MI+Cramer}}->select->"
+                       f"retrain DAG vs sequential standalone jobs with "
+                       f"file handoff, {n_rows} rows, byte-identical "
+                       f"outputs, min-of-{len(dag_samples)})",
+               "vs_baseline": None,
+               "dag_wall_sec": round(t_dag, 4),
+               "standalone_wall_sec": round(t_alone, 4),
+               "cost_model_fused_train_stages": fused,
+               "outputs_byte_identical": parity_ok}
+        return finish_metric(out, dag_samples)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 _BF16_PEAK_BY_KIND = (
     # substring of jax device_kind (lowercased) -> per-chip bf16 peak FLOP/s
     ("v6e", 918e12), ("v6 lite", 918e12),
@@ -1601,6 +1741,7 @@ def main():
     extra = []
     for nm, fn_b in (("ingest_e2e", bench_ingest_e2e),
                      ("shared_scan", bench_shared_scan),
+                     ("dag_workflow", bench_dag_workflow),
                      ("apriori", bench_apriori),
                      ("knn", bench_knn_distance),
                      ("tree", bench_tree_level),

@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# Workflow DAG runbook: the canonical bin -> train{NB + MI + Cramer} ->
+# feature-select -> retrain -> validate -> publish pipeline as ONE
+# declared DAG (core/dag), replacing the reference's hand-chained
+# resource/*.sh invocations.  The scheduler's cost model fuses the
+# three same-input trainers into one streamed scan, intermediates hand
+# off in memory (files are byte-identical sinks), and a killed run
+# resumes with --resume, skipping completed stages.
+set -euo pipefail
+cd "$(dirname "$0")"
+PY=${PYTHON:-python}
+rm -rf work && mkdir -p work/train work/test
+
+# ~7 MB of training rows: enough scan weight that the cost model's
+# AUTO decision fuses the three trainers (at a couple of MB it would
+# honestly run them separately — one read is too cheap to share)
+$PY -m avenir_tpu.datagen telecom_churn 250000 --seed 29 --out work/all.csv
+head -n 200000 work/all.csv > work/train/part-00000
+tail -n  50000 work/all.csv > work/test/part-00000
+
+# the whole pipeline, one invocation (watch stderr: the cost-model
+# decision for the [nb,mi,corr] group, per-stage runs, memory handoffs)
+$PY -m avenir_tpu dag -Dconf.path=workflow.properties work/train work/out
+
+echo "binned input:     work/out/bin/part-r-00000"
+echo "full NB model:    work/out/nb/part-r-00000"
+echo "MI ranking:       work/out/mi/part-r-00000"
+echo "Cramer index:     work/out/corr/part-r-00000"
+echo "selected schema:  work/out/select"
+echo "retrained model:  work/out/retrain/part-r-00000"
+echo "validation preds: work/out/validate/part-r-00000"
+echo "published model:  work/out/publish/part-r-00000 (the registry-served bytes)"
+head -n 2 work/out/validate/part-r-00000
+
+# the published artifact is byte-identical to the retrained model — the
+# registry serves exactly what the training stage produced
+cmp work/out/publish/part-r-00000 work/out/retrain/part-r-00000 \
+  && echo "publish == retrain (byte-identical)"
+
+# resume demo: re-run with --resume against the completed output tree —
+# no workflow checkpoint remains (the successful run deleted it), so
+# this is a fresh full run; kill one mid-flight and re-run with
+# --resume to watch completed stages skip instead
+# $PY -m avenir_tpu dag -Dconf.path=workflow.properties work/train work/out --resume

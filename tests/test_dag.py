@@ -1,0 +1,719 @@
+"""Cost-based workflow DAG engine (core/dag): manifest validation,
+cost-model fusion decisions, end-to-end byte parity of the canonical
+bin -> train{NB+MI+correlation} -> feature-select -> retrain ->
+validate -> publish pipeline against standalone jobs with file handoff,
+in-memory artifact handoff (+ optional sink), stage checkpoint/resume
+under injected faults, and the `dag` CLI."""
+
+import json
+import os
+
+import pytest
+
+from avenir_tpu.cli import _job_resolver, _lazy, resolve
+from avenir_tpu.core import JobConfig
+from avenir_tpu.core import dag, faultinject
+from avenir_tpu.core.dag import (Stage, WorkflowConfigError, fusion_decision,
+                                 load_workflow, run_workflow)
+from avenir_tpu.core.faultinject import FaultInjector, parse_plan
+from avenir_tpu.core.io import get_artifact_store
+from avenir_tpu.datagen.generators import gen_telecom_churn
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    """Every test leaves the fault injector and artifact store unset."""
+    yield
+    faultinject.set_injector(None)
+    from avenir_tpu.core.io import set_artifact_store
+    set_artifact_store(None)
+    assert get_artifact_store() is None
+
+
+# ---------------------------------------------------------------------------
+# shared workload: churn CSV + all-binned schema
+# ---------------------------------------------------------------------------
+
+SCHEMA = {"fields": [
+    {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+    {"name": "plan", "ordinal": 1, "dataType": "categorical",
+     "feature": True, "cardinality": ["planA", "planB"]},
+    {"name": "minUsed", "ordinal": 2, "dataType": "int", "feature": True,
+     "min": 0, "max": 2200, "bucketWidth": 200},
+    {"name": "dataUsed", "ordinal": 3, "dataType": "int", "feature": True,
+     "min": 0, "max": 1000, "bucketWidth": 100},
+    {"name": "csCall", "ordinal": 4, "dataType": "int", "feature": True,
+     "min": 0, "max": 14, "bucketWidth": 2},
+    {"name": "csEmail", "ordinal": 5, "dataType": "int", "feature": True,
+     "min": 0, "max": 22, "bucketWidth": 4},
+    {"name": "network", "ordinal": 6, "dataType": "int", "feature": True,
+     "min": 0, "max": 12, "bucketWidth": 2},
+    {"name": "churned", "ordinal": 7, "dataType": "categorical",
+     "cardinality": ["N", "Y"]}]}
+
+
+@pytest.fixture(scope="module")
+def data(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("dag_data")
+    schema_path = tmp / "schema.json"
+    schema_path.write_text(json.dumps(SCHEMA))
+    rows = gen_telecom_churn(2500, seed=29)
+    (tmp / "train").mkdir()
+    (tmp / "test").mkdir()
+    (tmp / "train" / "part-00000").write_text(
+        "\n".join(",".join(r) for r in rows[:2000]) + "\n")
+    (tmp / "test" / "part-00000").write_text(
+        "\n".join(",".join(r) for r in rows[2000:]) + "\n")
+    return {"schema": str(schema_path), "train": str(tmp / "train"),
+            "test": str(tmp / "test")}
+
+
+def _manifest(data, stages="bin,nb,mi,corr,select,retrain,validate,publish",
+              **extra):
+    props = {
+        "workflow.stages": stages,
+        "workflow.stage.bin.class": "org.chombo.mr.Projection",
+        "workflow.stage.bin.projection.operation": "project",
+        "workflow.stage.bin.projection.field": "0,1,2,3,4,5,6,7",
+        "workflow.stage.nb.class": "BayesianDistribution",
+        "workflow.stage.nb.input": "bin",
+        "workflow.stage.nb.feature.schema.file.path": data["schema"],
+        "workflow.stage.mi.class": "MutualInformation",
+        "workflow.stage.mi.input": "bin",
+        "workflow.stage.mi.feature.schema.file.path": data["schema"],
+        "workflow.stage.corr.class": "CramerCorrelation",
+        "workflow.stage.corr.input": "bin",
+        "workflow.stage.corr.feature.schema.file.path": data["schema"],
+        "workflow.stage.corr.source.attributes": "1",
+        "workflow.stage.corr.dest.attributes": "7",
+        "workflow.stage.select.class": "FeatureSelect",
+        "workflow.stage.select.input": "mi",
+        "workflow.stage.select.select.schema.file.path": data["schema"],
+        "workflow.stage.select.select.top.features": "4",
+        "workflow.stage.retrain.class": "BayesianDistribution",
+        "workflow.stage.retrain.input": "bin",
+        "workflow.stage.retrain.feature.schema.file.path": "@select",
+        "workflow.stage.validate.class": "BayesianPredictor",
+        "workflow.stage.validate.input": "path:" + data["test"],
+        "workflow.stage.validate.feature.schema.file.path": "@select",
+        "workflow.stage.validate.bayesian.model.file.path": "@retrain",
+        "workflow.stage.publish.class": "RegistryPublish",
+        "workflow.stage.publish.input": "retrain",
+        "workflow.stage.publish.publish.model.name": "churn",
+        "workflow.stage.publish.feature.schema.file.path": "@select",
+        "pipeline.chunk.rows": "256",
+        "pipeline.prefetch.depth": "2",
+    }
+    keep = set(stages.split(","))
+    props = {k: v for k, v in props.items()
+             if not k.startswith("workflow.stage.")
+             or k.split(".")[2] in keep}
+    props.update(extra)
+    return props
+
+
+def _read(base, sid):
+    p = os.path.join(base, sid)
+    if os.path.isfile(p):
+        return open(p).read()
+    return open(os.path.join(p, "part-r-00000")).read()
+
+
+PIPE = {"pipeline.chunk.rows": "256", "pipeline.prefetch.depth": "2"}
+
+
+def _run_standalone_chain(data, base, mesh):
+    """The canonical pipeline as the reference runbooks run it: one job
+    at a time, every intermediate round-tripped through a text file."""
+    def run(cls, props, inp, out):
+        modname, clsname, prefix = resolve(cls)
+        job = _lazy(modname, clsname)(JobConfig(dict(props, **PIPE), prefix))
+        job.run(inp, out, mesh=mesh)
+
+    j = os.path.join
+    run("org.chombo.mr.Projection",
+        {"projection.operation": "project",
+         "projection.field": "0,1,2,3,4,5,6,7"},
+        data["train"], j(base, "bin"))
+    run("BayesianDistribution",
+        {"feature.schema.file.path": data["schema"]},
+        j(base, "bin"), j(base, "nb"))
+    run("MutualInformation",
+        {"feature.schema.file.path": data["schema"]},
+        j(base, "bin"), j(base, "mi"))
+    run("CramerCorrelation",
+        {"feature.schema.file.path": data["schema"],
+         "source.attributes": "1", "dest.attributes": "7"},
+        j(base, "bin"), j(base, "corr"))
+    dag.FeatureSelect(JobConfig({
+        "select.schema.file.path": data["schema"],
+        "select.top.features": "4"})).run(j(base, "mi"), j(base, "select"))
+    run("BayesianDistribution",
+        {"feature.schema.file.path": j(base, "select")},
+        j(base, "bin"), j(base, "retrain"))
+    run("BayesianPredictor",
+        {"feature.schema.file.path": j(base, "select"),
+         "bayesian.model.file.path": j(base, "retrain")},
+        data["test"], j(base, "validate"))
+
+
+# ---------------------------------------------------------------------------
+# satellite: table-driven manifest validation
+# ---------------------------------------------------------------------------
+
+BAD_MANIFESTS = [
+    # (overlay building a broken manifest, error fragment naming the key)
+    ({"workflow.stages": ""}, "workflow.stages is empty"),
+    ({"workflow.stages": "a,a", "workflow.stage.a.class": "X"},
+     "duplicate stage ids"),
+    ({"workflow.stages": "a", "workflow.stage.a.class": "X",
+      "workflow.stage.typo.select.top.features": "3"},
+     "workflow.stage.typo.select.top.features"),
+    ({"workflow.stages": "a"}, "workflow.stage.a.class"),
+    ({"workflow.stages": "a", "workflow.stage.a.class": "X",
+      "workflow.stage.a.input": "ghost"},
+     "workflow.stage.a.input='ghost'"),
+    ({"workflow.stages": "a,b",
+      "workflow.stage.a.class": "X", "workflow.stage.a.input": "b",
+      "workflow.stage.b.class": "X", "workflow.stage.b.input": "a"},
+     "dependency cycle"),
+    ({"workflow.stages": "a", "workflow.stage.a.class": "X",
+      "workflow.stage.a.some.model.path": "@ghost"},
+     "undeclared stage 'ghost'"),
+    ({"workflow.stages": "a", "workflow.stage.a.class": "X",
+      "workflow.stage.a.some.model.path": "@a"},
+     "its own output"),
+    ({"workflow.stages": "a,b",
+      "workflow.stage.a.class": "X", "workflow.stage.a.output.path": "/t/o",
+      "workflow.stage.b.class": "X", "workflow.stage.b.output.path": "/t/o"},
+     "duplicates stage 'a'"),
+    ({"workflow.stages": "a;b", "workflow.stage.a;b.class": "X"},
+     "bad stage id"),
+    # sink.file=false on an output no stage consumes through the
+    # overlay: its byte-scanning consumer would find no file
+    ({"workflow.stages": "a,b",
+      "workflow.stage.a.class": "X", "workflow.stage.a.sink.file": "false",
+      "workflow.stage.b.class": "Y", "workflow.stage.b.input": "a"},
+     "workflow.stage.a.sink.file=false"),
+]
+
+
+@pytest.mark.parametrize("overlay,fragment", BAD_MANIFESTS)
+def test_manifest_validation_names_the_offending_key(tmp_path, overlay,
+                                                     fragment):
+    with pytest.raises((WorkflowConfigError, KeyError)) as ei:
+        load_workflow(JobConfig(dict(overlay)), str(tmp_path / "in"),
+                      str(tmp_path / "out"))
+    assert fragment in str(ei.value), str(ei.value)
+
+
+def test_manifest_requires_output_derivation(tmp_path):
+    cfg = JobConfig({"workflow.stages": "a",
+                     "workflow.stage.a.class": "X"})
+    with pytest.raises(WorkflowConfigError, match="output.path"):
+        load_workflow(cfg, str(tmp_path / "in"), None)
+
+
+def test_artifact_refs_resolve_to_output_paths(tmp_path):
+    cfg = JobConfig({
+        "workflow.stages": "a,b",
+        "workflow.stage.a.class": "X",
+        "workflow.stage.b.class": "Y",
+        "workflow.stage.b.input": "a",
+        "workflow.stage.b.bayesian.model.file.path": "@a"})
+    stages = load_workflow(cfg, str(tmp_path / "in"), str(tmp_path / "o"))
+    by_id = {s.sid: s for s in stages}
+    assert by_id["b"].deps == ["a"]
+    assert (by_id["b"].props["bayesian.model.file.path"]
+            == by_id["a"].out_path)
+
+
+# ---------------------------------------------------------------------------
+# the cost model demonstrably decides
+# ---------------------------------------------------------------------------
+
+def _stages_for_cost(n=3, fold_sec=None):
+    return [Stage(f"s{i}", "BayesianDistribution", {}, "$input",
+                  f"/t/s{i}", True, fold_sec, []) for i in range(n)]
+
+
+def test_cost_model_fuses_when_scan_dominates():
+    """50 MB scan, cheap folds: one shared scan amortizes N reads."""
+    fuse, d = fusion_decision(_stages_for_cost(3), 50_000_000,
+                              JobConfig({}))
+    assert fuse
+    assert d["fused_sec"] < d["separate_sec"]
+    assert set(d["fold_source"].values()) == {"default"}
+
+
+def test_cost_model_separates_when_folds_dominate():
+    """Tiny scan, heavy folds: the shared scan's coordination overhead
+    costs more than the saved read, so stages run separately."""
+    fuse, d = fusion_decision(_stages_for_cost(3, fold_sec=2.0), 10_000,
+                              JobConfig({}))
+    assert not fuse
+    assert set(d["fold_source"].values()) == {"configured"}
+    assert d["separate_sec"] <= d["fused_sec"]
+
+
+def test_cost_model_override_and_validation():
+    stages = _stages_for_cost(2)
+    assert fusion_decision(stages, 10,
+                           JobConfig({"workflow.fuse": "always"}))[0]
+    assert not fusion_decision(stages, 1 << 30,
+                               JobConfig({"workflow.fuse": "never"}))[0]
+    with pytest.raises(WorkflowConfigError, match="workflow.fuse"):
+        fusion_decision(stages, 10, JobConfig({"workflow.fuse": "maybe"}))
+
+
+def test_cost_model_uses_measured_span_timings():
+    """With multiscan.fold spans recorded (the PR-3 substrate), the
+    model prefers the MEASURED per-chunk fold time over the default."""
+    from avenir_tpu.core import obs
+
+    tr = obs.configure(enabled=True)
+    tr.clear()
+    try:
+        with tr.span("multiscan.fold", job="s0"):
+            pass
+        fuse, d = fusion_decision(_stages_for_cost(2), 1_000_000,
+                                  JobConfig({}))
+        assert d["fold_source"]["s0"] == "measured"
+        assert d["fold_source"]["s1"] == "default"
+    finally:
+        obs.configure(enabled=False)
+        tr.clear()
+
+
+def test_cost_decisions_drive_the_scheduler(data, tmp_path, mesh8):
+    """E2E: the same 3-ready-stage manifest groups into one shared scan
+    under a fusion-winning cost config and runs the stages separately
+    under a fusion-losing one — both decisions visible in the logs and
+    both producing identical outputs."""
+    outs = {}
+    for tag, extra in (
+            # fusion wins: a (modeled) slow scan dominates cheap folds
+            ("fuse", {"workflow.cost.scan.mb.per.sec": "0.01"}),
+            # fusion loses: (modeled) instant scan, heavy per-job folds
+            ("solo", {"workflow.stage.nb.cost.fold.sec": "9",
+                      "workflow.stage.mi.cost.fold.sec": "9",
+                      "workflow.stage.corr.cost.fold.sec": "9",
+                      "workflow.cost.scan.mb.per.sec": "100000"})):
+        msgs = []
+        props = _manifest(data, stages="bin,nb,mi,corr", **extra)
+        run_workflow(JobConfig(props), data["train"],
+                     str(tmp_path / tag), _job_resolver, mesh=mesh8,
+                     log=msgs.append)
+        decision = [m for m in msgs if "cost model" in m]
+        assert len(decision) == 1, msgs
+        if tag == "fuse":
+            assert "FUSE into one shared scan" in decision[0]
+        else:
+            assert "run separately" in decision[0]
+        outs[tag] = {sid: _read(str(tmp_path / tag), sid)
+                     for sid in ("nb", "mi", "corr")}
+    assert outs["fuse"] == outs["solo"]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end byte parity: DAG == standalone jobs with file handoff
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mesh_name", ["mesh1", "mesh8"])
+def test_canonical_pipeline_byte_parity(data, tmp_path, request, mesh_name):
+    """The full bin -> {NB,MI,corr} -> select -> retrain -> validate ->
+    publish DAG: every stage output — including the model bytes the
+    registry publish stage serves — is byte-identical to running the
+    constituent jobs standalone with text-file handoff."""
+    mesh = request.getfixturevalue(mesh_name)
+    alone = str(tmp_path / "alone")
+    _run_standalone_chain(data, alone, mesh)
+
+    wf = str(tmp_path / "wf")
+    props = _manifest(data, **{"workflow.fuse": "always"})
+    msgs = []
+    run_workflow(JobConfig(props), data["train"], wf, _job_resolver,
+                 mesh=mesh, log=msgs.append)
+    assert any("FUSE into one shared scan" in m for m in msgs), msgs
+    for sid in ("bin", "nb", "mi", "corr", "select", "retrain",
+                "validate"):
+        assert _read(wf, sid) == _read(alone, sid), sid
+    # the publish stage's output IS the bytes the registry adapter was
+    # built from — the served model equals the trained artifact
+    assert _read(wf, "publish") == _read(alone, "retrain")
+    # the correlation artifact-import hook round-trips the real output
+    from avenir_tpu.models.correlation import CategoricalCorrelation
+    triples = CategoricalCorrelation.parse_output(
+        _read(wf, "corr").splitlines())
+    assert triples and all(0.0 <= s <= 1.0 for _, _, s in triples)
+
+
+# ---------------------------------------------------------------------------
+# in-memory artifact handoff
+# ---------------------------------------------------------------------------
+
+def test_handoff_consumes_artifacts_from_memory(data, tmp_path, mesh8):
+    """Downstream stages consume upstream artifacts from the in-memory
+    overlay (memory reads observed), not by re-reading disk."""
+    msgs = []
+    run_workflow(JobConfig(_manifest(data)), data["train"],
+                 str(tmp_path / "wf"), _job_resolver, mesh=mesh8,
+                 log=msgs.append)
+    done = [m for m in msgs if "workflow complete" in m]
+    assert done and "in-memory artifact reads" in done[0]
+    n = int(done[0].split("—")[1].split("stages,")[1].split()[0])
+    assert n >= 5, done[0]
+
+
+def test_optional_sink_skips_the_file_write(data, tmp_path, mesh8):
+    """sink.file=false on an intermediate: no file lands on disk, the
+    downstream stage still consumes the artifact, and the terminal
+    outputs are byte-identical to the all-sinks run."""
+    base = str(tmp_path / "sinks")
+    run_workflow(JobConfig(_manifest(data, stages="bin,nb,mi,select")),
+                 data["train"], base, _job_resolver, mesh=mesh8)
+
+    nosink = str(tmp_path / "nosink")
+    props = _manifest(data, stages="bin,nb,mi,select",
+                      **{"workflow.stage.mi.sink.file": "false"})
+    run_workflow(JobConfig(props), data["train"], nosink, _job_resolver,
+                 mesh=mesh8)
+    assert not os.path.exists(os.path.join(nosink, "mi"))
+    assert _read(nosink, "select") == _read(base, "select")
+    assert _read(nosink, "nb") == _read(base, "nb")
+
+
+def test_handoff_parity_guard_catches_divergence(tmp_path):
+    """The overlay's first memory read asserts byte parity against the
+    file round-trip; a divergent file (simulated corruption) raises."""
+    from avenir_tpu.core.io import (ArtifactStore, read_lines,
+                                    set_artifact_store, write_output)
+
+    store = ArtifactStore(verify=True)
+    out = str(tmp_path / "art")
+    store.register(out)
+    prev = set_artifact_store(store)
+    try:
+        write_output(out, ["a,1", "b,2"])
+        with open(os.path.join(out, "part-r-00000"), "a") as fh:
+            fh.write("tampered,3\n")
+        with pytest.raises(AssertionError, match="handoff parity"):
+            list(read_lines(out))
+    finally:
+        set_artifact_store(prev)
+
+
+# ---------------------------------------------------------------------------
+# stage checkpoint/resume under injected faults
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mesh_name", ["mesh1", "mesh8"])
+def test_kill_inside_fused_scan_resume_skips_and_restarts_midscan(
+        data, tmp_path, request, mesh_name):
+    """Kill the workflow with an injected prefetch-worker death inside
+    the fused train stage group, resume with checkpoint.resume: stages
+    before the failure are SKIPPED (outputs untouched), the killed
+    shared scan restarts MID-SCAN from its sidecar, and the final
+    outputs are byte-identical to an uninterrupted workflow."""
+    mesh = request.getfixturevalue(mesh_name)
+    stages = "bin,nb,mi,select,retrain"
+    extra = {"checkpoint.interval.chunks": "2", "workflow.fuse": "always"}
+    ref = str(tmp_path / "ref")
+    run_workflow(JobConfig(_manifest(data, stages=stages, **extra)),
+                 data["train"], ref, _job_resolver, mesh=mesh)
+    want = {sid: _read(ref, sid) for sid in stages.split(",")}
+
+    out = str(tmp_path / "out")
+    faultinject.set_injector(FaultInjector(parse_plan("worker_death@5")))
+    with pytest.raises(RuntimeError, match="died without signaling"):
+        run_workflow(JobConfig(_manifest(data, stages=stages, **extra)),
+                     data["train"], out, _job_resolver, mesh=mesh)
+    faultinject.set_injector(None)
+    assert os.path.exists(os.path.join(out, "_workflow.ckpt"))
+    assert os.path.exists(os.path.join(out, "_dag_scan_mi+nb.ckpt"))
+    bin_mtime = os.path.getmtime(os.path.join(out, "bin", "part-r-00000"))
+
+    props = _manifest(data, stages=stages, **extra)
+    props["checkpoint.resume"] = "true"
+    msgs = []
+    run_workflow(JobConfig(props), data["train"], out, _job_resolver,
+                 mesh=mesh, log=msgs.append)
+    assert any("skipping completed stage 'bin'" in m for m in msgs), msgs
+    assert any("resuming from" in m and "byte offset" in m
+               for m in msgs), msgs
+    assert os.path.getmtime(
+        os.path.join(out, "bin", "part-r-00000")) == bin_mtime
+    assert {sid: _read(out, sid) for sid in want} == want
+    assert not os.path.exists(os.path.join(out, "_workflow.ckpt"))
+    assert not os.path.exists(os.path.join(out, "_dag_scan_mi+nb.ckpt"))
+
+
+def test_kill_inside_solo_stage_resume_skips_completed(data, tmp_path,
+                                                       mesh8):
+    """Same contract on a NON-fused stage: an injected H2D fault kills
+    the first training scan; resume skips the completed bin stage,
+    restarts the killed stage from its own mid-scan sidecar, and the
+    workflow finishes byte-identical."""
+    stages = "bin,nb,select2"
+    base = {"workflow.stage.select2.class": "org.chombo.mr.Projection",
+            "workflow.stage.select2.input": "nb",
+            "workflow.stage.select2.projection.operation": "project",
+            "workflow.stage.select2.projection.field": "0",
+            "checkpoint.interval.chunks": "2",
+            "workflow.fuse": "never"}
+    ref = str(tmp_path / "ref")
+    run_workflow(JobConfig(_manifest(data, stages=stages, **base)),
+                 data["train"], ref, _job_resolver, mesh=mesh8)
+    want = {sid: _read(ref, sid) for sid in stages.split(",")}
+
+    out = str(tmp_path / "out")
+    faultinject.set_injector(FaultInjector(parse_plan("h2d@5")))
+    with pytest.raises(faultinject.InjectedFault):
+        run_workflow(JobConfig(_manifest(data, stages=stages, **base)),
+                     data["train"], out, _job_resolver, mesh=mesh8)
+    faultinject.set_injector(None)
+    assert os.path.exists(os.path.join(out, "nb") + ".ckpt"), \
+        "killed stage must leave its mid-scan sidecar"
+
+    props = _manifest(data, stages=stages, **base)
+    props["checkpoint.resume"] = "true"
+    msgs = []
+    run_workflow(JobConfig(props), data["train"], out, _job_resolver,
+                 mesh=mesh8, log=msgs.append)
+    assert any("skipping completed stage 'bin'" in m for m in msgs), msgs
+    assert {sid: _read(out, sid) for sid in want} == want
+    assert not os.path.exists(os.path.join(out, "nb") + ".ckpt")
+
+
+def test_regrouped_resume_sweeps_stale_scan_sidecars(data, tmp_path,
+                                                     mesh8):
+    """A resume whose grouping differs from the killed run's (fuse flag
+    flipped) never loads the old fused-group sidecar — and the
+    completed workflow must still sweep it, leaving NO sidecar behind."""
+    stages = "bin,nb,mi"
+    extra = {"checkpoint.interval.chunks": "2", "workflow.fuse": "always"}
+    out = str(tmp_path / "out")
+    faultinject.set_injector(FaultInjector(parse_plan("worker_death@5")))
+    with pytest.raises(RuntimeError):
+        run_workflow(JobConfig(_manifest(data, stages=stages, **extra)),
+                     data["train"], out, _job_resolver, mesh=mesh8)
+    faultinject.set_injector(None)
+    stale = os.path.join(out, "_dag_scan_mi+nb.ckpt")
+    assert os.path.exists(stale)
+
+    props = _manifest(data, stages=stages, **dict(
+        extra, **{"workflow.fuse": "never"}))
+    props["checkpoint.resume"] = "true"
+    run_workflow(JobConfig(props), data["train"], out, _job_resolver,
+                 mesh=mesh8)
+    assert not os.path.exists(stale), "stale group sidecar not swept"
+    assert not os.path.exists(os.path.join(out, "_workflow.ckpt"))
+
+
+def test_dataset_sized_outputs_stay_out_of_the_overlay(data, tmp_path,
+                                                       mesh8):
+    """Only artifacts consumed THROUGH the overlay (@refs + built-in
+    stage inputs) are registered: the bin projection's dataset-sized
+    output — byte-scanned from disk by the trainers — must not be
+    pinned in host memory for the workflow's lifetime."""
+    from avenir_tpu.core.dag import load_workflow, overlay_consumed
+    from avenir_tpu.core.io import ArtifactStore, set_artifact_store
+
+    stages = load_workflow(JobConfig(_manifest(data)), data["train"],
+                           str(tmp_path / "o"))
+    assert overlay_consumed(stages) == {"mi", "select", "retrain"}
+
+    captured = {}
+    orig_register = ArtifactStore.register
+
+    def spy(self, out_path, sink_file=True):
+        captured.setdefault(id(self), set()).add(
+            os.path.basename(out_path))
+        return orig_register(self, out_path, sink_file=sink_file)
+
+    ArtifactStore.register = spy
+    try:
+        run_workflow(JobConfig(_manifest(data)), data["train"],
+                     str(tmp_path / "wf"), _job_resolver, mesh=mesh8)
+    finally:
+        ArtifactStore.register = orig_register
+        set_artifact_store(None)
+    (registered,) = captured.values()
+    assert registered == {"mi", "select", "retrain"}
+
+
+def test_resume_reruns_stage_whose_config_changed(data, tmp_path, mesh8):
+    """A recorded stage whose params changed (different top-K) must NOT
+    be skipped on resume — the params hash catches it — while stages
+    with unchanged params still skip."""
+    stages = "bin,nb,mi,select,retrain"
+    out = str(tmp_path / "out")
+    # fail AFTER select completes: retrain's output path sits under a
+    # regular file, so bin/nb/mi/select are all recorded when the
+    # workflow dies
+    (tmp_path / "blocker").write_text("not a directory\n")
+    props = _manifest(data, stages=stages, **{
+        "workflow.fuse": "never",
+        "workflow.stage.retrain.output.path":
+            str(tmp_path / "blocker" / "retrain")})
+    with pytest.raises(OSError):
+        run_workflow(JobConfig(props), data["train"], out, _job_resolver,
+                     mesh=mesh8)
+    assert os.path.exists(os.path.join(out, "_workflow.ckpt"))
+
+    props = _manifest(data, stages=stages, **{
+        "workflow.fuse": "never",
+        "workflow.stage.select.select.top.features": "2"})
+    props["checkpoint.resume"] = "true"
+    msgs = []
+    run_workflow(JobConfig(props), data["train"], out, _job_resolver,
+                 mesh=mesh8, log=msgs.append)
+    skipped = {m.split("'")[1] for m in msgs if "skipping" in m}
+    assert {"bin", "nb", "mi"} <= skipped, msgs
+    assert "select" not in skipped, msgs
+    sel = json.loads(open(os.path.join(out, "select")).read())
+    kept = [f["name"] for f in sel["fields"] if f.get("feature")]
+    assert len(kept) == 2
+
+
+def test_resume_invalidates_consumers_of_rewritten_artifacts(
+        data, tmp_path, mesh8):
+    """An upstream stage that re-runs on resume (changed params) and
+    rewrites its artifact at the SAME path must invalidate every
+    downstream consumer's completion record: retrain was recorded done
+    against the top-4 schema, so when select re-runs with top-2 it must
+    NOT be skipped — and the resumed workflow's outputs must equal a
+    fresh run with the new selection."""
+    stages = "bin,nb,mi,select,retrain,final"
+    base = {"workflow.fuse": "never",
+            "workflow.stage.final.class": "org.chombo.mr.Projection",
+            "workflow.stage.final.input": "retrain",
+            "workflow.stage.final.projection.operation": "project",
+            "workflow.stage.final.projection.field": "0"}
+    out = str(tmp_path / "out")
+    # fail AFTER retrain completes: final's output path sits under a
+    # regular file, so bin..retrain are all recorded when the run dies
+    (tmp_path / "blocker").write_text("not a directory\n")
+    props = _manifest(data, stages=stages, **dict(
+        base, **{"workflow.stage.final.output.path":
+                 str(tmp_path / "blocker" / "final")}))
+    with pytest.raises(OSError):
+        run_workflow(JobConfig(props), data["train"], out, _job_resolver,
+                     mesh=mesh8)
+    assert os.path.exists(os.path.join(out, "_workflow.ckpt"))
+
+    props = _manifest(data, stages=stages, **base)
+    props["workflow.stage.select.select.top.features"] = "2"
+    props["checkpoint.resume"] = "true"
+    msgs = []
+    run_workflow(JobConfig(props), data["train"], out, _job_resolver,
+                 mesh=mesh8, log=msgs.append)
+    skipped = {m.split("'")[1] for m in msgs if "skipping" in m}
+    assert {"bin", "nb", "mi"} <= skipped, msgs
+    assert "select" not in skipped, msgs
+    assert "retrain" not in skipped, \
+        "retrain consumed the rewritten @select artifact — stale skip"
+
+    fresh = str(tmp_path / "fresh")
+    props = _manifest(data, stages=stages, **base)
+    props["workflow.stage.select.select.top.features"] = "2"
+    run_workflow(JobConfig(props), data["train"], fresh, _job_resolver,
+                 mesh=mesh8)
+    for sid in ("select", "retrain", "final"):
+        assert _read(out, sid) == _read(fresh, sid), sid
+
+
+# ---------------------------------------------------------------------------
+# built-in stages
+# ---------------------------------------------------------------------------
+
+def test_feature_select_rewrites_schema(data, tmp_path, mesh8):
+    modname, clsname, prefix = resolve("MutualInformation")
+    _lazy(modname, clsname)(JobConfig(dict(
+        {"feature.schema.file.path": data["schema"]}, **PIPE),
+        prefix)).run(data["train"], str(tmp_path / "mi"), mesh=mesh8)
+    sel = dag.FeatureSelect(JobConfig({
+        "select.schema.file.path": data["schema"],
+        "select.top.features": "3"}))
+    counters = sel.run(str(tmp_path / "mi"), str(tmp_path / "sel"))
+    assert counters.get("Select", "Features kept") == 3
+    assert counters.get("Select", "Features dropped") == 3
+    doc = json.loads(open(str(tmp_path / "sel")).read())
+    by_name = {f["name"]: f for f in doc["fields"]}
+    assert by_name["churned"]["classAttr"] is True
+    assert sum(1 for f in doc["fields"] if f.get("feature")) == 3
+    # the rewritten schema still loads as a FeatureSchema with the same
+    # class attribute
+    from avenir_tpu.core.schema import FeatureSchema
+    fs = FeatureSchema.from_file(str(tmp_path / "sel"))
+    assert fs.class_attr_field().name == "churned"
+    assert len(fs.feature_fields()) == 3
+
+    with pytest.raises(WorkflowConfigError, match="ranks only"):
+        dag.FeatureSelect(JobConfig({
+            "select.schema.file.path": data["schema"],
+            "select.top.features": "99"})).run(str(tmp_path / "mi"),
+                                               str(tmp_path / "sel99"))
+
+
+def test_correlation_parse_output_strict():
+    """The correlation artifact-import hook raises on malformed lines
+    instead of silently yielding a shorter result."""
+    from avenir_tpu.models.correlation import CategoricalCorrelation
+
+    assert (CategoricalCorrelation.parse_output(["plan,churned,0.5"])
+            == [("plan", "churned", 0.5)])
+    for bad in (["plan,churned"], ["a,b,xyz"], ["a,b,c,0.5"]):
+        with pytest.raises(ValueError, match="malformed correlation"):
+            CategoricalCorrelation.parse_output(bad)
+
+
+def test_mi_parse_scores_rejects_malformed_score_lines():
+    """A garbled line inside a score section (partial write, hand edit)
+    must raise naming the line — not silently truncate the ranking a
+    feature-select stage consumes."""
+    from avenir_tpu.models.mutual_info import MutualInformation
+
+    good = ["mutualInformationScoreAlgorithm: mutual.info.maximization",
+            "2,0.5", "1,0.25"]
+    assert MutualInformation.parse_scores(good) == [(2, 0.5), (1, 0.25)]
+    with pytest.raises(ValueError, match="malformed score line"):
+        MutualInformation.parse_scores(
+            good + ["garbage,0.1", "3,0.05"])
+
+
+def test_registry_publish_builds_a_servable_entry(data, tmp_path, mesh8):
+    modname, clsname, prefix = resolve("BayesianDistribution")
+    _lazy(modname, clsname)(JobConfig(dict(
+        {"feature.schema.file.path": data["schema"]}, **PIPE),
+        prefix)).run(data["train"], str(tmp_path / "model"), mesh=mesh8)
+    pub = dag.RegistryPublish(JobConfig({
+        "publish.model.name": "churn",
+        "feature.schema.file.path": data["schema"]}))
+    counters = pub.run(str(tmp_path / "model"), str(tmp_path / "pub"),
+                       mesh=mesh8)
+    assert counters.get("Registry", "Published versions") == 1
+    assert (_read(str(tmp_path), "pub")
+            == _read(str(tmp_path), "model"))
+
+
+# ---------------------------------------------------------------------------
+# the `dag` CLI
+# ---------------------------------------------------------------------------
+
+def test_dag_cli_end_to_end(data, tmp_path, capsys):
+    from avenir_tpu import cli
+
+    props = _manifest(data, stages="bin,nb,mi,select")
+    (tmp_path / "workflow.properties").write_text(
+        "\n".join(f"{k}={v}" for k, v in props.items()) + "\n")
+    rc = cli.main(["dag",
+                   f"-Dconf.path={tmp_path}/workflow.properties",
+                   data["train"], str(tmp_path / "out")])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "--- stage nb" in err and "--- stage select" in err
+    assert "workflow complete" in err
+    assert os.path.exists(os.path.join(str(tmp_path / "out"), "nb",
+                                       "part-r-00000"))
+    assert os.path.exists(os.path.join(str(tmp_path / "out"), "select"))

@@ -192,6 +192,63 @@ def test_mi_counts_rows_beyond_declared_max(tmp_path, mesh8):
     assert any(l.startswith("0,19,") for l in lines)  # the out-of-range bin
 
 
+def test_mi_pair_table_budget_guard(tmp_path):
+    """The MI pair tables are quadratic in features AND bins
+    (PC[pair, b1, b2, class]); against a declared
+    pipeline.device.budget.bytes the job must fail fast at
+    construction — before any input is read or device memory is
+    touched — with the byte estimate and the knobs named, instead of
+    an opaque OOM mid-fold."""
+    from avenir_tpu.models.mutual_info import pair_table_bytes
+
+    spath = str(tmp_path / "schema.json")
+    with open(spath, "w") as f:
+        json.dump(MI_SCHEMA, f)
+    # 6 features, max 12 bins (minUsed: 2200/200 + 1), 2 classes
+    est = pair_table_bytes(6, 12, 2)
+    assert est == 4 * (15 * 12 * 12 * 2 + 2 * 6 * 12)
+
+    with pytest.raises(ValueError) as ei:
+        MutualInformation(JobConfig({
+            "feature.schema.file.path": spath,
+            "pipeline.device.budget.bytes": str(est - 1)}))
+    msg = str(ei.value)
+    assert f"~{est} bytes" in msg
+    assert "pipeline.device.budget.bytes" in msg
+    assert "bucketWidth" in msg and "feature" in msg
+
+    # a sufficient budget (or none at all) constructs fine
+    MutualInformation(JobConfig({
+        "feature.schema.file.path": spath,
+        "pipeline.device.budget.bytes": str(est)}))
+    MutualInformation(JobConfig({"feature.schema.file.path": spath}))
+
+
+def test_mi_budget_guard_catches_discovered_growth(tmp_path, mesh8):
+    """Bins DISCOVERED mid-stream (values past the declared max) grow
+    the pair tables past the declared-extent estimate; the re-check at
+    cap sizing catches that too, still before the fold allocates."""
+    schema = {"fields": [
+        {"name": "v", "ordinal": 0, "dataType": "int", "feature": True,
+         "min": 0, "max": 10, "bucketWidth": 5},
+        {"name": "u", "ordinal": 1, "dataType": "int", "feature": True,
+         "min": 0, "max": 10, "bucketWidth": 5},
+        {"name": "c", "ordinal": 2, "dataType": "categorical",
+         "cardinality": ["A", "B"]}]}
+    spath = str(tmp_path / "s.json")
+    with open(spath, "w") as f:
+        json.dump(schema, f)
+    from avenir_tpu.models.mutual_info import pair_table_bytes
+    declared_est = pair_table_bytes(2, 3, 2)
+    # 9995 -> bin 1999: fine under the declared estimate, huge discovered
+    write_output(str(tmp_path / "in"), ["9995,3,A", "3,7,B", "7,2,A"])
+    job = MutualInformation(JobConfig({
+        "feature.schema.file.path": spath,
+        "pipeline.device.budget.bytes": str(declared_est + 4096)}))
+    with pytest.raises(ValueError, match="pair tables need"):
+        job.run(str(tmp_path / "in"), str(tmp_path / "out"), mesh=mesh8)
+
+
 def test_cramer_and_heterogeneity(tmp_path, mesh8):
     # two perfectly-correlated categoricals and one independent
     rng = np.random.default_rng(3)

@@ -289,6 +289,71 @@ def test_streaming_accepts_reference_typo_keys():
     assert loop.learner.find_action("x") is not None
 
 
+def test_redis_transport_round_trip_wire_protocol():
+    """The REAL RedisTransport against the in-process FakeRedis: the
+    reference's producers lpush `eventID,roundNum` events and
+    `actionID,reward` rewards, the loop consumes them via the
+    transport's rpop protocol, and the action queue round-trips
+    `eventID,action` messages in the order the reference's consumer
+    would rpop them."""
+    from avenir_tpu.models.streaming import FakeRedis, RedisTransport
+
+    fake = FakeRedis()
+    transport = RedisTransport("unused", 0, "events", "rewards",
+                               "actions", client=fake)
+    config = {"reinforcement.learner.type": "randomGreedy",
+              "reinforcement.learner.actions": "a,b",
+              "random.seed": "5", "batch.size": "1"}
+    loop = StreamingLearnerLoop(config, transport)
+
+    for i in range(3):
+        fake.lpush("events", f"e{i},{i}")       # producer side
+    fake.lpush("rewards", "a,70", "b,20")
+    assert loop.run(max_events=3, idle_timeout=0.0) == 3
+    assert loop.reward_count == 2
+    assert fake.llen("events") == 0             # drained rpop-side
+    assert fake.llen("rewards") == 0
+    # consumer-side FIFO: rpop returns the messages oldest-first, one
+    # `eventID,action` line per event, actions from the declared set
+    popped = [transport._r.rpop("actions") for _ in range(3)]
+    assert [m.split(",")[0] for m in popped] == ["e0", "e1", "e2"]
+    assert all(m.split(",")[1] in ("a", "b") for m in popped)
+    assert fake.rpop("actions") is None
+
+
+def test_redis_transport_built_from_reference_config_keys(monkeypatch):
+    """The config-driven construction path (redis.server.host/port +
+    queue names) builds a RedisTransport through the redis package
+    surface — covered by stubbing the module with FakeRedis."""
+    import sys
+    import types
+
+    from avenir_tpu.models.streaming import FakeRedis
+
+    seen = {}
+
+    def fake_redis_ctor(host, port, decode_responses):
+        seen.update(host=host, port=port, decode=decode_responses)
+        return FakeRedis()
+
+    stub = types.ModuleType("redis")
+    stub.Redis = fake_redis_ctor
+    monkeypatch.setitem(sys.modules, "redis", stub)
+    loop = StreamingLearnerLoop({
+        "reinforcement.learner.type": "randomGreedy",
+        "reinforcement.learner.actions": "x,y",
+        "random.seed": "1",
+        "redis.server.host": "queues.example",
+        "redis.server.port": "6379",
+        "redis.event.queue": "ev", "redis.reward.queue": "rw",
+        "redis.action.queue": "ac"})
+    assert seen == {"host": "queues.example", "port": 6379,
+                    "decode": True}
+    loop.transport._r.lpush("ev", "e1,1")
+    assert loop.step() is True
+    assert loop.transport._r.llen("ac") == 1
+
+
 def test_softmax_decay_divisor_matches_reference():
     """SoftMaxLearner.java:97 subtracts the raw minTrial (default -1), so
     with min.trial unset the decay divisor is totalTrialCount + 1."""
