@@ -15,9 +15,13 @@ Config surface (the .properties files every job loads):
 
 - ``fault.inject.plan`` — semicolon/comma-separated entries::
 
-      <point>@<index>[-<index2>|*][x<count>][:<arg>]
+      <point>[<tag>]@<index>[-<index2>|*][x<count>][:<arg>]
 
-  e.g. ``read@0-1`` (the first two file-read attempts raise a transient
+  The optional ``[<tag>]`` qualifier restricts an entry to call sites
+  firing with that tag (serving batchers tag scorer points with their
+  model VARIANT, so ``scorer_slow[f32]@*:40`` slows only the f32
+  variant — the router-demotion test); untagged entries fire at every
+  site.  e.g. ``read@0-1`` (the first two file-read attempts raise a transient
   I/O error, the third succeeds — the retry path; auto-indexed points
   count every CALL, so consecutive failures are index ranges, while
   ``x<count>`` repeats a fault at one explicit chunk index across
@@ -101,22 +105,28 @@ class SimulatedWorkerDeath(BaseException):
 
 
 class _Entry:
-    __slots__ = ("point", "lo", "hi", "count", "arg")
+    __slots__ = ("point", "lo", "hi", "count", "arg", "tag")
 
     def __init__(self, point: str, lo: int, hi: Optional[int],
-                 count: int, arg: Optional[str]):
+                 count: int, arg: Optional[str], tag: Optional[str] = None):
         self.point = point
         self.lo = lo
         self.hi = hi          # None = unbounded (the `*` index)
         self.count = count    # firings per matched index (x<count>)
         self.arg = arg
+        self.tag = tag        # None = any call site; else only sites
+        #                       firing with this tag (e.g. a serving
+        #                       scorer variant: scorer_slow[f32]@*)
 
-    def matches(self, index: int) -> bool:
+    def matches(self, index: int, tag: Optional[str] = None) -> bool:
+        if self.tag is not None and tag != self.tag:
+            return False
         return index >= self.lo and (self.hi is None or index <= self.hi)
 
     def __repr__(self):
         hi = "*" if self.hi is None else self.hi
-        return (f"_Entry({self.point}@{self.lo}-{hi}"
+        t = f"[{self.tag}]" if self.tag else ""
+        return (f"_Entry({self.point}{t}@{self.lo}-{hi}"
                 f"x{self.count}:{self.arg})")
 
 
@@ -132,6 +142,16 @@ def parse_plan(text: str) -> List[_Entry]:
             raise ValueError(f"bad fault plan entry (no '@'): {s!r}")
         point, _, spec = s.partition("@")
         point = point.strip()
+        tag: Optional[str] = None
+        if point.endswith("]") and "[" in point:
+            # optional call-site tag qualifier: point[tag]@spec — the
+            # entry fires only at sites passing fire(..., tag=<tag>)
+            # (e.g. one serving scorer VARIANT: scorer_slow[f32]@*:40)
+            point, _, tag = point[:-1].partition("[")
+            point = point.strip()
+            tag = tag.strip()
+            if not tag:
+                raise ValueError(f"empty tag qualifier in {s!r}")
         if point not in POINTS:
             raise ValueError(
                 f"unknown fault point {point!r}; known: {', '.join(POINTS)}")
@@ -152,7 +172,7 @@ def parse_plan(text: str) -> List[_Entry]:
             lo, hi = int(a), int(b)
         else:
             lo = hi = int(spec)
-        entries.append(_Entry(point, lo, hi, count, arg))
+        entries.append(_Entry(point, lo, hi, count, arg, tag))
     return entries
 
 
@@ -176,22 +196,31 @@ class FaultInjector:
         self.fired_log: List[Tuple[str, int]] = []
 
     # -- index bookkeeping -------------------------------------------------
-    def _next_index(self, point: str) -> int:
+    def _next_index(self, point: str, tag: Optional[str] = None) -> int:
+        # per-(point, tag) occurrence counters so tagged call sites
+        # (e.g. two scorer variants) keep deterministic indices no
+        # matter how their firings interleave
+        key = point if tag is None else f"{point}[{tag}]"
         with self._lock:
-            i = self._auto.get(point, 0)
-            self._auto[point] = i + 1
+            i = self._auto.get(key, 0)
+            self._auto[key] = i + 1
             return i
 
-    def _due(self, point: str, index: Optional[int]):
-        """The first still-armed entry matching (point, index), consuming
-        one firing; None when nothing fires."""
+    def _due(self, point: str, index: Optional[int],
+             tag: Optional[str] = None):
+        """The first still-armed entry matching (point, index, tag),
+        consuming one firing; None when nothing fires."""
         if index is None:
-            index = self._next_index(point)
+            index = self._next_index(point, tag)
         with self._lock:
             for eid, e in enumerate(self.plan):
-                if e.point != point or not e.matches(index):
+                if e.point != point or not e.matches(index, tag):
                     continue
-                k = (eid, index)
+                # the fired budget is keyed per call-site tag too: an
+                # UNTAGGED entry like scorer@0 fires at each tagged
+                # site's own index 0 (deterministic per site) instead
+                # of being consumed by whichever site races there first
+                k = (eid, index, tag)
                 if self._fired.get(k, 0) >= e.count:
                     continue
                 self._fired[k] = self._fired.get(k, 0) + 1
@@ -200,10 +229,13 @@ class FaultInjector:
         return None
 
     # -- the injection points ----------------------------------------------
-    def fire(self, point: str, index: Optional[int] = None) -> None:
+    def fire(self, point: str, index: Optional[int] = None,
+             tag: Optional[str] = None) -> None:
         """Raise/sleep per the plan at an instrumented point (no-op when
-        no armed entry matches)."""
-        e = self._due(point, index)
+        no armed entry matches).  ``tag`` identifies the call site for
+        tag-qualified plan entries (``point[tag]@...``); untagged
+        entries fire regardless of the site's tag."""
+        e = self._due(point, index, tag)
         if e is None:
             return
         where = f"{point}@{index if index is not None else 'auto'}"

@@ -526,8 +526,21 @@ class MarkovModelClassifier:
         self.model = MarkovModel.load(cfg.must("mm.model.path"), class_based)
         self.class_labels = cfg.must("class.labels").split(",")
         self.threshold = cfg.get_float("log.odds.threshold", 0.0)
-        self._t0 = jnp.asarray(self.model.class_trans[self.class_labels[0]])
-        self._t1 = jnp.asarray(self.model.class_trans[self.class_labels[1]])
+        # mmc.score.precision=float32 casts the transition tables (and so
+        # the whole log-odds sum) to f32 — the fast serving VARIANT of
+        # this classifier.  Batch and serve share this code path, so a
+        # batch run with the same key is byte-identical to the variant's
+        # online responses (asserted in tests/test_pool.py).
+        self.score_precision = cfg.get("mmc.score.precision", "float64")
+        if self.score_precision not in ("float64", "float32"):
+            raise ValueError(
+                f"invalid mmc.score.precision: {self.score_precision}")
+        dt = (jnp.float32 if self.score_precision == "float32"
+              else jnp.float64)
+        self._t0 = jnp.asarray(
+            self.model.class_trans[self.class_labels[0]], dtype=dt)
+        self._t1 = jnp.asarray(
+            self.model.class_trans[self.class_labels[1]], dtype=dt)
         self._prepared = True
 
     def min_fields(self) -> int:

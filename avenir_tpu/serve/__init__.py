@@ -18,10 +18,23 @@ PAPERS.md "Online serving").
   to ``serve.batch.max.size`` or ``serve.batch.max.delay.ms``, score as one
   padded bucket, and scatter back to per-request futures; admission control
   (``serve.queue.max.depth``) sheds on overflow instead of OOMing.
-- ``server``   — stdlib JSON-lines TCP frontend + the ``python -m
-  avenir_tpu serve`` CLI entry, exporting per-model counters (requests,
-  batches, shed, batch-fill, p50/p95/p99 latency) through ``Counters``.
-- ``breaker``  — per-model circuit breaker (open after K consecutive
+- ``frontend`` — non-blocking ``selectors`` event-loop TCP frontend:
+  one acceptor + a few I/O shard threads multiplex many thousands of
+  open sockets (connections cost file descriptors, not threads), with
+  per-connection response ordering, bounded read buffers, pipelining
+  backpressure, and graceful drain.
+- ``pool``     — replica scorer pool: N batcher+scorer replicas per
+  (model, variant), pinned round-robin across local devices,
+  least-loaded dispatch by queue depth; hot-swap reload and the circuit
+  breaker are per-replica.
+- ``router``   — SLO-aware variant router (INFaaS-style): requests carry
+  an optional ``slo_ms`` hint and the router picks the cheapest variant
+  whose rolling windowed p99 meets it, demoting soft-degraded or
+  breaker-open variants to their siblings before any request fails.
+- ``server``   — request routing + the ``python -m avenir_tpu serve``
+  CLI entry, exporting per-model counters (requests, batches, shed,
+  batch-fill, p50/p95/p99 latency) through ``Counters``.
+- ``breaker``  — per-replica circuit breaker (open after K consecutive
   scorer failures, half-open probes) behind the graceful-degradation
   surface: deadlines, degraded health, and a watchdog that restarts dead
   batcher workers (README "Fault tolerance").
@@ -30,10 +43,16 @@ PAPERS.md "Online serving").
 from .batcher import MicroBatcher, ShedError                    # noqa: F401
 from .breaker import CircuitBreaker, CircuitOpenError           # noqa: F401
 from .engine import ADAPTER_KINDS, pow2_bucket                  # noqa: F401
+from .frontend import EventLoopFrontend                         # noqa: F401
+from .pool import ScorerPool                                    # noqa: F401
 from .registry import ModelRegistry                             # noqa: F401
-from .server import PredictionServer, serve_main                # noqa: F401
+from .router import VariantRouter                               # noqa: F401
+from .server import (PredictionServer, TruncatedResponseError,  # noqa: F401
+                     serve_main)
 from .slo import SLOBoard                                       # noqa: F401
 
 __all__ = ["ADAPTER_KINDS", "CircuitBreaker", "CircuitOpenError",
-           "MicroBatcher", "ModelRegistry", "PredictionServer",
-           "SLOBoard", "ShedError", "pow2_bucket", "serve_main"]
+           "EventLoopFrontend", "MicroBatcher", "ModelRegistry",
+           "PredictionServer", "SLOBoard", "ScorerPool", "ShedError",
+           "TruncatedResponseError", "VariantRouter", "pow2_bucket",
+           "serve_main"]

@@ -82,10 +82,15 @@ class MicroBatcher:
                  max_queue_depth: int = 256,
                  hist_buckets: Optional[int] = None,
                  deadline_ms: float = 0.0,
-                 breaker: Optional[CircuitBreaker] = None):
+                 breaker: Optional[CircuitBreaker] = None,
+                 fault_tag: Optional[str] = None):
         self.name = name
         self.predict_fn = predict_fn
         self.counters = counters
+        # call-site tag for the scorer fault points: a replica pool sets
+        # the model VARIANT so a plan like scorer_slow[f32]@*:40 slows
+        # exactly one variant's scorers (the router-demotion test)
+        self.fault_tag = fault_tag
         self.max_batch = max(1, int(max_batch))
         self.max_delay = max(0.0, float(max_delay_ms)) / 1000.0
         self.max_queue_depth = max(1, int(max_queue_depth))
@@ -110,16 +115,20 @@ class MicroBatcher:
         return t
 
     # -- client side -------------------------------------------------------
-    def submit(self, line: str) -> Future:
-        """Enqueue one request line; the Future resolves to the output
-        line (or raises).  Sheds with ShedError past the depth limit;
-        fails fast with CircuitOpenError while the model's breaker is
-        open."""
+    def _admit(self) -> None:
+        """One breaker admission check shared by both wire paths."""
         if self.breaker is not None and not self.breaker.allow():
             self.counters.incr(SERVE_GROUP, "Breaker rejected")
             raise CircuitOpenError(
                 f"model {self.name!r} circuit breaker is "
                 f"{self.breaker.state} after consecutive scorer failures")
+
+    def submit(self, line: str) -> Future:
+        """Enqueue one request line; the Future resolves to the output
+        line (or raises).  Sheds with ShedError past the depth limit;
+        fails fast with CircuitOpenError while the model's breaker is
+        open."""
+        self._admit()
         req = _Request(line, self.deadline_s)
         with self._cv:
             if self._closed:
@@ -134,6 +143,35 @@ class MicroBatcher:
         # it now so this request is not parked behind a dead thread
         self.ensure_worker()
         return req.future
+
+    def submit_many(self, lines: List[str]):
+        """Enqueue a client-side batch under ONE lock round (the wire
+        protocol's ``"rows": [...]`` shape): returns ``(futures, shed)``
+        where rows past the queue-depth limit hold ``None`` and count
+        into ``shed``.  One breaker admission guards the whole wire
+        request (a half-open probe window admits client batches, not
+        rows).  Amortizes the per-row lock/notify/liveness cost that
+        dominates the event-loop frontend's submit path under load."""
+        self._admit()
+        futures: List[Optional[Future]] = []
+        shed = 0
+        with self._cv:
+            if self._closed:
+                raise RuntimeError(f"batcher {self.name} is closed")
+            room = self.max_queue_depth - len(self._q)
+            for line in lines:
+                if room <= 0:
+                    self.counters.incr(SERVE_GROUP, "Shed")
+                    futures.append(None)
+                    shed += 1
+                    continue
+                req = _Request(line, self.deadline_s)
+                self._q.append(req)
+                room -= 1
+                futures.append(req.future)
+            self._cv.notify()
+        self.ensure_worker()
+        return futures, shed
 
     # -- worker side -------------------------------------------------------
     def _drain_batch(self) -> List[_Request]:
@@ -223,8 +261,9 @@ class MicroBatcher:
                                      batch=len(batch)):
                         fi_score = faultinject.get_injector()
                         if fi_score is not None:
-                            fi_score.fire("scorer")
-                            fi_score.fire("scorer_slow")
+                            fi_score.fire("scorer", tag=self.fault_tag)
+                            fi_score.fire("scorer_slow",
+                                          tag=self.fault_tag)
                         outputs = self.predict_fn([r.line for r in batch])
                 except Exception as e:                 # noqa: BLE001
                     self.counters.incr(SERVE_GROUP, "Batch errors")

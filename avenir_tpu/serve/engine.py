@@ -38,6 +38,29 @@ from ..utils.caches import bounded_cache_get, bounded_cache_put
 
 SERVE_GROUP = "Serve"
 
+#: Built-in scorer VARIANT presets per adapter kind (INFaaS-style
+#: model-less variants, PAPERS.md): naming a preset variant in
+#: ``serve.model.<name>.variants`` applies its config overlay to the
+#: model's scoring config and declares its latency/accuracy class —
+#: ``f32`` is the fast log-space path, ``f64`` the strict-parity path
+#: (the two NB scorer implementations benchmarked at 324M vs 3.5M
+#: rows/s in BASELINE.md).  Non-preset variant names declare their
+#: overlay explicitly via ``serve.model.<name>.variant.<v>.<key>``.
+VARIANT_PRESETS: Dict[str, Dict[str, dict]] = {
+    "naiveBayes": {
+        "f32": {"overlay": {"bp.score.precision": "float32"},
+                "latency_class": "fast", "accuracy_class": "standard"},
+        "f64": {"overlay": {"bp.score.precision": "float64"},
+                "latency_class": "standard", "accuracy_class": "parity"},
+    },
+    "markovClassifier": {
+        "f32": {"overlay": {"mmc.score.precision": "float32"},
+                "latency_class": "fast", "accuracy_class": "standard"},
+        "f64": {"overlay": {"mmc.score.precision": "float64"},
+                "latency_class": "standard", "accuracy_class": "parity"},
+    },
+}
+
 
 def pow2_bucket(n: int, cap: Optional[int] = None) -> int:
     """Smallest power of two >= n (>= 1), optionally capped."""

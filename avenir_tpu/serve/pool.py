@@ -1,0 +1,523 @@
+"""Replica scorer pool: N batcher+scorer replicas per model variant.
+
+PR 2's serving stack batched every model onto ONE scorer behind one
+dispatch worker — a single device serializes the whole model's traffic
+(the ~7.7k rows/s single-replica ceiling in BASELINE.md).  This module
+is the ROADMAP item 2 rewrite: each (model, variant) owns a POOL of
+replicas — one complete adapter + micro-batcher + circuit breaker per
+replica, pinned round-robin across the mesh's local devices when there
+is more than one — and requests dispatch to the LEAST-LOADED replica by
+queue depth (Clipper's adaptive-batching tier, scaled horizontally).
+
+Structure:
+
+- :class:`Replica`       — one adapter + batcher + breaker.  Hot-swap
+  reload and the circuit breaker are PER-REPLICA: one replica rebuilding
+  (or tripped open) keeps serving traffic on its siblings.
+- :class:`VariantGroup`  — a variant's replica set + the aggregated
+  stats facade the rolling SLO monitor (serve/slo.py) observes, plus the
+  variant-level soft-degrade bit the router reads.
+- :class:`ScorerPool`    — every model's ordered variant groups; owns
+  build/reload/close and the least-loaded submit path.
+
+Config surface (serve.properties; README "Online serving"):
+
+- ``serve.pool.replicas`` — replicas per (model, variant): an int, or
+  ``auto`` for one per local device (default 1); per-model override
+  ``serve.model.<name>.pool.replicas``.
+
+Dispatch semantics: ``submit`` tries replicas in ascending queue-depth
+order; a replica whose breaker is open (or whose queue sheds) is skipped
+and the next one tried, so a single replica failure degrades capacity,
+not availability.  Only when EVERY replica refuses does the caller see
+the error — sheds win over breaker errors so overload still reads as
+overload.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ..core.metrics import Counters
+from .batcher import MicroBatcher, ShedError
+from .breaker import CircuitBreaker, CircuitOpenError
+from .registry import DEFAULT_VARIANT, ModelEntry, ModelRegistry
+
+KEY_REPLICAS = "serve.pool.replicas"
+DEFAULT_REPLICAS = 1
+
+SERVE_GROUP = "Serve"
+
+
+def _resolve_replicas(config, model: str) -> int:
+    """Replica count for one model: per-model override, then the global
+    ``serve.pool.replicas`` (``auto`` = one per local JAX device)."""
+    raw = config.get(f"serve.model.{model}.pool.replicas")
+    if raw is None:
+        raw = config.get(KEY_REPLICAS, str(DEFAULT_REPLICAS))
+    raw = str(raw).strip()
+    if raw == "auto":
+        import jax
+        return max(1, len(jax.local_devices()))
+    n = int(raw)
+    if n < 1:
+        raise ValueError(f"serve.pool.replicas must be >= 1 or auto: {raw}")
+    return n
+
+
+def _devices_for(n_replicas: int) -> List[Optional[object]]:
+    """Round-robin device assignment: None (default device) on
+    single-device hosts, else local devices cycled across replicas."""
+    import jax
+    devs = jax.local_devices()
+    if len(devs) <= 1:
+        return [None] * n_replicas
+    return [devs[i % len(devs)] for i in range(n_replicas)]
+
+
+def _pin(fn: Callable, device) -> Callable:
+    """Wrap a predict fn so its device work lands on one replica's
+    assigned device (no-op wrapper when unpinned)."""
+    if device is None:
+        return fn
+
+    def pinned(lines):
+        import jax
+        with jax.default_device(device):
+            return fn(lines)
+
+    return pinned
+
+
+class Replica:
+    """One scorer replica: adapter + dispatch batcher + breaker."""
+
+    __slots__ = ("model", "variant", "index", "device", "entry", "batcher")
+
+    def __init__(self, model: str, variant: str, index: int, device,
+                 entry: ModelEntry, batcher: MicroBatcher):
+        self.model = model
+        self.variant = variant
+        self.index = index
+        self.device = device
+        self.entry = entry
+        self.batcher = batcher
+
+    def depth(self) -> int:
+        return self.batcher.depth()
+
+    def state(self) -> dict:
+        b = self.batcher
+        brk = b.breaker
+        return {"replica": self.index,
+                "version": self.entry.version,
+                "queue_depth": b.depth(),
+                "worker_alive": b.worker_alive(),
+                "breaker": brk.state if brk is not None else "closed",
+                "device": str(self.device) if self.device is not None
+                else None}
+
+
+class _SummedHist:
+    """Aggregated cumulative latency histogram across a variant's
+    replicas — presents the ``_state()/bounds`` surface ModelSLO diffs.
+    Rebuilt on reload, so the monitor's identity check resets the
+    window exactly as it does for a single swapped batcher."""
+
+    def __init__(self, hists):
+        self.hists = list(hists)
+        self.bounds = self.hists[0].bounds
+
+    def _state(self):
+        counts = None
+        n, total = 0, 0.0
+        for h in self.hists:
+            c, hn, ht, _vmin, _vmax = h._state()
+            if counts is None:
+                counts = list(c)
+            else:
+                counts = [a + b for a, b in zip(counts, c)]
+            n += hn
+            total += ht
+        return counts, n, total, None, None
+
+
+def merged_hist_state(hists) -> dict:
+    """One mergeable ``state_dict`` summing several LatencyHistograms
+    that share one bound ladder (a variant group's replicas) — the form
+    the telemetry overlay ships per (model, variant)."""
+    hists = list(hists)
+    out = hists[0].state_dict()
+    counts = {int(i): c for i, c in out.get("counts", {}).items()}
+    vmin = out.get("vmin")
+    vmax = out.get("vmax")
+    for h in hists[1:]:
+        s = h.state_dict()
+        for i, c in s.get("counts", {}).items():
+            counts[int(i)] = counts.get(int(i), 0) + c
+        out["n"] += s["n"]
+        out["total"] += s["total"]
+        if s.get("vmin") is not None:
+            vmin = s["vmin"] if vmin is None else min(vmin, s["vmin"])
+        if s.get("vmax") is not None:
+            vmax = s["vmax"] if vmax is None else max(vmax, s["vmax"])
+    out["counts"] = {str(i): c for i, c in sorted(counts.items())}
+    out["vmin"] = vmin
+    out["vmax"] = vmax
+    return out
+
+
+class _SummedCounters:
+    """Read-only sum of the replicas' counters (the monitor diffs
+    cumulative Serve counters)."""
+
+    def __init__(self, counters: List[Counters]):
+        self._counters = list(counters)
+
+    def get(self, group: str, name: str) -> int:
+        return sum(c.get(group, name) for c in self._counters)
+
+
+class _GroupStats:
+    """The batcher-shaped facade a :class:`~avenir_tpu.serve.slo.ModelSLO`
+    observes for a whole variant group; its ``breaker`` is the group
+    itself (the soft-degrade sink)."""
+
+    def __init__(self, group: "VariantGroup"):
+        self.e2e_hist = _SummedHist(
+            [r.batcher.e2e_hist for r in group.replicas])
+        self.counters = _SummedCounters(
+            [r.batcher.counters for r in group.replicas])
+        self.breaker = group
+
+
+class VariantGroup:
+    """One model variant's replica set + health/SLO state."""
+
+    def __init__(self, model: str, variant: str, replicas: List[Replica],
+                 slo_key: Optional[str] = None):
+        self.model = model
+        self.variant = variant
+        self.replicas = replicas
+        # the key this group's rolling SLO monitor lives under on the
+        # SLOBoard: the bare model name for the implicit single default
+        # variant (the pre-pool surface), "model@variant" otherwise
+        self.slo_key = slo_key if slo_key is not None else model
+        self.latency_class = replicas[0].entry.latency_class
+        self.accuracy_class = replicas[0].entry.accuracy_class
+        self._lock = threading.Lock()
+        self._slo_degraded = False
+        self._slo_reason: Optional[str] = None
+        self.stats_facade = _GroupStats(self)
+
+    # -- soft-degrade sink (SLOBoard calls this through the facade) --------
+    def set_soft_degraded(self, flag: bool,
+                          reason: Optional[str] = None) -> None:
+        """The variant-level SLO-sustained-violation bit the router reads
+        to demote this variant; forwarded to every replica breaker so
+        per-replica state reporting agrees."""
+        with self._lock:
+            self._slo_degraded = bool(flag)
+            self._slo_reason = reason if flag else None
+        for r in self.replicas:
+            if r.batcher.breaker is not None:
+                r.batcher.breaker.set_soft_degraded(flag, reason)
+
+    @property
+    def soft_degraded(self) -> bool:
+        with self._lock:
+            return self._slo_degraded
+
+    @property
+    def soft_degrade_reason(self) -> Optional[str]:
+        with self._lock:
+            return self._slo_reason
+
+    # -- health ------------------------------------------------------------
+    def admitting_replicas(self) -> int:
+        """Replicas currently able to take a request: worker alive and
+        breaker not open (half-open counts: probes are admitted)."""
+        n = 0
+        for r in self.replicas:
+            brk = r.batcher.breaker
+            if not r.batcher.worker_alive():
+                continue
+            if brk is not None and brk.state == "open":
+                continue
+            n += 1
+        return n
+
+    def available(self) -> bool:
+        return self.admitting_replicas() > 0
+
+    def healthy(self) -> bool:
+        """Routable without demotion: some replica admits AND the rolling
+        SLO window is not in sustained violation."""
+        return self.available() and not self.soft_degraded
+
+    def depth(self) -> int:
+        return sum(r.depth() for r in self.replicas)
+
+    # -- dispatch ----------------------------------------------------------
+    def _replica_at(self, index: int) -> Optional[Replica]:
+        for r in self.replicas:          # re-read: reload swaps the list
+            if r.index == index:
+                return r
+        return None
+
+    def _try_replicas(self, attempt: Callable[[Replica], object]):
+        """The ONE dispatch policy, shared by both wire paths: replicas
+        in ascending queue-depth order; breaker-open/shedding replicas
+        are skipped; a batcher closed by a concurrent hot-swap reload is
+        retried once on its swapped REPLACEMENT (the list entry at the
+        same index).  Raises only when every replica refuses (sheds
+        outrank breaker errors)."""
+        order = sorted(self.replicas, key=lambda r: r.batcher.depth())
+        shed_exc = None
+        open_exc = None
+        for rep in order:
+            try:
+                return attempt(rep)
+            except CircuitOpenError as e:
+                open_exc = e
+            except ShedError as e:
+                shed_exc = e
+            except RuntimeError as e:
+                fresh = self._replica_at(rep.index)
+                if fresh is None or fresh is rep:
+                    open_exc = open_exc or e
+                    continue
+                try:
+                    return attempt(fresh)
+                except ShedError as e2:
+                    shed_exc = e2
+                except (CircuitOpenError, RuntimeError) as e2:
+                    open_exc = open_exc or e2
+        if shed_exc is not None:
+            raise shed_exc
+        raise open_exc if open_exc is not None else ShedError(
+            f"no replica of {self.model}@{self.variant} accepted")
+
+    def submit(self, line: str):
+        """Least-loaded dispatch of one request line; see
+        :meth:`_try_replicas` for the skip/retry policy."""
+        return self._try_replicas(lambda rep: rep.batcher.submit(line))
+
+    def submit_many(self, lines):
+        """One wire request's client-side batch to ONE replica (the
+        least-loaded), under one lock round (`MicroBatcher.submit_many`)
+        — splitting a batch across replicas would only shrink every
+        micro-batch.  Returns ``(futures, shed)`` with ``None`` slots
+        for shed rows (per-row sheds never raise here)."""
+        return self._try_replicas(
+            lambda rep: rep.batcher.submit_many(lines))
+
+    def section(self, slo_stats: Optional[dict] = None) -> dict:
+        """The per-variant dict health/stats report."""
+        d = {"latency_class": self.latency_class,
+             "accuracy_class": self.accuracy_class,
+             "replicas": [r.state() for r in self.replicas],
+             "admitting": self.admitting_replicas(),
+             "queue_depth": self.depth(),
+             "soft_degraded": self.soft_degraded,
+             "healthy": self.healthy()}
+        if self.soft_degrade_reason:
+            d["soft_degrade_reason"] = self.soft_degrade_reason
+        if slo_stats is not None:
+            d["slo"] = slo_stats
+        return d
+
+
+class ScorerPool:
+    """Every served model's ordered variant groups; owns construction,
+    per-replica hot swap, warmup, and shutdown."""
+
+    def __init__(self, config, registry: ModelRegistry,
+                 batch_kw: dict, warmup: bool = True):
+        self.config = config
+        self.registry = registry
+        self.batch_kw = dict(batch_kw)
+        self.warmup = warmup
+        self._lock = threading.Lock()
+        # model -> variant (declared cost order) -> group
+        self.groups: Dict[str, Dict[str, VariantGroup]] = {}
+        try:
+            for name in registry.model_names():
+                self._load_model(name)
+        except BaseException:
+            # a later model failing to build must not leak the worker
+            # threads / device tables of the ones already loaded
+            self.close()
+            raise
+
+    # -- construction ------------------------------------------------------
+    def _make_batcher(self, entry: ModelEntry, replica: int,
+                      predict_fn) -> MicroBatcher:
+        multi = len(self.registry.variant_names(entry.name)) > 1
+        tag = entry.variant if (multi or entry.variant != DEFAULT_VARIANT) \
+            else None
+        return MicroBatcher(
+            entry.name, predict_fn, entry.counters,
+            breaker=CircuitBreaker.from_config(self.config, entry.name),
+            fault_tag=tag, **self.batch_kw)
+
+    def _build_replica(self, name: str, variant: str, index: int, device,
+                       counters: Optional[Counters] = None) -> Replica:
+        import jax
+        if device is not None:
+            with jax.default_device(device):
+                entry = self.registry.build(name, variant,
+                                            counters=counters)
+        else:
+            entry = self.registry.build(name, variant, counters=counters)
+        if self.warmup:
+            self.registry._warm(entry)
+        batcher = self._make_batcher(
+            entry, index, _pin(entry.adapter.predict_lines, device))
+        return Replica(name, variant, index, device, entry, batcher)
+
+    def _load_model(self, name: str) -> None:
+        variants = self.registry.variant_names(name)
+        n = _resolve_replicas(self.config, name)
+        devices = _devices_for(n)
+        single_default = variants == [DEFAULT_VARIANT]
+        groups: Dict[str, VariantGroup] = {}
+        built: List[Replica] = []
+        try:
+            for v in variants:
+                reps = []
+                for i in range(n):
+                    rep = self._build_replica(name, v, i, devices[i])
+                    built.append(rep)
+                    reps.append(rep)
+                groups[v] = VariantGroup(
+                    name, v, reps,
+                    slo_key=name if single_default else f"{name}@{v}")
+        except BaseException:
+            # e.g. a later variant with no declared overlay: stop the
+            # batcher workers this call already started
+            for rep in built:
+                rep.batcher.close()
+            raise
+        with self._lock:
+            self.groups[name] = groups
+        # the registry keeps serving its legacy surface (get/entries =
+        # the PRIMARY replica of the preferred variant)
+        self.registry.adopt(groups[variants[0]].replicas[0].entry)
+
+    # -- lookup ------------------------------------------------------------
+    def model_names(self) -> List[str]:
+        with self._lock:
+            return list(self.groups)
+
+    def variant_groups(self, model: str) -> List[VariantGroup]:
+        with self._lock:
+            groups = self.groups.get(model)
+        if not groups:
+            raise KeyError(f"model {model!r} is not loaded")
+        return list(groups.values())
+
+    def group(self, model: str, variant: str) -> VariantGroup:
+        with self._lock:
+            groups = self.groups.get(model)
+        if not groups:
+            raise KeyError(f"model {model!r} is not loaded")
+        g = groups.get(variant)
+        if g is None:
+            raise KeyError(
+                f"model {model!r} has no variant {variant!r} "
+                f"(declared: {', '.join(groups)})")
+        return g
+
+    def primary_batcher(self, model: str) -> MicroBatcher:
+        """The preferred variant's replica-0 batcher (the legacy
+        single-batcher surface tests and the bench drive directly)."""
+        return self.variant_groups(model)[0].replicas[0].batcher
+
+    def replicas(self):
+        with self._lock:
+            snapshot = [g for groups in self.groups.values()
+                        for g in groups.values()]
+        for g in snapshot:
+            for r in g.replicas:
+                yield r
+
+    def merged_counters(self, model: str) -> dict:
+        """Counters summed across every replica of every variant (the
+        model-level stats view; equals the single batcher's counters in
+        the default 1-variant x 1-replica shape)."""
+        merged: Dict[str, Dict[str, int]] = {}
+        for g in self.variant_groups(model):
+            for r in g.replicas:
+                for grp, names in r.entry.counters.as_dict().items():
+                    dst = merged.setdefault(grp, {})
+                    for k, v in names.items():
+                        dst[k] = dst.get(k, 0) + v
+        return merged
+
+    # -- lifecycle ---------------------------------------------------------
+    def ensure_workers(self) -> None:
+        for r in self.replicas():
+            r.batcher.ensure_worker()
+
+    def reload(self, model: str, variant: Optional[str] = None,
+               replica: Optional[int] = None) -> ModelEntry:
+        """Per-replica hot swap: rebuild the named scope (one replica,
+        one variant, or the whole model) from the artifact files.  Each
+        replica swaps independently — a fresh adapter + batcher + BREAKER
+        (a repaired artifact must not inherit an open circuit) while its
+        siblings keep serving; counters carry over per replica."""
+        groups = {g.variant: g for g in self.variant_groups(model)}
+        if variant is not None and variant not in groups:
+            raise KeyError(
+                f"model {model!r} has no variant {variant!r}")
+        if replica is not None:
+            replica = int(replica)
+        primary = None
+        swapped = 0
+        for v, g in groups.items():
+            if variant is not None and v != variant:
+                continue
+            new_reps, retired = [], []
+            for rep in g.replicas:
+                if replica is not None and rep.index != replica:
+                    new_reps.append(rep)
+                    continue
+                fresh = self._build_replica(
+                    model, v, rep.index, rep.device,
+                    counters=rep.entry.counters)
+                fresh.entry.counters.incr(SERVE_GROUP, "Reloads")
+                new_reps.append(fresh)
+                retired.append(rep)
+                swapped += 1
+            if retired:
+                # swap FIRST, drain the old batcher after: new traffic
+                # lands on the fresh replica immediately (with the
+                # default single replica, draining before the swap would
+                # fail every request for the whole drain window)
+                g.replicas = new_reps
+                # new facade identity -> the variant's SLO window restarts
+                g.stats_facade = _GroupStats(g)
+                g.set_soft_degraded(False)
+                for rep in retired:
+                    rep.batcher.close(drain=True)
+            if primary is None:
+                primary = g.replicas[0].entry
+        if replica is not None and swapped == 0:
+            raise KeyError(
+                f"model {model!r} has no replica {replica!r} in the "
+                f"reload scope (indices 0..{len(next(iter(groups.values())).replicas) - 1})")
+        variants = self.registry.variant_names(model)
+        head = groups[variants[0]].replicas[0].entry
+        self.registry.adopt(head)
+        return primary if primary is not None else head
+
+    def close(self, drain: bool = False) -> None:
+        with self._lock:
+            groups = [g for gs in self.groups.values()
+                      for g in gs.values()]
+            self.groups.clear()
+        for g in groups:
+            for r in g.replicas:
+                r.batcher.close(drain=drain)

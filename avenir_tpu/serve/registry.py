@@ -8,6 +8,17 @@ see resource/serving/ for a complete runbook):
     serve.model.<name>.version=1           # optional, default "1"
     serve.model.<name>.conf=<job.properties>   # the model's OWN job config
     serve.model.<name>.<key>=<value>       # inline overrides of that config
+    serve.model.<name>.variants=f32,f64    # scorer variants, cheapest first
+    serve.model.<name>.variant.<v>.<key>=<value>   # per-variant overlay
+    serve.model.<name>.variant.<v>.latency.class=fast|standard
+    serve.model.<name>.variant.<v>.accuracy.class=standard|parity
+
+Variants (INFaaS-style, PAPERS.md) are alternative scorer builds of the
+SAME artifact — ``f32``/``f64`` are built-in presets for the NB and
+Markov kinds (engine.VARIANT_PRESETS) flipping the score precision; any
+other name declares its config overlay explicitly.  The replica pool
+(pool.py) builds N replicas per variant and the router (router.py)
+picks per request.
 
 A model's scoring config is exactly the properties file its batch
 predictor job runs with (``bp.properties``, the Markov classifier's
@@ -32,20 +43,30 @@ from typing import Dict, List, Optional, Tuple
 
 from ..core.config import JobConfig, parse_properties
 from ..core.metrics import Counters
-from .engine import (ADAPTER_KINDS, ModelAdapter, ScorerCompileCache,
-                     pow2_bucket, pow2_buckets)
+from .engine import (ADAPTER_KINDS, VARIANT_PRESETS, ModelAdapter,
+                     ScorerCompileCache, pow2_bucket, pow2_buckets)
+
+#: the implicit single variant of a model that declares none
+DEFAULT_VARIANT = "default"
 
 
 class ModelEntry:
-    __slots__ = ("name", "version", "kind", "adapter", "counters")
+    __slots__ = ("name", "version", "kind", "adapter", "counters",
+                 "variant", "latency_class", "accuracy_class")
 
     def __init__(self, name: str, version: str, kind: str,
-                 adapter: ModelAdapter, counters: Counters):
+                 adapter: ModelAdapter, counters: Counters,
+                 variant: str = DEFAULT_VARIANT,
+                 latency_class: str = "standard",
+                 accuracy_class: str = "standard"):
         self.name = name
         self.version = version
         self.kind = kind
         self.adapter = adapter
         self.counters = counters
+        self.variant = variant
+        self.latency_class = latency_class
+        self.accuracy_class = accuracy_class
 
 
 class ModelRegistry:
@@ -70,46 +91,119 @@ class ModelRegistry:
             return []
         return [n.strip() for n in names.split(",") if n.strip()]
 
-    def _model_config(self, name: str) -> JobConfig:
+    def variant_names(self, name: str) -> List[str]:
+        """The model's declared scorer variants in COST ORDER (cheapest
+        first — the order the router tries them in), or the implicit
+        single ``default`` variant when none are declared."""
+        v = self.config.get(f"serve.model.{name}.variants")
+        if not v:
+            return [DEFAULT_VARIANT]
+        names = [s.strip() for s in v.split(",") if s.strip()]
+        if not names:
+            return [DEFAULT_VARIANT]
+        if len(set(names)) != len(names):
+            raise ValueError(
+                f"duplicate variant names in serve.model.{name}.variants")
+        return names
+
+    def _variant_spec(self, name: str, kind: str, variant: str) -> dict:
+        """Config overlay + declared latency/accuracy classes for one
+        variant: the kind's built-in preset (f32/f64) underneath any
+        explicit ``serve.model.<name>.variant.<v>.*`` keys."""
+        preset = VARIANT_PRESETS.get(kind, {}).get(variant, {})
+        overlay = dict(preset.get("overlay", {}))
+        lat = preset.get("latency_class", "standard")
+        acc = preset.get("accuracy_class", "standard")
+        prefix = f"serve.model.{name}.variant.{variant}."
+        for k, v in self.config.props.items():
+            if not k.startswith(prefix):
+                continue
+            sub = k[len(prefix):]
+            if sub == "latency.class":
+                lat = v
+            elif sub == "accuracy.class":
+                acc = v
+            else:
+                overlay[sub] = v
+        if variant != DEFAULT_VARIANT and not overlay:
+            raise ValueError(
+                f"variant {variant!r} of model {name!r} declares no config "
+                f"overlay: name a built-in preset "
+                f"({', '.join(sorted(VARIANT_PRESETS.get(kind, {})) or '-')})"
+                f" or set serve.model.{name}.variant.{variant}.<key> keys")
+        return {"overlay": overlay, "latency_class": lat,
+                "accuracy_class": acc}
+
+    def _base_props(self, name: str) -> Dict[str, str]:
+        """The model's job config before any variant overlay: its
+        ``conf`` file (if named) under the inline ``serve.model.<n>.*``
+        overrides, minus the ``variant.`` subtree."""
         prefix = f"serve.model.{name}."
+        vprefix = f"{prefix}variant."
         inline = {k[len(prefix):]: v for k, v in self.config.props.items()
-                  if k.startswith(prefix)}
+                  if k.startswith(prefix) and not k.startswith(vprefix)}
         props: Dict[str, str] = {}
         conf_path = inline.pop("conf", None)
         if conf_path:
             with open(conf_path, "r") as fh:
                 props.update(parse_properties(fh.read()))
         props.update(inline)
+        return props
+
+    def _model_config(self, name: str,
+                      variant: str = DEFAULT_VARIANT) -> JobConfig:
+        props = self._base_props(name)
+        if variant != DEFAULT_VARIANT:
+            kind = props.get("kind", "")
+            props.update(self._variant_spec(name, kind, variant)["overlay"])
         return JobConfig(props)
 
     # -- loading / lookup --------------------------------------------------
-    def _build(self, name: str,
-               counters: Optional[Counters] = None) -> ModelEntry:
-        mconf = self._model_config(name)
-        kind = mconf.must(
-            "kind", f"missing serve.model.{name}.kind")
+    def build(self, name: str, variant: str = DEFAULT_VARIANT,
+              counters: Optional[Counters] = None) -> ModelEntry:
+        """Construct one complete serving entry (adapter + counters) for
+        a model variant WITHOUT registering it — the replica pool builds
+        one per replica and adopts only the primary."""
+        props = self._base_props(name)
+        kind = props.get("kind")
+        if not kind:
+            raise KeyError(f"missing serve.model.{name}.kind")
         cls = ADAPTER_KINDS.get(kind)
         if cls is None:
             raise ValueError(
                 f"unknown model kind {kind!r}; known: "
                 + ", ".join(sorted(ADAPTER_KINDS)))
+        # one spec computation feeds both the config overlay and the
+        # declared classes — they can never drift apart
+        spec = self._variant_spec(name, kind, variant)
+        if variant != DEFAULT_VARIANT:
+            props.update(spec["overlay"])
+        mconf = JobConfig(props)
         version = mconf.get("version", "1")
         counters = counters if counters is not None else Counters()
         adapter = cls(mconf, counters,
                       cache=ScorerCompileCache(counters),
                       max_bucket=pow2_bucket(self.max_batch),
                       mesh=self.mesh)
-        return ModelEntry(name, version, kind, adapter, counters)
+        return ModelEntry(name, version, kind, adapter, counters,
+                          variant=variant,
+                          latency_class=spec["latency_class"],
+                          accuracy_class=spec["accuracy_class"])
 
-    def load(self, name: str, warmup: bool = False,
-             counters: Optional[Counters] = None) -> ModelEntry:
-        entry = self._build(name, counters)       # slow part, off-lock
+    def adopt(self, entry: ModelEntry, warmup: bool = False) -> ModelEntry:
+        """Register a built entry as the latest version of its model."""
         if warmup:
             self._warm(entry)
         with self._lock:
-            self._entries[(name, entry.version)] = entry
-            self._latest[name] = entry.version
+            self._entries[(entry.name, entry.version)] = entry
+            self._latest[entry.name] = entry.version
         return entry
+
+    def load(self, name: str, warmup: bool = False,
+             counters: Optional[Counters] = None) -> ModelEntry:
+        # slow part (build + warm) off-lock
+        return self.adopt(self.build(name, counters=counters),
+                          warmup=warmup)
 
     def load_all(self, warmup: bool = False) -> List[ModelEntry]:
         return [self.load(n, warmup=warmup) for n in self.model_names()]

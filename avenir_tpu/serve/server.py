@@ -2,19 +2,24 @@
 
 Wire protocol (one JSON object per line, one JSON response line each, in
 request order per connection; concurrency comes from concurrent
-connections — the stdlib threading server gives each connection its own
-handler thread, which parks on the micro-batcher future):
+connections — the ``selectors`` event-loop frontend multiplexes many
+thousands of open sockets over a few I/O threads, and requests resolve
+through batcher-future callbacks instead of parked handler threads):
 
     {"model": "churn", "row": "C001,planA,1210,505,8,11,3,Y"}
       -> {"model": "churn", "version": "1", "output": "C001,...,Y,87"}
     {"model": "churn", "rows": ["...", "..."]}          # client-side batch
       -> {"model": "churn", "version": "1", "outputs": ["...", "..."]}
+    {"model": "churn", "row": "...", "slo_ms": 20}      # SLO-hinted routing
+    {"model": "churn", "row": "...", "variant": "f64"}  # explicit variant pin
     {"cmd": "stats"}            -> per-model counters + latency percentiles
+                                   + per-variant/per-replica pool state
     {"cmd": "health"}           -> {"ok": true, "models": [...], "slo": {...}}
     {"cmd": "metrics"}          -> Prometheus TEXT exposition (multi-line,
                                    terminated by "# EOF"; read it with
                                    ``request_text`` / a scrape loop)
     {"cmd": "reload", "model": "churn"}   -> hot swap from updated artifacts
+        (+ optional "variant"/"replica" to swap one slice of the pool)
 
 Error responses carry {"error": "..."} (plus {"shed": true} when admission
 control rejected the request) and never tear down the connection.
@@ -23,34 +28,46 @@ Config surface (serve.properties): ``serve.host`` (default 127.0.0.1),
 ``serve.port`` (default 8650; 0 picks an ephemeral port, printed on
 stderr), ``serve.batch.max.size``, ``serve.batch.max.delay.ms``,
 ``serve.queue.max.depth``, ``serve.request.timeout.sec``, plus the
-registry's ``serve.models`` / ``serve.model.<name>.*`` surface and
-``serve.warmup`` (default true) — see registry.py.  Graceful-degradation
-keys (README "Fault tolerance"): ``serve.request.deadline.ms``,
-``serve.breaker.failures`` / ``serve.breaker.reset.sec`` /
-``serve.breaker.probe.requests``, ``serve.watchdog.interval.sec``,
-``serve.max.line.bytes``.  Telemetry keys (README "Telemetry & SLOs"):
-``telemetry.interval.sec`` / ``telemetry.jsonl.path`` (or the
-``--metrics-out`` flag) drive the periodic exporter, and the
-``serve.slo.*`` surface (slo.py) declares the rolling-window targets
-whose violation flips the SLO gauges, the ``health`` report, and the
-breaker's soft-degrade bit.
+registry's ``serve.models`` / ``serve.model.<name>.*`` surface (including
+the ``serve.model.<name>.variants`` scorer-variant declarations) and
+``serve.warmup`` (default true) — see registry.py.  Scale-out keys
+(README "Online serving"): ``serve.pool.replicas`` (pool.py),
+``serve.router.default.slo.ms`` / ``serve.router.strict`` (router.py),
+``serve.frontend.threads`` / ``serve.frontend.backlog`` /
+``serve.frontend.pipeline.max`` (frontend.py), and
+``serve.drain.timeout.sec`` (graceful drain bound, this module).
+Graceful-degradation keys (README "Fault tolerance"):
+``serve.request.deadline.ms``, ``serve.breaker.failures`` /
+``serve.breaker.reset.sec`` / ``serve.breaker.probe.requests``,
+``serve.watchdog.interval.sec``, ``serve.max.line.bytes``.  Telemetry
+keys (README "Telemetry & SLOs"): ``telemetry.interval.sec`` /
+``telemetry.jsonl.path`` (or the ``--metrics-out`` flag) drive the
+periodic exporter, and the ``serve.slo.*`` surface (slo.py) declares the
+rolling-window targets whose violation flips the SLO gauges, the
+``health`` report, the breaker's soft-degrade bit, and — through the
+variant router — which scorer variant a request lands on.
 """
 
 from __future__ import annotations
 
 import json
 import socket
-import socketserver
 import sys
 import threading
 import time
-from typing import Dict, Optional
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional
 
 from ..core import obs, telemetry
 from ..core.config import JobConfig, load_job_config, parse_cli_args
 from .batcher import MicroBatcher, ShedError
-from .breaker import CircuitBreaker, CircuitOpenError
-from .registry import ModelEntry, ModelRegistry
+from .breaker import CircuitOpenError
+from .frontend import (DEFAULT_BACKLOG, DEFAULT_IO_THREADS,
+                       DEFAULT_PIPELINE_MAX, EventLoopFrontend, KEY_BACKLOG,
+                       KEY_IO_THREADS, KEY_PIPELINE_MAX)
+from .pool import ScorerPool, merged_hist_state
+from .registry import ModelRegistry
+from .router import SLOUnattainableError, VariantRouter
 from .slo import SLOBoard
 
 # a distinct class pre-3.11, an alias of the builtin after
@@ -58,18 +75,64 @@ from concurrent.futures import TimeoutError as _FutureTimeout
 
 DEFAULT_MAX_LINE_BYTES = 1 << 20
 
+KEY_DRAIN_TIMEOUT = "serve.drain.timeout.sec"
+DEFAULT_DRAIN_TIMEOUT_SEC = 10.0
+
+SERVE_GROUP = "Serve"
+
+
+class TruncatedResponseError(RuntimeError):
+    """A client helper read a response that ended (connection close or
+    read deadline) before its framing terminator arrived; ``partial``
+    carries whatever bytes did."""
+
+    def __init__(self, message: str, partial: bytes = b""):
+        super().__init__(message)
+        self.partial = partial
+
+
+class _Submission:
+    """One predict request's routed submission state, shared by the
+    synchronous (embedded/`handle_line`) and callback (event-loop
+    frontend) completion paths."""
+
+    __slots__ = ("entry", "decision", "multi_variant", "single", "futures",
+                 "shed", "degraded", "last_err")
+
+    def __init__(self, entry, decision, multi_variant, single, futures,
+                 shed, degraded, last_err):
+        self.entry = entry
+        self.decision = decision
+        self.multi_variant = multi_variant
+        self.single = single
+        self.futures = futures
+        self.shed = shed
+        self.degraded = degraded
+        self.last_err = last_err
+
 
 class PredictionServer:
-    """In-process serving stack: registry + per-model batchers + TCP
-    frontend.  Usable embedded (tests, bench) or via ``serve_main``.
+    """In-process serving stack: registry + replica scorer pool +
+    SLO-aware variant router + event-loop TCP frontend.  Usable embedded
+    (tests, bench) or via ``serve_main``.
+
+    Scale-out surface (pool.py / router.py / frontend.py): every
+    (model, variant) owns ``serve.pool.replicas`` batcher+scorer
+    replicas dispatched least-loaded; models declaring
+    ``serve.model.<name>.variants`` (e.g. ``f32,f64``) are routed
+    per-request by SLO hint with soft-degraded variants demoted to their
+    siblings; the TCP frontend is a non-blocking ``selectors`` event
+    loop, so 10k+ open sockets cost file descriptors, not threads.
 
     Graceful-degradation surface (see batcher.py / breaker.py):
     ``serve.request.deadline.ms`` (timeout responses instead of silent
-    waits), ``serve.breaker.*`` (per-model circuit breaker — ``health``
-    reports ``degraded`` models), ``serve.watchdog.interval.sec`` (a
-    watchdog restarts any dead batcher worker), and
-    ``serve.max.line.bytes`` (the frontend survives oversized or
-    malformed request lines with a structured error response)."""
+    waits), ``serve.breaker.*`` (per-REPLICA circuit breaker —
+    ``health`` reports ``degraded`` models), ``serve.watchdog.interval.sec``
+    (a watchdog restarts any dead batcher worker), ``serve.max.line.bytes``
+    (the frontend survives oversized or malformed request lines with a
+    structured error response), and ``serve.drain.timeout.sec`` (shutdown
+    completes or deadline-times-out every queued request — nothing is
+    silently dropped)."""
 
     def __init__(self, config: JobConfig, mesh=None):
         self.config = config
@@ -79,26 +142,40 @@ class PredictionServer:
             0.0, config.get_float("serve.request.deadline.ms", 0.0)) / 1000.0
         self.max_line_bytes = config.get_int("serve.max.line.bytes",
                                              DEFAULT_MAX_LINE_BYTES)
-        self._batch_kw = dict(
+        self.drain_timeout_s = config.get_float(KEY_DRAIN_TIMEOUT,
+                                                DEFAULT_DRAIN_TIMEOUT_SEC)
+        batch_kw = dict(
             max_batch=config.get_int("serve.batch.max.size", 64),
             max_delay_ms=config.get_float("serve.batch.max.delay.ms", 2.0),
             max_queue_depth=config.get_int("serve.queue.max.depth", 256),
             hist_buckets=obs.histogram_buckets_from_config(config),
             deadline_ms=config.get_float("serve.request.deadline.ms", 0.0))
-        self._batchers: Dict[str, MicroBatcher] = {}
         self._lock = threading.Lock()
-        self._tcp: Optional[socketserver.ThreadingTCPServer] = None
-        self._tcp_thread: Optional[threading.Thread] = None
+        self._frontend: Optional[EventLoopFrontend] = None
+        self._stopped = False
         self._stop_watchdog = threading.Event()
-        warm = config.get_boolean("serve.warmup", True)
-        for entry in self.registry.load_all(warmup=warm):
-            self._attach(entry)
+        # in-flight async collectors, reaped past their deadline by the
+        # serve-timeout thread (started with the TCP frontend)
+        self._inflight: set = set()
+        self._inflight_lock = threading.Lock()
+        self._reaper_thread: Optional[threading.Thread] = None
+        # the replica pool builds every (model, variant) group — one
+        # adapter + batcher + breaker per replica — and adopts each
+        # model's primary entry into the registry's legacy surface
+        self.pool = ScorerPool(config, self.registry, batch_kw,
+                               warmup=config.get_boolean("serve.warmup",
+                                                         True))
+        # telemetry: rolling SLO monitors (per variant group) + the
+        # periodic exporter whose snapshot backs the ``metrics`` command
+        # (Prometheus exposition) and the telemetry.jsonl.path series
+        self.slo = SLOBoard(config)
+        self.router = VariantRouter(config, self.pool, self.slo)
+        # commands can block (a reload rebuilds adapters; health
+        # evaluates SLO windows) — they run here, never on an I/O shard
+        self._cmd_pool = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="serve-cmd")
         self._watchdog_thread = self._start_watchdog(
             config.get_float("serve.watchdog.interval.sec", 0.5))
-        # telemetry: rolling SLO monitors + the periodic exporter whose
-        # snapshot backs the ``metrics`` command (Prometheus exposition)
-        # and the optional telemetry.jsonl.path time-series file
-        self.slo = SLOBoard(config)
         telemetry.configure_from_config(config)
         self.telemetry = telemetry.TelemetryExporter(
             config.get_float(telemetry.KEY_INTERVAL,
@@ -106,34 +183,17 @@ class PredictionServer:
             jsonl_path=config.get(telemetry.KEY_JSONL_PATH),
             providers=[self._telemetry_overlay]).start()
 
-    # -- model plumbing ----------------------------------------------------
-    def _attach(self, entry: ModelEntry) -> None:
-        """(Re)wire a model's batcher to the given entry's adapter (a
-        reload also gets a FRESH breaker: swapping in a repaired
-        artifact should not inherit the broken one's open circuit)."""
-        with self._lock:
-            old = self._batchers.get(entry.name)
-            self._batchers[entry.name] = MicroBatcher(
-                entry.name, entry.adapter.predict_lines, entry.counters,
-                breaker=CircuitBreaker.from_config(self.config, entry.name),
-                **self._batch_kw)
-        if old is not None:
-            old.close(drain=True)
-
     # -- watchdog ----------------------------------------------------------
     def _start_watchdog(self, interval_s: float) -> Optional[threading.Thread]:
-        """A daemon thread that restarts any dead batcher worker every
-        ``interval_s`` (0 disables — the defensive restart in
-        ``submit`` still applies)."""
+        """A daemon thread that restarts any dead batcher worker (across
+        every replica of every variant) every ``interval_s`` (0 disables
+        — the defensive restart in ``submit`` still applies)."""
         if interval_s <= 0:
             return None
 
         def watch():
             while not self._stop_watchdog.wait(interval_s):
-                with self._lock:
-                    batchers = list(self._batchers.values())
-                for b in batchers:
-                    b.ensure_worker()
+                self.pool.ensure_workers()
 
         t = threading.Thread(target=watch, name="serve-watchdog",
                              daemon=True)
@@ -141,63 +201,109 @@ class PredictionServer:
         return t
 
     def batcher(self, name: str) -> MicroBatcher:
-        with self._lock:
-            b = self._batchers.get(name)
-        if b is None:
-            raise KeyError(f"model {name!r} is not loaded")
-        return b
+        """The model's primary batcher (preferred variant, replica 0) —
+        the legacy single-batcher surface tests and the bench drive."""
+        return self.pool.primary_batcher(name)
 
     # -- telemetry ---------------------------------------------------------
     def _observe_slo(self) -> Dict[str, dict]:
-        """Evaluate every model's rolling SLO window NOW (also feeds the
-        sustained-violation soft-degrade signal into the breakers)."""
-        with self._lock:
-            batchers = dict(self._batchers)
-        return {name: self.slo.observe(name, b)
-                for name, b in sorted(batchers.items())}
+        """Evaluate every variant group's rolling SLO window NOW (also
+        feeds the sustained-violation soft-degrade signal back into the
+        group — the bit the router reads to demote it).  Keys are the
+        groups' SLO keys: the bare model name for the implicit single
+        default variant, ``model@variant`` otherwise."""
+        out: Dict[str, dict] = {}
+        for name in self.pool.model_names():
+            for g in self.pool.variant_groups(name):
+                out[g.slo_key] = self.slo.observe(
+                    g.slo_key, g.stats_facade, config_name=name)
+        return out
 
     def _telemetry_overlay(self) -> dict:
         """The per-model snapshot sections the exporter/`metrics` scrape
-        adds on top of the global registry: latency histogram states
-        (model-labeled), queue/breaker/worker gauges (breaker state as
-        the 0/1/2 encoding), per-model counters, and the SLO gauges."""
+        adds on top of the global registry: model-level latency
+        histogram states, queue/breaker/worker gauges (breaker state as
+        the 0/1/2 encoding), per-model counters, the SLO gauges, and the
+        pool's per-variant (``serve.variant.*``) and per-replica
+        (``serve.replica.*``) state plus router decision counts
+        (``serve.router.*``)."""
         slo_stats = self._observe_slo()
-        with self._lock:
-            batchers = dict(self._batchers)
         now = time.time()
         gauges: Dict[str, dict] = {}
         hists: Dict[str, dict] = {}
         counters: Dict[str, dict] = {}
 
-        def g(name, model, value):
-            gauges[telemetry.labeled(name, model=model)] = {
+        def g(name, value, **labels):
+            gauges[telemetry.labeled(name, **labels)] = {
                 "value": float(value), "ts": now}
 
-        for name, b in sorted(batchers.items()):
+        for name in sorted(self.pool.model_names()):
+            groups = self.pool.variant_groups(name)
+            all_replicas = [r for grp in groups for r in grp.replicas]
+            # model-level surface: byte-compatible with the pre-pool
+            # single-batcher names (exactly one sample per model)
             hists[telemetry.labeled("serve.e2e.latency", model=name)] = \
-                b.e2e_hist.state_dict()
+                merged_hist_state([r.batcher.e2e_hist
+                                   for r in all_replicas])
             hists[telemetry.labeled("serve.queue.wait", model=name)] = \
-                b.queue_wait_hist.state_dict()
-            g("serve.queue.depth", name, b.depth())
-            g("serve.worker.alive", name, 1 if b.worker_alive() else 0)
-            brk = b.breaker
-            g("serve.breaker.state", name,
-              brk.state_code() if brk is not None else 0)
-            g("serve.breaker.soft.degraded", name,
-              1 if (brk is not None and brk.soft_degraded) else 0)
-            counters[f"Serve.{name}"] = b.counters.as_dict().get(
-                "Serve", {})
-            stats = slo_stats.get(name) or {}
+                merged_hist_state([r.batcher.queue_wait_hist
+                                   for r in all_replicas])
+            g("serve.queue.depth", sum(r.depth() for r in all_replicas),
+              model=name)
+            g("serve.worker.alive",
+              1 if all(r.batcher.worker_alive() for r in all_replicas)
+              else 0, model=name)
+            primary_brk = groups[0].replicas[0].batcher.breaker
+            g("serve.breaker.state", primary_brk.state_code()
+              if primary_brk is not None else 0, model=name)
+            g("serve.breaker.soft.degraded",
+              1 if any(grp.soft_degraded for grp in groups) else 0,
+              model=name)
+            counters[f"Serve.{name}"] = self.pool.merged_counters(
+                name).get(SERVE_GROUP, {})
+            stats = slo_stats.get(groups[0].slo_key) or {}
             if stats.get("p50_ms") is not None:
-                g("serve.slo.p50.ms", name, stats["p50_ms"])
+                g("serve.slo.p50.ms", stats["p50_ms"], model=name)
             if stats.get("p99_ms") is not None:
-                g("serve.slo.p99.ms", name, stats["p99_ms"])
-            g("serve.slo.shed.pct", name, stats.get("shed_pct", 0.0))
-            g("serve.slo.error.pct", name, stats.get("error_pct", 0.0))
-            g("serve.slo.violation", name,
-              1 if stats.get("violation") else 0)
-            g("serve.slo.sustained", name,
-              1 if stats.get("sustained") else 0)
+                g("serve.slo.p99.ms", stats["p99_ms"], model=name)
+            g("serve.slo.shed.pct", stats.get("shed_pct", 0.0), model=name)
+            g("serve.slo.error.pct", stats.get("error_pct", 0.0),
+              model=name)
+            g("serve.slo.violation", 1 if stats.get("violation") else 0,
+              model=name)
+            g("serve.slo.sustained", 1 if stats.get("sustained") else 0,
+              model=name)
+            # per-variant + per-replica pool state
+            for grp in groups:
+                v = grp.variant
+                g("serve.variant.queue.depth", grp.depth(),
+                  model=name, variant=v)
+                g("serve.variant.admitting", grp.admitting_replicas(),
+                  model=name, variant=v)
+                g("serve.variant.soft.degraded",
+                  1 if grp.soft_degraded else 0, model=name, variant=v)
+                g("serve.variant.healthy", 1 if grp.healthy() else 0,
+                  model=name, variant=v)
+                g("serve.router.routed", self.router.routed(name, v),
+                  model=name, variant=v)
+                vstats = slo_stats.get(grp.slo_key) or {}
+                if vstats.get("p99_ms") is not None:
+                    g("serve.variant.slo.p99.ms", vstats["p99_ms"],
+                      model=name, variant=v)
+                for r in grp.replicas:
+                    brk = r.batcher.breaker
+                    g("serve.replica.queue.depth", r.depth(),
+                      model=name, variant=v, replica=r.index)
+                    g("serve.replica.breaker.state",
+                      brk.state_code() if brk is not None else 0,
+                      model=name, variant=v, replica=r.index)
+                    g("serve.replica.worker.alive",
+                      1 if r.batcher.worker_alive() else 0,
+                      model=name, variant=v, replica=r.index)
+            g("serve.router.demotions", self.router.demotions(name),
+              model=name)
+        if self._frontend is not None:
+            g("serve.frontend.connections", self._frontend.connections())
         return {"gauges": gauges, "hists": hists, "counters": counters}
 
     def metrics_text(self) -> str:
@@ -216,34 +322,22 @@ class PredictionServer:
 
     # -- request handling --------------------------------------------------
     def handle_line(self, line: str) -> dict:
+        """Synchronous request path (embedded users, tests): parse,
+        execute, and return the response dict, waiting on futures."""
         with obs.get_tracer().span("serve.request"):
-            return self._handle_line(line)
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError as e:
+                return {"error": f"bad request JSON: {e}"}
+            if not isinstance(obj, dict):
+                return {"error": "request must be a JSON object"}
+            return self._handle_obj(obj)
 
-    def _handle_line(self, line: str) -> dict:
-        try:
-            obj = json.loads(line)
-        except json.JSONDecodeError as e:
-            return {"error": f"bad request JSON: {e}"}
-        if not isinstance(obj, dict):
-            return {"error": "request must be a JSON object"}
+    def _handle_obj(self, obj: dict) -> dict:
         cmd = obj.get("cmd")
         try:
-            if cmd == "stats":
-                return self._stats()
-            if cmd == "health":
-                return self._health()
-            if cmd == "metrics":
-                # Prometheus text exposition, NOT a JSON line: the
-                # frontend writes the raw text (terminated by "# EOF")
-                return {"_text": self.metrics_text()}
-            if cmd == "reload":
-                entry = self.registry.reload(
-                    obj.get("model") or self._default_model())
-                self._attach(entry)
-                return {"ok": True, "model": entry.name,
-                        "version": entry.version}
             if cmd is not None:
-                return {"error": f"unknown cmd {cmd!r}"}
+                return self._command(cmd, obj)
             return self._predict(obj)
         except (KeyError, ValueError) as e:
             return {"error": str(e)}
@@ -252,10 +346,37 @@ class PredictionServer:
             # swap, ... — the connection must survive every request error
             return {"error": f"{type(e).__name__}: {e}"}
 
-    def _predict(self, obj: dict) -> dict:
+    def _command(self, cmd: str, obj: dict) -> dict:
+        if cmd == "stats":
+            return self._stats()
+        if cmd == "health":
+            return self._health()
+        if cmd == "metrics":
+            # Prometheus text exposition, NOT a JSON line: the frontend
+            # writes the raw text (terminated by "# EOF")
+            return {"_text": self.metrics_text()}
+        if cmd == "reload":
+            model = obj.get("model") or self._default_model()
+            entry = self.pool.reload(model, variant=obj.get("variant"),
+                                     replica=obj.get("replica"))
+            return {"ok": True, "model": entry.name,
+                    "version": entry.version}
+        return {"error": f"unknown cmd {cmd!r}"}
+
+    # -- predict: routing + submission (shared sync/async) -----------------
+    def _submit(self, obj: dict) -> object:
+        """Validate, route, and submit one predict request's rows; returns
+        a :class:`_Submission`, or a complete error-response dict for
+        malformed requests."""
         name = obj.get("model") or self._default_model()
+        # version validation against the registry's adopted surface
         entry = self.registry.get(name, obj.get("version"))
-        batcher = self.batcher(name)
+        slo_ms = obj.get("slo_ms")
+        if slo_ms is not None and not isinstance(slo_ms, (int, float)):
+            return {"error": '"slo_ms" must be a number (milliseconds)'}
+        pin = obj.get("variant")
+        if pin is not None and not isinstance(pin, str):
+            return {"error": '"variant" must be a string'}
         rows = obj.get("rows")
         single = rows is None
         if single:
@@ -269,33 +390,98 @@ class PredictionServer:
             # validate BEFORE submitting: one malformed entry must not
             # poison a shared micro-batch with other clients' requests
             return {"error": '"rows" must be a list of strings'}
+        try:
+            group, decision = self.router.route(
+                name, slo_ms=float(slo_ms) if slo_ms is not None else None,
+                variant=pin)
+        except SLOUnattainableError as e:
+            return {"model": entry.name, "version": entry.version,
+                    "error": str(e), "slo_unattainable": True}
+        multi = len(self.pool.variant_groups(name)) > 1
+        futures: List[Optional[object]] = []
+        shed, degraded = 0, 0
+        last_err = "request failed"
+        if single:
+            try:
+                futures.append(group.submit(rows[0]))
+            except ShedError:
+                futures.append(None)
+                shed += 1
+            except (CircuitOpenError, RuntimeError) as e:
+                # every replica of the routed group refused (breakers
+                # open / batchers mid-swap): the model variant is
+                # degraded, not the request
+                futures.append(None)
+                degraded += 1
+                last_err = str(e)
+        else:
+            # client-side batch: one replica, one lock round (and the
+            # whole batch coalesces into that replica's micro-batches)
+            try:
+                futures, shed = group.submit_many(rows)
+            except ShedError:
+                futures = [None] * len(rows)
+                shed = len(rows)
+            except (CircuitOpenError, RuntimeError) as e:
+                futures = [None] * len(rows)
+                degraded = len(rows)
+                last_err = str(e)
+        return _Submission(entry, decision, multi, single, futures,
+                           shed, degraded, last_err)
+
+    def _assemble(self, sub: _Submission, outputs: List[Optional[str]],
+                  errors: int, timeouts: int, last_err: str) -> dict:
+        resp: dict = {"model": sub.entry.name, "version": sub.entry.version}
+        if sub.multi_variant or "pinned" in sub.decision:
+            resp["variant"] = sub.decision["variant"]
+            if sub.decision.get("demoted"):
+                resp["demoted"] = True
+            if "slo_met" in sub.decision:
+                resp["slo_met"] = sub.decision["slo_met"]
+        if sub.single:
+            if sub.shed:
+                resp["error"] = ("request shed: queue at "
+                                 "serve.queue.max.depth")
+                resp["shed"] = True
+                return resp
+            if sub.degraded:
+                resp["error"] = last_err
+                resp["degraded"] = True
+                return resp
+            if outputs[0] is None:
+                resp["error"] = last_err
+                if timeouts:
+                    resp["timeout"] = True
+                return resp
+            resp["output"] = outputs[0]
+            return resp
+        resp["outputs"] = outputs
+        if sub.shed:
+            resp["shed"] = sub.shed
+        if sub.degraded:
+            resp["degraded"] = sub.degraded
+        if timeouts:
+            resp["timeouts"] = timeouts
+        if errors:
+            resp["errors"] = errors
+        return resp
+
+    def _predict(self, obj: dict) -> dict:
+        """Synchronous predict: submit, then WAIT on the futures (the
+        embedded/handle_line path; the event-loop frontend uses
+        ``_predict_async`` instead, which never blocks a thread)."""
+        sub = self._submit(obj)
+        if isinstance(sub, dict):
+            return sub
         t0 = time.perf_counter()
         # the client-side wait honors the request deadline when one is
         # configured (the queue-side half lives in the batcher worker),
         # bounded by the legacy serve.request.timeout.sec either way
         wait_s = (min(self.deadline_s, self.timeout) if self.deadline_s
                   else self.timeout)
-        futures, shed, degraded = [], 0, 0
-        last_err = "request failed"
-        for row in rows:
-            try:
-                futures.append(batcher.submit(row))
-            except ShedError:
-                futures.append(None)
-                shed += 1
-            except CircuitOpenError as e:
-                # breaker open: fail fast and say so — the model is
-                # degraded, not the request
-                futures.append(None)
-                degraded += 1
-                last_err = str(e)
-            except RuntimeError:
-                # the batcher was closed by a concurrent hot-swap reload;
-                # re-fetch the freshly attached one and retry once
-                batcher = self.batcher(name)
-                futures.append(batcher.submit(row))
         outputs, errors, timeouts = [], 0, 0
-        for f in futures:
+        last_err = sub.last_err
+        for f in sub.futures:
             if f is None:
                 outputs.append(None)
                 continue
@@ -314,187 +500,342 @@ class PredictionServer:
                 outputs.append(None)
                 errors += 1
                 last_err = str(e)
-        resp: dict = {"model": entry.name, "version": entry.version}
-        if single:
-            if shed:
-                return {"model": entry.name, "version": entry.version,
-                        "error": "request shed: queue at "
-                                 "serve.queue.max.depth", "shed": True}
-            if degraded:
-                return {"model": entry.name, "version": entry.version,
-                        "error": last_err, "degraded": True}
-            if outputs[0] is None:
-                resp["error"] = last_err
-                if timeouts:
-                    resp["timeout"] = True
-                return resp
-            resp["output"] = outputs[0]
-            return resp
-        resp["outputs"] = outputs
-        if shed:
-            resp["shed"] = shed
-        if degraded:
-            resp["degraded"] = degraded
-        if timeouts:
-            resp["timeouts"] = timeouts
-        if errors:
-            resp["errors"] = errors
-        return resp
+        return self._assemble(sub, outputs, errors, timeouts, last_err)
 
+    # -- async dispatch (the event-loop frontend's entry) ------------------
+    def dispatch_line(self, line: str, cb: Callable[[dict], None]) -> None:
+        """Non-blocking request dispatch: ``cb(response)`` fires exactly
+        once, on whatever thread resolves the request — immediately for
+        malformed requests, on a command-executor thread for commands,
+        and from the batcher workers' future callbacks for predictions.
+        NEVER blocks the calling (I/O shard) thread on a scorer."""
+        tracer = obs.get_tracer()
+        if tracer.enabled:
+            # the serve.request span, recorded retroactively at response
+            # time (no thread carries the request across the async hop)
+            t0 = time.perf_counter()
+            inner = cb
+
+            def cb(resp, _inner=inner, _t0=t0):
+                tracer.record_span(
+                    "serve.request", int(_t0 * 1e9),
+                    int((time.perf_counter() - _t0) * 1e9))
+                _inner(resp)
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError as e:
+            cb({"error": f"bad request JSON: {e}"})
+            return
+        if not isinstance(obj, dict):
+            cb({"error": "request must be a JSON object"})
+            return
+        if obj.get("cmd") is not None:
+            try:
+                self._cmd_pool.submit(lambda: cb(self._handle_obj(obj)))
+            except RuntimeError:                     # executor shut down
+                cb({"error": "server shutting down"})
+            return
+        try:
+            sub = self._submit(obj)
+        except (KeyError, ValueError) as e:
+            cb({"error": str(e)})
+            return
+        except Exception as e:                      # noqa: BLE001
+            cb({"error": f"{type(e).__name__}: {e}"})
+            return
+        if isinstance(sub, dict):
+            cb(sub)
+            return
+        # the async path honors the same client-wait bound as the sync
+        # one: a collector not finished by its deadline is force-timed
+        # out by the reaper (a hung scorer whose worker thread is still
+        # alive would otherwise hang the connection forever)
+        wait_s = (min(self.deadline_s, self.timeout) if self.deadline_s
+                  else self.timeout)
+        coll = _AsyncCollector(self, sub, cb,
+                               deadline=time.monotonic() + wait_s)
+        with self._inflight_lock:
+            self._inflight.add(coll)
+        coll.arm()
+
+    def _reap_expired(self) -> None:
+        """Time out every in-flight async request past its deadline
+        (runs on the serve-timeout reaper thread)."""
+        now = time.monotonic()
+        with self._inflight_lock:
+            due = [c for c in self._inflight if c.deadline <= now]
+        for c in due:
+            c.expire()
+
+    def _start_reaper(self) -> threading.Thread:
+        def reap():
+            interval = max(0.05, min(1.0, self.timeout / 4.0))
+            while not self._stop_watchdog.wait(interval):
+                self._reap_expired()
+
+        t = threading.Thread(target=reap, name="serve-timeout",
+                             daemon=True)
+        t.start()
+        return t
+
+    # -- reporting ---------------------------------------------------------
     def _health(self) -> dict:
-        """Health now reports DEGRADED models explicitly: a model whose
-        breaker is open/half-open, whose batcher worker is down, or
-        whose rolling SLO window is in SUSTAINED violation (the
-        soft-degrade signal) is still listed (requests fail fast — or,
-        for SLO-only degradation, keep flowing — with the state
-        visible) but the top-level ``ok`` drops to False so
-        orchestrators can see it.  The ``slo`` section carries every
-        model's windowed p50/p99/shed/error stats vs its declared
-        targets."""
+        """Health reports DEGRADED models explicitly: a model with a
+        non-closed primary breaker, any dead batcher worker, or any
+        variant group in SUSTAINED SLO violation is still listed
+        (requests keep flowing — demoted to sibling variants/replicas
+        where possible — with the state visible) but the top-level
+        ``ok`` drops to False so orchestrators can see it.  The ``slo``
+        section carries every variant group's windowed stats under its
+        SLO key (the bare model name for single-default-variant models,
+        ``model@variant`` otherwise), and each model's ``variants``
+        section carries per-replica queue/breaker/worker state."""
         slo_stats = self._observe_slo()
         models, degraded = [], []
-        for e in self.registry.entries():
-            b = self._batchers.get(e.name)
-            brk = b.breaker if b else None
-            state = brk.state if brk is not None else "closed"
-            worker_ok = b.worker_alive() if b else False
-            slo_bad = bool((slo_stats.get(e.name) or {}).get("sustained"))
-            if state != "closed" or not worker_ok or slo_bad:
-                degraded.append(e.name)
-            models.append({"name": e.name, "version": e.version,
-                           "kind": e.kind, "breaker": state,
-                           "slo_degraded": slo_bad,
-                           "worker_alive": worker_ok})
+        for name in sorted(self.pool.model_names()):
+            groups = self.pool.variant_groups(name)
+            entry = self.registry.get(name)
+            primary_brk = groups[0].replicas[0].batcher.breaker
+            state = primary_brk.state if primary_brk is not None else "closed"
+            worker_ok = all(r.batcher.worker_alive()
+                            for grp in groups for r in grp.replicas)
+            slo_bad = any(bool((slo_stats.get(grp.slo_key) or {})
+                               .get("sustained")) for grp in groups)
+            breaker_bad = any(
+                r.batcher.breaker is not None
+                and r.batcher.breaker.state != "closed"
+                for grp in groups for r in grp.replicas)
+            if breaker_bad or not worker_ok or slo_bad:
+                degraded.append(name)
+            models.append({
+                "name": name, "version": entry.version, "kind": entry.kind,
+                "breaker": state, "slo_degraded": slo_bad,
+                "worker_alive": worker_ok,
+                "variants": {
+                    grp.variant: grp.section(slo_stats.get(grp.slo_key))
+                    for grp in groups},
+                "router": self.router.section(name)})
         return {"ok": not degraded, "degraded": degraded, "models": models,
                 "slo": slo_stats}
 
     def _stats(self) -> dict:
         models = {}
-        for entry in self.registry.entries():
-            b = self._batchers.get(entry.name)
-            models[entry.name] = {
+        for name in sorted(self.pool.model_names()):
+            entry = self.registry.get(name)
+            groups = self.pool.variant_groups(name)
+            b = groups[0].replicas[0].batcher
+            models[name] = {
                 "version": entry.version,
                 "kind": entry.kind,
-                "counters": entry.counters.as_dict(),
-                # byte-compatible p50/p95/p99 field names, now sourced
-                # from the shared log-bucketed LatencyHistogram
-                "latency_ms": (b.latency_percentiles_ms() if b else None),
-                "histograms": (b.histograms() if b else None),
+                # merged across every replica of every variant (equals
+                # the single batcher's counters in the default shape)
+                "counters": self.pool.merged_counters(name),
+                # byte-compatible p50/p95/p99 field names, sourced from
+                # the PRIMARY replica's histogram (the legacy surface)
+                "latency_ms": b.latency_percentiles_ms(),
+                "histograms": b.histograms(),
                 "batch_fill_ratio": (round(b.fill_ratio(), 4)
-                                     if b and b.fill_ratio() is not None
+                                     if b.fill_ratio() is not None
                                      else None),
-                "queue_depth": b.depth() if b else 0,
+                "queue_depth": sum(grp.depth() for grp in groups),
                 "breaker": (b.breaker.state_dict()
-                            if b and b.breaker is not None else None),
+                            if b.breaker is not None else None),
+                "variants": {grp.variant: grp.section() for grp in groups},
+                "router": self.router.section(name),
             }
-        return {"models": models, "obs": obs.get_tracer().stats(),
-                "slo": self.slo.section()}
+        out = {"models": models, "obs": obs.get_tracer().stats(),
+               "slo": self.slo.section()}
+        if self._frontend is not None:
+            out["frontend"] = {
+                "connections": self._frontend.connections(),
+                "io_threads": len(self._frontend.shards)}
+        return out
 
     # -- TCP frontend ------------------------------------------------------
     def start(self) -> int:
-        """Bind + serve in a daemon thread; returns the bound port."""
+        """Bind the event-loop frontend; returns the bound port."""
         host = self.config.get("serve.host", "127.0.0.1")
         port = self.config.get_int("serve.port", 8650)
-        app = self
-
-        limit = self.max_line_bytes
-
-        class Handler(socketserver.StreamRequestHandler):
-            def handle(self):
-                # hardened line loop: the line length is BOUNDED (an
-                # attacker or buggy client streaming an endless line can
-                # no longer balloon memory), binary garbage decodes with
-                # replacement and yields a structured JSON error, and NO
-                # request failure tears down the connection thread —
-                # only socket errors do
-                while True:
-                    try:
-                        raw = self.rfile.readline(limit + 1)
-                    except OSError:
-                        return
-                    if not raw:
-                        return                       # client closed
-                    if len(raw) > limit and not raw.endswith(b"\n"):
-                        # genuinely oversized: readline stopped mid-line.
-                        # (limit+1 bytes ENDING in \n is a complete line
-                        # whose payload fits the limit — skimming there
-                        # would eat the NEXT request and desync the
-                        # connection's request/response pairing)
-                        self._skim_line()
-                        resp = {"error": f"request line exceeds "
-                                         f"serve.max.line.bytes ({limit})"}
-                    else:
-                        line = raw.decode("utf-8", errors="replace").strip()
-                        if not line:
-                            continue
-                        try:
-                            resp = app.handle_line(line)
-                        except Exception as e:       # noqa: BLE001
-                            resp = {"error": f"internal error: "
-                                             f"{type(e).__name__}: {e}"}
-                    try:
-                        if isinstance(resp, dict) and "_text" in resp:
-                            # raw text response (the `metrics` Prometheus
-                            # exposition): multi-line, "# EOF"-terminated
-                            text = resp["_text"]
-                            if not text.endswith("\n"):
-                                text += "\n"
-                            self.wfile.write(text.encode())
-                        else:
-                            self.wfile.write(
-                                (json.dumps(resp) + "\n").encode())
-                        self.wfile.flush()
-                    except OSError:
-                        return
-
-            def _skim_line(self):
-                """Discard the remainder of an oversized line so the
-                next readline starts at a real line boundary."""
-                while True:
-                    chunk = self.rfile.readline(limit + 1)
-                    if not chunk or chunk.endswith(b"\n"):
-                        return
-
-        class Server(socketserver.ThreadingTCPServer):
-            allow_reuse_address = True
-            daemon_threads = True
-
-        self._tcp = Server((host, port), Handler)
-        self.port = self._tcp.server_address[1]
-        self._tcp_thread = threading.Thread(
-            target=self._tcp.serve_forever, name="serve-frontend",
-            daemon=True)
-        self._tcp_thread.start()
+        self._frontend = EventLoopFrontend(
+            self, host, port,
+            io_threads=self.config.get_int(KEY_IO_THREADS,
+                                           DEFAULT_IO_THREADS),
+            backlog=self.config.get_int(KEY_BACKLOG, DEFAULT_BACKLOG),
+            pipeline_max=self.config.get_int(KEY_PIPELINE_MAX,
+                                             DEFAULT_PIPELINE_MAX))
+        if self._reaper_thread is None:
+            self._reaper_thread = self._start_reaper()
+        self.port = self._frontend.port
         return self.port
 
-    def stop(self) -> None:
-        self._stop_watchdog.set()
-        # stop the telemetry thread FIRST (its final tick still sees the
-        # live batchers); verifiably gone afterwards — the shutdown lint
-        # hammers start/stop and asserts no leaked avenir-telemetry thread
-        self.telemetry.stop()
-        if self._tcp is not None:
-            self._tcp.shutdown()
-            self._tcp.server_close()
-            self._tcp = None
+    def stop(self, drain: bool = True) -> None:
+        """Graceful shutdown: stop accepting, let every already-read
+        request complete (bounded by ``serve.drain.timeout.sec``; what
+        remains gets a structured drain-timeout error), then stop the
+        I/O shards, telemetry, command executor, and the replica pool —
+        no queued request is ever silently dropped."""
         with self._lock:
-            batchers = list(self._batchers.values())
-            self._batchers.clear()
-        for b in batchers:
-            b.close(drain=False)
+            if self._stopped:
+                return
+            self._stopped = True
+        self._stop_watchdog.set()
+        fe = self._frontend
+        if fe is not None:
+            fe.begin_drain()
+            if drain and not fe.await_drained(self.drain_timeout_s):
+                fe.fail_pending(
+                    "server draining: request abandoned past "
+                    "serve.drain.timeout.sec")
+                fe.await_drained(1.0)
+            fe.stop()
+            self._frontend = None
+        # stop the telemetry thread BEFORE the pool closes (its final
+        # tick still sees the live batchers); verifiably gone afterwards
+        # — the shutdown lint hammers start/stop and asserts no leaked
+        # avenir-telemetry thread
+        self.telemetry.stop()
+        self._cmd_pool.shutdown(wait=True)
+        self.pool.close(drain=False)
+
+
+class _AsyncCollector:
+    """Waits (without a thread) for every future of one multi-row
+    submission, then assembles the response and fires the frontend
+    callback exactly once — or is force-timed-out by the server's
+    reaper when its deadline passes first."""
+
+    __slots__ = ("server", "sub", "cb", "deadline", "_lock", "_left",
+                 "_outputs", "_errors", "_timeouts", "_last_err",
+                 "_finished")
+
+    def __init__(self, server: PredictionServer, sub: _Submission,
+                 cb: Callable[[dict], None],
+                 deadline: float = float("inf")):
+        self.server = server
+        self.sub = sub
+        self.cb = cb
+        self.deadline = deadline
+        self._lock = threading.Lock()
+        self._left = sum(1 for f in sub.futures if f is not None)
+        self._outputs: List[Optional[str]] = [None] * len(sub.futures)
+        self._errors = 0
+        self._timeouts = 0
+        self._last_err = sub.last_err
+        self._finished = False
+
+    def arm(self) -> None:
+        fire = False
+        with self._lock:
+            if self._left == 0 and not self._finished:
+                self._finished = True
+                fire = True
+        if fire:
+            self._finish()
+            return
+        for i, f in enumerate(self.sub.futures):
+            if f is not None:
+                f.add_done_callback(
+                    lambda fut, i=i: self._done(i, fut))
+
+    def _done(self, i: int, fut) -> None:
+        out: Optional[str] = None
+        err = timeout = 0
+        last = None
+        exc = fut.exception()
+        if exc is None:
+            out = fut.result()
+        else:
+            err = 1
+            last = str(exc) or f"{type(exc).__name__}"
+            if isinstance(exc, (TimeoutError, _FutureTimeout)):
+                timeout = 1
+                last = str(exc) or "request deadline exceeded"
+        with self._lock:
+            if self._finished:
+                return          # the reaper already answered this one
+            self._outputs[i] = out
+            self._errors += err
+            self._timeouts += timeout
+            if last is not None:
+                self._last_err = last
+            self._left -= 1
+            fire = self._left == 0
+            if fire:
+                self._finished = True
+        if fire:
+            self._finish()
+
+    def expire(self) -> None:
+        """Reaper entry: convert every still-unresolved row into a
+        structured timeout (no-op when the response already fired)."""
+        with self._lock:
+            if self._finished:
+                return
+            self._finished = True
+            self._errors += self._left
+            self._timeouts += self._left
+            self._left = 0
+            self._last_err = ("request timed out "
+                              "(serve.request.timeout.sec)")
+        self._finish()
+
+    def _finish(self) -> None:
+        with self.server._inflight_lock:
+            self.server._inflight.discard(self)
+        try:
+            resp = self.server._assemble(
+                self.sub, self._outputs, self._errors, self._timeouts,
+                self._last_err)
+        except Exception as e:                      # noqa: BLE001
+            resp = {"error": f"{type(e).__name__}: {e}"}
+        self.cb(resp)
+
+
+# ---------------------------------------------------------------------------
+# client helpers (tests, bench, runbook clients)
+# ---------------------------------------------------------------------------
+
+def _read_response(sock: socket.socket, complete, timeout: float,
+                   what: str) -> bytes:
+    """Incremental bounded read: recv until ``complete(buf)`` says the
+    response is fully framed.  The deadline applies to the WHOLE read —
+    a response missing its terminator surfaces a structured
+    :class:`TruncatedResponseError` (carrying the partial bytes) after
+    ``timeout`` seconds or on connection close, instead of stalling a
+    blocking ``recv`` until the full socket timeout with the partial
+    response silently discarded."""
+    deadline = time.monotonic() + timeout
+    buf = b""
+    while not complete(buf):
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise TruncatedResponseError(
+                f"{what}: no complete response within {timeout}s "
+                f"({len(buf)} partial bytes)", buf)
+        sock.settimeout(remaining)
+        try:
+            chunk = sock.recv(65536)
+        except socket.timeout:
+            raise TruncatedResponseError(
+                f"{what}: no complete response within {timeout}s "
+                f"({len(buf)} partial bytes)", buf) from None
+        if not chunk:
+            raise TruncatedResponseError(
+                f"{what}: connection closed mid-response "
+                f"({len(buf)} partial bytes)", buf)
+        buf += chunk
+    return buf
 
 
 def request(host: str, port: int, obj: dict, timeout: float = 30.0) -> dict:
     """One-shot client helper: send one JSON request line, read one
-    response line (used by tests, the bench, and the runbook client)."""
+    response line (used by tests, the bench, and the runbook client).
+    Raises :class:`TruncatedResponseError` when the response line never
+    completes within ``timeout``."""
     with socket.create_connection((host, port), timeout=timeout) as sock:
         sock.sendall((json.dumps(obj) + "\n").encode())
-        buf = b""
-        while not buf.endswith(b"\n"):
-            chunk = sock.recv(65536)
-            if not chunk:
-                break
-            buf += chunk
+        buf = _read_response(sock, lambda b: b.endswith(b"\n"), timeout,
+                             "request")
     return json.loads(buf.decode())
 
 
@@ -502,26 +843,22 @@ def request_text(host: str, port: int, obj: dict,
                  timeout: float = 30.0) -> str:
     """One-shot client for TEXT responses (the ``metrics`` Prometheus
     exposition): sends one JSON request line, reads until the ``# EOF``
-    terminator line (or connection close) — the scrape-loop primitive
-    the telemetry runbook's client uses.  If the server answers with a
-    one-line JSON error instead of exposition (e.g. ``metrics_text``
-    itself failed, or the cmd was not ``metrics``), that line is
-    returned immediately — the caller gets the diagnostic instead of
-    blocking until the socket timeout waiting for a terminator that
-    will never come."""
+    terminator line — the scrape-loop primitive the telemetry runbook's
+    client uses.  If the server answers with a one-line JSON error
+    instead of exposition (e.g. ``metrics_text`` itself failed, or the
+    cmd was not ``metrics``), that line is returned immediately — the
+    caller gets the diagnostic instead of blocking until the read
+    deadline waiting for a terminator that will never come.  A response
+    that never completes raises :class:`TruncatedResponseError`."""
     terminator = b"# EOF\n"
+
+    def complete(buf: bytes) -> bool:
+        return (buf.endswith(terminator)
+                or (buf.startswith(b"{") and buf.endswith(b"\n")))
+
     with socket.create_connection((host, port), timeout=timeout) as sock:
         sock.sendall((json.dumps(obj) + "\n").encode())
-        buf = b""
-        while True:
-            chunk = sock.recv(65536)
-            if not chunk:
-                break
-            buf += chunk
-            if buf.endswith(terminator):
-                break
-            if buf.startswith(b"{") and buf.endswith(b"\n"):
-                break                      # a JSON (error) response line
+        buf = _read_response(sock, complete, timeout, "request_text")
     return buf.decode()
 
 
@@ -560,10 +897,11 @@ def serve_main(argv) -> int:
     print(f"serving {names} on "
           f"{config.get('serve.host', '127.0.0.1')}:{port}", file=sys.stderr,
           flush=True)
-    # explicit shutdown handlers: SIGTERM is the standard operational stop,
-    # and a backgrounded server (sh's `serve &`) inherits SIGINT as
-    # SIG_IGN — installing our own handler re-enables both so shutdown
-    # (and the --trace export below) runs instead of requiring SIGKILL
+    # explicit shutdown handlers: SIGTERM is the standard operational stop
+    # (and triggers the same graceful drain as an in-process stop()), and
+    # a backgrounded server (sh's `serve &`) inherits SIGINT as SIG_IGN —
+    # installing our own handler re-enables both so the drain (and the
+    # --trace export below) runs instead of requiring SIGKILL
     stop_evt = threading.Event()
     import signal
     for sig in (signal.SIGINT, signal.SIGTERM):
@@ -576,7 +914,9 @@ def serve_main(argv) -> int:
     except KeyboardInterrupt:
         pass
     finally:
-        server.stop()
+        # graceful drain: accepting stops, queued requests complete (or
+        # deadline-timeout) before the process exits
+        server.stop(drain=True)
         if flusher is not None:
             flusher.stop()
         if trace_path:

@@ -213,25 +213,39 @@ class SLOBoard:
         self._monitors: Dict[str, ModelSLO] = {}
         self._lock = threading.Lock()
 
-    def monitor(self, name: str) -> ModelSLO:
+    def monitor(self, name: str,
+                config_name: Optional[str] = None) -> ModelSLO:
+        """The monitor keyed ``name``; per-model target overrides are
+        resolved against ``config_name`` (a replica pool monitors each
+        VARIANT group under ``model@variant`` while the declared targets
+        stay per-model — ``serve.model.<model>.slo.*``)."""
         with self._lock:
             mon = self._monitors.get(name)
             if mon is None:
                 cfg = self.config
+                model = config_name or name
                 mon = self._monitors[name] = ModelSLO(
                     name,
                     p99_ms=cfg.get_float(
-                        f"serve.model.{name}.slo.p99.ms", self._default_p99),
+                        f"serve.model.{model}.slo.p99.ms", self._default_p99),
                     error_pct=cfg.get_float(
-                        f"serve.model.{name}.slo.error.pct",
+                        f"serve.model.{model}.slo.error.pct",
                         self._default_err),
                     window_sec=self.window_sec,
                     degrade_evals=self.degrade_evals)
             return mon
 
-    def observe(self, name: str, batcher,
-                now: Optional[float] = None) -> dict:
-        mon = self.monitor(name)
+    def peek(self, name: str) -> Optional[Dict[str, object]]:
+        """Last evaluated window stats for one monitor WITHOUT creating
+        it or re-evaluating (the router's read path; None before the
+        first observation)."""
+        with self._lock:
+            mon = self._monitors.get(name)
+            return dict(mon.last) if mon is not None else None
+
+    def observe(self, name: str, batcher, now: Optional[float] = None,
+                config_name: Optional[str] = None) -> dict:
+        mon = self.monitor(name, config_name=config_name)
         stats = mon.observe(batcher, now=now)
         brk = batcher.breaker
         if brk is not None and mon.degrade_evals > 0:

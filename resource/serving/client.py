@@ -1,7 +1,9 @@
 #!/usr/bin/env python
 """JSON-lines client for the serving runbook: waits for the server's
-"serving ... on host:port" banner, fires concurrent single-row requests
-(so the micro-batcher actually coalesces), then prints the stats surface.
+"serving ... on host:port" banner, fires concurrent SLO-hinted
+single-row requests (so the micro-batchers coalesce and the variant
+router actually decides), pins one request per declared variant, then
+prints the stats surface including the replica-pool and router state.
 
 Usage: client.py <server.log> <test.csv>
 """
@@ -46,12 +48,21 @@ def main():
     rows = [l for l in open(test_path).read().splitlines() if l][:64]
 
     health = request(host, port, {"cmd": "health"})
-    print("health:", json.dumps(health))
+    churn = health["models"][0]
+    pool_shape = {v: len(sec["replicas"])
+                  for v, sec in churn.get("variants", {}).items()}
+    print("health:", json.dumps({k: health[k] for k in ("ok", "degraded")}))
+    print(f"pool: variants x replicas = {pool_shape}, "
+          f"router order = {churn.get('router', {}).get('order')}")
 
+    # concurrent SLO-hinted requests: the router picks the cheapest
+    # variant whose rolling p99 meets the 250ms hint (f32, unless it is
+    # degraded), and the replica pool dispatches least-loaded
     results = [None] * len(rows)
 
     def go(i):
-        results[i] = request(host, port, {"model": "churn", "row": rows[i]})
+        results[i] = request(host, port, {"model": "churn", "row": rows[i],
+                                          "slo_ms": 250})
 
     threads = [threading.Thread(target=go, args=(i,)) for i in range(len(rows))]
     t0 = time.perf_counter()
@@ -64,10 +75,25 @@ def main():
     errors = [r for r in results if r is None or "error" in r]
     if errors:
         raise SystemExit(f"{len(errors)} failed responses, e.g. {errors[0]}")
-    print(f"scored {len(rows)} concurrent rows in {dt * 1000:.0f} ms")
+    print(f"scored {len(rows)} concurrent SLO-hinted rows in "
+          f"{dt * 1000:.0f} ms")
+    by_variant = {}
+    for r in results:
+        by_variant[r.get("variant", "default")] = \
+            by_variant.get(r.get("variant", "default"), 0) + 1
+    print(f"routed: {by_variant}")
     print("first responses:")
     for r in results[:3]:
-        print(" ", r["output"])
+        print(f"  [{r.get('variant', '-')}] {r['output']}")
+
+    # explicit variant pins: the same row served by each declared scorer
+    # build (f64 = strict-parity precision)
+    for variant in churn.get("variants", {"default": None}):
+        r = request(host, port, {"model": "churn", "row": rows[0],
+                                 "variant": variant})
+        if "error" in r:
+            raise SystemExit(f"pinned {variant} failed: {r}")
+        print(f"pinned {variant}: {r['output']}")
 
     stats = request(host, port, {"cmd": "stats"})["models"]["churn"]
     serve = stats["counters"]["Serve"]
@@ -75,6 +101,11 @@ def main():
           f"(coalesced), shed={serve.get('Shed', 0)}, "
           f"fill={stats['batch_fill_ratio']}, "
           f"latency_ms={stats['latency_ms']}")
+    print(f"router: {json.dumps(stats.get('router'))}")
+    for v, sec in sorted(stats.get("variants", {}).items()):
+        per_rep = {r["replica"]: r["queue_depth"] for r in sec["replicas"]}
+        print(f"variant {v}: admitting={sec['admitting']}, "
+              f"healthy={sec['healthy']}, replica queue depths={per_rep}")
     assert serve["Batches"] < serve["Requests"], "batcher did not coalesce"
 
 

@@ -2,10 +2,13 @@
 top-level span from ``run()`` (the ``core.obs.traced_run`` decorator) and
 return a Counters metrics snapshot — so new drivers cannot silently opt
 out of the unified tracing + metrics surface.  The telemetry layer rides
-the same lint: every ``telemetry.*``/``serve.slo.*`` config key must be
-bound to a KEY_ constant, read through a JobConfig accessor, and
-documented in README, and the telemetry exporter thread must be
-verifiably stopped on shutdown."""
+the same lint: every ``telemetry.*``/``serve.slo.*`` — and, since the
+serving-at-scale PR, ``serve.pool.*``/``serve.router.*``/
+``serve.frontend.*``/``serve.drain.*`` — config key must be bound to a
+KEY_ constant, read through a JobConfig accessor, and documented in
+README, and the telemetry exporter thread must be verifiably stopped on
+shutdown (the serve-side half — pool replica batchers, I/O shards, the
+command executor — is hammered in tests/test_pool.py)."""
 
 import importlib
 import inspect
@@ -56,12 +59,17 @@ def test_every_registered_driver_run_returns_counters():
 # telemetry config-key lint
 # ---------------------------------------------------------------------------
 
+# the config-key namespaces the lint owns (serve.model.<name>.* per-model
+# override keys are derived at runtime from these and stay out)
+_LINT_PREFIXES = (r'(?:telemetry|serve\.slo|serve\.pool|serve\.router|'
+                  r'serve\.frontend|serve\.drain)')
+
 # a key literal READ directly through a JobConfig accessor (gauge/metric
 # NAMES reuse the dotted vocabulary but never flow through an accessor,
 # so they stay out of the config-key lint)
 _ACCESSOR_LITERAL_RE = re.compile(
     r'\.(?:get|get_int|get_float|get_boolean|get_list|must|must_int|'
-    r'must_float|must_list)\(\s*"((?:telemetry|serve\.slo)\.[a-z0-9.]+)"')
+    r'must_float|must_list)\(\s*"(' + _LINT_PREFIXES + r'\.[a-z0-9.]+)"')
 
 
 def _package_sources():
@@ -78,7 +86,7 @@ def _collect_config_keys():
     a KEY_ constant, or (a lint violation) read as a bare literal."""
     keys = {}
     const_re = re.compile(
-        r'^(KEY_[A-Z0-9_]+)\s*=\s*"((?:telemetry|serve\.slo)\.[a-z0-9.]+)"',
+        r'^(KEY_[A-Z0-9_]+)\s*=\s*"(' + _LINT_PREFIXES + r'\.[a-z0-9.]+)"',
         re.MULTILINE)
     for path, text in _package_sources():
         for m in const_re.finditer(text):

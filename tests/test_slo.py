@@ -398,3 +398,87 @@ def test_no_leaked_telemetry_threads_after_serve_exit(nb_artifact,
         assert tele_threads() == ["avenir-telemetry"]
         srv.stop()
         assert tele_threads() == []
+
+
+# ---------------------------------------------------------------------------
+# breaker state-code gauge under concurrency (hammer)
+# ---------------------------------------------------------------------------
+
+def test_breaker_state_code_hammer_concurrent_transitions():
+    """``CircuitBreaker.state_code`` (the 0/1/2 telemetry gauge) hammered
+    while worker threads concurrently drive soft-degrade flips, trips
+    (consecutive failures), half-open probes, and closes: every observed
+    code must be a valid encoding of a reachable state, ``state_dict``
+    must stay internally consistent, and no transition can deadlock or
+    raise."""
+    now = [0.0]
+    clock_lock = threading.Lock()
+
+    def clock():
+        with clock_lock:
+            return now[0]
+
+    def advance(dt):
+        with clock_lock:
+            now[0] += dt
+
+    b = CircuitBreaker("m", failure_threshold=3, reset_sec=0.001,
+                       probe_requests=2, clock=clock)
+    stop = threading.Event()
+    errors = []
+    codes = set()
+
+    def flipper():
+        # soft-degrade flips never touch the hard state machine
+        while not stop.is_set():
+            b.set_soft_degraded(True, "slo")
+            b.set_soft_degraded(False)
+
+    def tripper():
+        while not stop.is_set():
+            for _ in range(3):
+                b.record_failure()          # -> open (or re-open a probe)
+            advance(0.002)                  # past reset: next allow probes
+            if b.allow():
+                b.record_success()          # probe closes it
+
+    def reader():
+        try:
+            while not stop.is_set():
+                c = b.state_code()
+                codes.add(c)
+                if c not in (0, 1, 2):
+                    raise AssertionError(f"invalid state code {c}")
+                d = b.state_dict()
+                expect = {"closed": 0, "half_open": 1, "open": 2}[d["state"]]
+                # the dict read is a second lock acquisition, so the code
+                # may have MOVED between the two reads — but both must be
+                # valid encodings
+                if expect not in (0, 1, 2):
+                    raise AssertionError(f"invalid state {d['state']}")
+                if d["consecutive_failures"] < 0:
+                    raise AssertionError("negative failure streak")
+        except BaseException as e:          # noqa: BLE001
+            errors.append(e)
+
+    threads = ([threading.Thread(target=flipper) for _ in range(2)]
+               + [threading.Thread(target=tripper) for _ in range(3)]
+               + [threading.Thread(target=reader) for _ in range(3)])
+    for t in threads:
+        t.start()
+    time.sleep(0.8)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+        assert not t.is_alive(), "hammer thread wedged"
+    assert not errors, errors
+    # under concurrent trips + probes the gauge visited every state
+    assert codes == {0, 1, 2}, codes
+    # quiesce: drive a deterministic close and confirm the gauge settles
+    advance(1.0)
+    while not b.allow():
+        advance(1.0)
+    b.record_success()
+    assert b.state_code() == 0
+    b.set_soft_degraded(False)
+    assert b.state_dict()["slo_degraded"] is False
