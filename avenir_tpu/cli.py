@@ -150,11 +150,12 @@ def extract_resume_flag(argv):
 
 def configure_resilience(config) -> None:
     """Apply the resilience-layer config surfaces (retry policy + fault
-    injection plan) — called by every CLI entry point next to the obs
-    configure."""
-    from .core import faultinject, resilience
+    injection plan + the io durability strict mode) — called by every
+    CLI entry point next to the obs configure."""
+    from .core import faultinject, io, resilience
     resilience.configure_from_config(config)
     faultinject.configure_from_config(config)
+    io.configure_from_config(config)
 
 
 def _init_runtime() -> None:

@@ -30,6 +30,20 @@ uninterrupted one (asserted in tests/test_resilience.py at mesh=1 and
 8-way).  A successful run deletes its sidecar, so stale checkpoints
 never shadow a completed job.
 
+Generations + corruption fallback (the self-healing half): each save
+ROTATES the previous sidecar to ``<path>.1`` (then ``.2``, ...) keeping
+the last ``checkpoint.keep`` generations, and ``load`` walks them
+newest→oldest — a truncated/corrupt sidecar (surfaced as
+:class:`CheckpointCorrupt`, never a raw pickle traceback) falls back to
+the next older generation, and when every generation is corrupt the
+``checkpoint.fallback`` policy decides: ``cold`` (the default) degrades
+to a cold start — a full re-run, trivially byte-identical — while
+``fail`` raises for operators who would rather investigate than recount.
+Resuming from an OLDER generation just replays more chunks; the fold is
+deterministic, so output stays byte-identical (asserted in
+tests/test_chaos.py under seeded kill+corrupt schedules).  Recovery
+events ride the telemetry registry's ``Durability/*`` counters.
+
 Config surface:
 
 - ``checkpoint.interval.chunks`` — checkpoint every N folded chunks
@@ -37,6 +51,9 @@ Config surface:
 - ``checkpoint.path``            — sidecar path (default ``<out>.ckpt``)
 - ``checkpoint.resume``          — resume from the sidecar if present
   (the CLI ``--resume`` flag sets this)
+- ``checkpoint.keep``            — sidecar generations kept (default 2)
+- ``checkpoint.fallback``        — ``cold`` | ``fail`` when every
+  generation is corrupt (default ``cold``)
 """
 
 from __future__ import annotations
@@ -45,11 +62,19 @@ import hashlib
 import os
 import pickle
 import tempfile
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
+
+from . import faultinject
 
 KEY_INTERVAL = "checkpoint.interval.chunks"
 KEY_PATH = "checkpoint.path"
 KEY_RESUME = "checkpoint.resume"
+KEY_KEEP = "checkpoint.keep"
+KEY_FALLBACK = "checkpoint.fallback"
+
+DEFAULT_KEEP = 2
+FALLBACK_COLD = "cold"
+FALLBACK_FAIL = "fail"
 
 CKPT_VERSION = 1
 _FP_HASH_BYTES = 1 << 20       # fingerprint hashes the first 1 MB
@@ -59,6 +84,77 @@ class CheckpointMismatch(RuntimeError):
     """The sidecar does not match this run (different input file or
     chunking parameters): resuming would silently break byte parity, so
     fail fast and tell the user to re-run without ``--resume``."""
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A sidecar failed to unpickle (truncated write, disk corruption).
+    ``load`` walks older generations past it; this surfaces only under
+    ``checkpoint.fallback=fail`` with every generation corrupt."""
+
+
+def _durability_counters():
+    """The process-global ``Durability`` counter group (shared accessor
+    in core.io, so recovery events from both layers land in the same
+    telemetry registry and ``--metrics-out`` exports them)."""
+    from .io import _durability_counters as _dc
+    return _dc()
+
+
+def _fallback_from_config(config) -> str:
+    mode = (config.get(KEY_FALLBACK, FALLBACK_COLD)
+            or FALLBACK_COLD).strip().lower()
+    if mode not in (FALLBACK_COLD, FALLBACK_FAIL):
+        raise ValueError(
+            f"{KEY_FALLBACK}={mode!r}: use {FALLBACK_COLD} or "
+            f"{FALLBACK_FAIL}")
+    return mode
+
+
+def generation_paths(path: str, keep: int) -> List[str]:
+    """Sidecar paths newest→oldest: ``path``, ``path.1``, ..."""
+    return [path] + [f"{path}.{i}" for i in range(1, max(1, int(keep)))]
+
+
+def _rotate_generations(path: str, keep: int) -> None:
+    """Shift existing sidecar generations one slot older before a new
+    save lands at ``path`` (``keep=1`` keeps none — the pre-generation
+    behavior)."""
+    gens = generation_paths(path, keep)
+    for i in range(len(gens) - 1, 0, -1):
+        if os.path.exists(gens[i - 1]):
+            os.replace(gens[i - 1], gens[i])
+
+
+def _load_payload(path: str) -> Dict[str, Any]:
+    """Unpickle one sidecar, surfacing every corruption mode (truncated
+    file, garbled bytes, wrong object shape) as
+    :class:`CheckpointCorrupt` instead of a raw pickle traceback."""
+    try:
+        with open(path, "rb") as fh:
+            payload = pickle.load(fh)
+    except (OSError, pickle.PickleError, EOFError, AttributeError,
+            ImportError, IndexError, MemoryError, UnicodeDecodeError,
+            ValueError) as e:
+        raise CheckpointCorrupt(
+            f"checkpoint {path} is unreadable "
+            f"({type(e).__name__}: {e})") from None
+    if not isinstance(payload, dict):
+        raise CheckpointCorrupt(
+            f"checkpoint {path} does not hold a payload dict "
+            f"({type(payload).__name__})")
+    return payload
+
+
+def _maybe_corrupt_sidecar(path: str, save_index: int) -> None:
+    """The ``ckpt_corrupt`` fault point: truncate the just-written
+    sidecar in place (crash mid-checkpoint-write / disk corruption,
+    deterministic per save index — the generation-fallback test)."""
+    fi = faultinject.get_injector()
+    if fi is None or fi.armed("ckpt_corrupt", index=save_index) is None:
+        return
+    size = os.path.getsize(path)
+    with open(path, "rb+") as fh:
+        fh.truncate(max(size // 2, 1))
 
 
 def input_fingerprint(path: str) -> Dict[str, Any]:
@@ -96,7 +192,8 @@ class StreamCheckpointer:
 
     def __init__(self, path: str, interval: int, kind: str, in_path: str,
                  params: Optional[Dict[str, Any]] = None,
-                 resume: bool = False):
+                 resume: bool = False, keep: int = DEFAULT_KEEP,
+                 fallback: str = FALLBACK_COLD):
         if interval < 1:
             raise ValueError(f"{KEY_INTERVAL} must be >= 1: {interval}")
         self.path = path
@@ -105,6 +202,8 @@ class StreamCheckpointer:
         self.in_path = in_path
         self.params = dict(params or {})
         self.resume = bool(resume)
+        self.keep = max(1, int(keep))
+        self.fallback = fallback
         self.saves = 0
         self._fp = None
 
@@ -131,7 +230,9 @@ class StreamCheckpointer:
             return None
         return cls(config.get(KEY_PATH, default_path),
                    max(interval, 1) if interval > 0 else 8,
-                   kind, in_path, params=params, resume=resume)
+                   kind, in_path, params=params, resume=resume,
+                   keep=config.get_int(KEY_KEEP, DEFAULT_KEEP),
+                   fallback=_fallback_from_config(config))
 
     # -- producer side -----------------------------------------------------
     def due(self, chunk_index: int) -> bool:
@@ -145,7 +246,8 @@ class StreamCheckpointer:
     def save(self, token: CheckpointToken, carry: Any,
              extra: Optional[Dict[str, Any]] = None) -> None:
         """Atomically write the sidecar (tmp + rename: a crash mid-save
-        leaves the previous checkpoint intact)."""
+        leaves the previous checkpoint intact), rotating the previous
+        sidecar one generation older first (``checkpoint.keep``)."""
         payload = {
             "version": CKPT_VERSION,
             "kind": self.kind,
@@ -164,6 +266,7 @@ class StreamCheckpointer:
             with os.fdopen(fd, "wb") as fh:
                 pickle.dump(payload, fh,
                             protocol=pickle.HIGHEST_PROTOCOL)
+            _rotate_generations(self.path, self.keep)
             os.replace(tmp, self.path)
         except BaseException:
             try:
@@ -171,46 +274,85 @@ class StreamCheckpointer:
             except OSError:
                 pass
             raise
+        _maybe_corrupt_sidecar(self.path, self.saves)
         self.saves += 1
 
     # -- resume side -------------------------------------------------------
-    def load(self) -> Optional[Dict[str, Any]]:
-        """The validated sidecar payload with ``state`` unpickled, or
-        None when no sidecar exists (resume degrades to a full run —
-        trivially byte-identical).  Raises :class:`CheckpointMismatch`
-        on a version/kind/fingerprint/params mismatch."""
-        if not os.path.exists(self.path):
-            return None
-        with open(self.path, "rb") as fh:
-            payload = pickle.load(fh)
+    def _validate(self, path: str,
+                  payload: Dict[str, Any]) -> Dict[str, Any]:
         if payload.get("version") != CKPT_VERSION:
             raise CheckpointMismatch(
-                f"checkpoint {self.path}: version "
+                f"checkpoint {path}: version "
                 f"{payload.get('version')} != {CKPT_VERSION}")
         if payload.get("kind") != self.kind:
             raise CheckpointMismatch(
-                f"checkpoint {self.path}: kind {payload.get('kind')!r} "
+                f"checkpoint {path}: kind {payload.get('kind')!r} "
                 f"does not match this job ({self.kind!r})")
         fp = input_fingerprint(self.in_path)
         if payload.get("fingerprint") != fp:
             raise CheckpointMismatch(
-                f"checkpoint {self.path} was written against a different "
+                f"checkpoint {path} was written against a different "
                 f"input than {self.in_path!r} — re-run without --resume")
         if payload.get("params") != self.params:
             raise CheckpointMismatch(
-                f"checkpoint {self.path}: chunking/config params changed "
+                f"checkpoint {path}: chunking/config params changed "
                 f"({payload.get('params')} != {self.params}) — resuming "
                 f"would break byte parity; re-run without --resume")
-        payload["state"] = pickle.loads(payload["state"])
+        try:
+            payload["state"] = pickle.loads(payload["state"])
+        except (KeyError, TypeError, pickle.PickleError, EOFError,
+                AttributeError, ImportError, IndexError,
+                UnicodeDecodeError, ValueError) as e:
+            raise CheckpointCorrupt(
+                f"checkpoint {path}: host stream state unreadable "
+                f"({type(e).__name__}: {e})") from None
         return payload
 
+    def load(self) -> Optional[Dict[str, Any]]:
+        """The newest VALID sidecar generation's payload with ``state``
+        unpickled, or None when no sidecar exists (resume degrades to a
+        full run — trivially byte-identical).
+
+        A corrupt generation (truncated save, disk damage) falls back to
+        the next older one — resuming from an older offset only replays
+        more chunks, output stays byte-identical.  Every generation
+        corrupt applies ``checkpoint.fallback``: ``cold`` degrades to a
+        cold start (None), ``fail`` raises :class:`CheckpointCorrupt`.
+        A version/kind/fingerprint/params MISMATCH still raises
+        :class:`CheckpointMismatch` — that is a config error, and an
+        older generation of the same wrong run cannot repair it."""
+        counters = _durability_counters()
+        corrupt: List[str] = []
+        for i, path in enumerate(generation_paths(self.path, self.keep)):
+            if not os.path.exists(path):
+                continue
+            try:
+                payload = self._validate(path, _load_payload(path))
+            except CheckpointCorrupt as e:
+                counters.incr("Durability", "Checkpoint corrupt")
+                corrupt.append(str(e))
+                continue
+            if corrupt:
+                counters.incr("Durability", "Generation fallbacks")
+            return payload
+        if not corrupt:
+            return None                 # no sidecar at all: full run
+        if self.fallback == FALLBACK_FAIL:
+            raise CheckpointCorrupt(
+                f"every checkpoint generation of {self.path} is corrupt "
+                f"({'; '.join(corrupt)}) and {KEY_FALLBACK}="
+                f"{FALLBACK_FAIL}")
+        counters.incr("Durability", "Cold starts")
+        return None
+
     def complete(self) -> None:
-        """Remove the sidecar after a successful run (stale checkpoints
-        must never shadow a completed job's output)."""
-        try:
-            os.unlink(self.path)
-        except FileNotFoundError:
-            pass
+        """Remove every sidecar generation after a successful run (stale
+        checkpoints must never shadow a completed job's output)."""
+        for path in generation_paths(self.path, self.keep):
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
 
 
 # ---------------------------------------------------------------------------
@@ -237,24 +379,75 @@ class WorkflowCheckpointer:
     restarts it mid-file).  A successful workflow deletes the sidecar.
     """
 
-    def __init__(self, path: str, in_path: str, resume: bool = False):
+    def __init__(self, path: str, in_path: str, resume: bool = False,
+                 keep: int = DEFAULT_KEEP, fallback: str = FALLBACK_COLD):
         self.path = path
         self.in_path = in_path
         self.resume = bool(resume)
+        self.keep = max(1, int(keep))
+        self.fallback = fallback
+        #: set when a corrupt sidecar degraded this resume to a fresh
+        #: run — the caller (core.dag) logs it
+        self.degraded_reason: Optional[str] = None
         self._stages: Dict[str, Dict[str, Any]] = {}
-        if resume and os.path.exists(path):
-            with open(path, "rb") as fh:
-                payload = pickle.load(fh)
+        if resume:
+            self._load_generations()
+
+    @classmethod
+    def from_config(cls, config, path: str, in_path: str,
+                    resume: bool) -> "WorkflowCheckpointer":
+        return cls(path, in_path, resume=resume,
+                   keep=config.get_int(KEY_KEEP, DEFAULT_KEEP),
+                   fallback=_fallback_from_config(config))
+
+    def _load_generations(self) -> None:
+        """Walk the sidecar generations newest→oldest; a corrupt sidecar
+        (the bare ``pickle.load`` that used to crash ``dag --resume``
+        before any fallback could run) falls back to an older generation,
+        and with none valid the run degrades to a FRESH workflow (every
+        stage re-runs — always correct) under ``checkpoint.fallback=cold``
+        with a ``Durability / Workflow sidecar corrupt`` warning counter,
+        or raises under ``fail``."""
+        counters = _durability_counters()
+        corrupt: List[str] = []
+        for path in generation_paths(self.path, self.keep):
+            if not os.path.exists(path):
+                continue
+            try:
+                payload = _load_payload(path)
+                stages = payload.get("stages")
+                if not isinstance(stages, dict):
+                    raise CheckpointCorrupt(
+                        f"workflow checkpoint {path} has no stages table")
+            except CheckpointCorrupt as e:
+                counters.incr("Durability", "Workflow sidecar corrupt")
+                corrupt.append(str(e))
+                continue
             if payload.get("version") != WF_CKPT_VERSION:
                 raise CheckpointMismatch(
                     f"workflow checkpoint {path}: version "
                     f"{payload.get('version')} != {WF_CKPT_VERSION}")
-            if payload.get("fingerprint") != input_fingerprint(in_path):
+            if payload.get("fingerprint") != input_fingerprint(
+                    self.in_path):
                 raise CheckpointMismatch(
                     f"workflow checkpoint {path} was written against a "
-                    f"different input than {in_path!r} — re-run without "
-                    f"--resume")
-            self._stages = payload["stages"]
+                    f"different input than {self.in_path!r} — re-run "
+                    f"without --resume")
+            if corrupt:
+                counters.incr("Durability", "Generation fallbacks")
+            self._stages = stages
+            return
+        if not corrupt:
+            return                      # no sidecar: fresh run, as ever
+        if self.fallback == FALLBACK_FAIL:
+            raise CheckpointCorrupt(
+                f"every workflow checkpoint generation of {self.path} is "
+                f"corrupt ({'; '.join(corrupt)}) and {KEY_FALLBACK}="
+                f"{FALLBACK_FAIL}")
+        counters.incr("Durability", "Cold starts")
+        self.degraded_reason = (
+            f"workflow checkpoint {self.path} corrupt in every "
+            f"generation — degrading to a fresh run (all stages re-run)")
 
     @staticmethod
     def params_key(obj: Any) -> str:
@@ -264,9 +457,14 @@ class WorkflowCheckpointer:
         ).hexdigest()
 
     def _fingerprint_ok(self, path: str, recorded) -> bool:
+        from .io import TornArtifactError
         try:
             return input_fingerprint(path) == recorded
         except OSError:
+            return False
+        except TornArtifactError:
+            # a torn input/output artifact can never validate a skip —
+            # the stage re-runs and republishes it (self-healing)
             return False
 
     def stage_done(self, sid: str, params_key: str,
@@ -323,6 +521,7 @@ class WorkflowCheckpointer:
         try:
             with os.fdopen(fd, "wb") as fh:
                 pickle.dump(payload, fh, protocol=pickle.HIGHEST_PROTOCOL)
+            _rotate_generations(self.path, self.keep)
             os.replace(tmp, self.path)
         except BaseException:
             try:
@@ -330,9 +529,11 @@ class WorkflowCheckpointer:
             except OSError:
                 pass
             raise
+        _maybe_corrupt_sidecar(self.path, len(self._stages) - 1)
 
     def complete(self) -> None:
-        try:
-            os.unlink(self.path)
-        except FileNotFoundError:
-            pass
+        for path in generation_paths(self.path, self.keep):
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass

@@ -593,13 +593,17 @@ def run_workflow(config: JobConfig, in_path: str, out_base: Optional[str],
 
     tracer = get_tracer()
     metrics = telemetry.get_metrics()
+    from .io import KEY_REQUIRE_SUCCESS, set_require_success
     stages = load_workflow(config, in_path, out_base)
     by_id = {s.sid: s for s in stages}
     resume = config.get_boolean(KEY_RESUME, False)
     ck_path = config.get(KEY_CKPT_PATH,
                          os.path.join(out_base, "_workflow.ckpt")
                          if out_base else in_path + ".workflow.ckpt")
-    ck = WorkflowCheckpointer(ck_path, in_path, resume=resume)
+    ck = WorkflowCheckpointer.from_config(config, ck_path, in_path,
+                                          resume=resume)
+    if ck.degraded_reason:
+        say(f"dag: {ck.degraded_reason}")
 
     store = ArtifactStore(
         verify=config.get_boolean(KEY_HANDOFF_VERIFY, True))
@@ -637,6 +641,13 @@ def run_workflow(config: JobConfig, in_path: str, out_base: Optional[str],
 
     results: Dict[str, Counters] = {}
     done: set = set()
+    # io.require.success (strict _SUCCESS-marker mode) applies to every
+    # stage input read below — a half-written upstream directory fails
+    # the consuming stage fast instead of training on half an artifact.
+    # Process-global, so the finally restores the caller's setting (a
+    # strict workflow must not leak strict mode into later jobs).
+    prev_strict = set_require_success(
+        config.get_boolean(KEY_REQUIRE_SUCCESS, False))
     prev_store = set_artifact_store(store)
     try:
         with tracer.span("dag.run", stages=",".join(by_id)):
@@ -749,6 +760,7 @@ def run_workflow(config: JobConfig, in_path: str, out_base: Optional[str],
                 pass
     finally:
         set_artifact_store(prev_store)
+        set_require_success(prev_strict)
     metrics.counters.set("Dag", "Memory handoffs", store.memory_reads)
     say(f"dag: workflow complete — {len(stages)} stages, "
         f"{store.memory_reads} in-memory artifact reads")

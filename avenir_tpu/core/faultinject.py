@@ -59,6 +59,21 @@ Instrumented points (grep ``fire(`` / ``mangle(`` call sites):
                       (the SLO-violation test in tests/test_slo.py)
 ``batcher_death``     serving batcher worker loop iterations — raises
                       ``SimulatedWorkerDeath``
+``scorer_poison``     serving scorer batches whose lines contain the
+                      entry's ``arg`` marker (default "POISON") — raises
+                      ``InjectedScorerFault`` for the WHOLE batch, like a
+                      real poison row does (the bisect-isolation path in
+                      serve/batcher.py; content-based, so every rescored
+                      sub-batch containing the row fails too)
+``torn_write``        ``OutputWriter.close`` publishes — simulates the
+                      legacy in-place writer crashing mid-write: half the
+                      staged bytes land at the final path with NO
+                      manifest/_SUCCESS update, then ``InjectedFault``
+                      (the reader-validation / safe-reload path)
+``ckpt_corrupt``      checkpoint sidecar saves by save index — the
+                      just-written sidecar is truncated in place after a
+                      successful save (crash mid-checkpoint-write /disk
+                      corruption; the generation-fallback path)
 ====================  =====================================================
 
 Disabled-mode cost: ``get_injector()`` returns None until a plan is
@@ -80,7 +95,8 @@ KEY_SEED = "fault.inject.seed"
 
 #: the known instrumented points (parse-time typo guard)
 POINTS = ("read", "corrupt", "slow", "h2d", "worker_death", "scorer",
-          "scorer_slow", "batcher_death")
+          "scorer_slow", "batcher_death", "scorer_poison", "torn_write",
+          "ckpt_corrupt")
 
 
 class InjectedReadError(OSError):
@@ -229,6 +245,47 @@ class FaultInjector:
         return None
 
     # -- the injection points ----------------------------------------------
+    def armed(self, point: str, index: Optional[int] = None,
+              tag: Optional[str] = None):
+        """The armed entry matching (point, index, tag), CONSUMING one
+        firing, or None — for points whose fault is enacted by the call
+        site itself rather than raised here (``torn_write`` tears the
+        staged file, ``ckpt_corrupt`` truncates the just-written
+        sidecar)."""
+        return self._due(point, index, tag)
+
+    def fire_poison(self, lines, tag: Optional[str] = None) -> None:
+        """The ``scorer_poison`` point: raise InjectedScorerFault when
+        any of the batch's ``lines`` contains an armed entry's marker
+        (``arg``, default "POISON").  Content-based, so the bisect
+        isolation in serve/batcher.py deterministically re-fails every
+        rescored sub-batch still containing the poison row while its
+        cohabitants' sub-batches succeed."""
+        matched = [
+            (eid, e) for eid, e in enumerate(self.plan)
+            if e.point == "scorer_poison"
+            and (e.tag is None or e.tag == tag)
+            and any((e.arg or "POISON") in l for l in lines)]
+        if not matched:
+            return
+        # one occurrence index per marker-matching batch; the firing
+        # budget consumed belongs to the entry whose marker matched (an
+        # exhausted entry falls through to the next matching one, so a
+        # multi-marker plan's budgets stay independent)
+        index = self._next_index("scorer_poison", tag)
+        with self._lock:
+            for eid, e in matched:
+                if not e.matches(index, tag):
+                    continue
+                k = (eid, index, tag)
+                if self._fired.get(k, 0) >= e.count:
+                    continue
+                self._fired[k] = self._fired.get(k, 0) + 1
+                self.fired_log.append(("scorer_poison", index))
+                raise InjectedScorerFault(
+                    f"injected poison-batch failure "
+                    f"(marker {(e.arg or 'POISON')!r} in batch)")
+
     def fire(self, point: str, index: Optional[int] = None,
              tag: Optional[str] = None) -> None:
         """Raise/sleep per the plan at an instrumented point (no-op when

@@ -10,13 +10,118 @@ written here can be read by reference tooling and vice versa.
 Input paths may be a single file or a directory (all non-hidden files inside,
 sorted — mirroring how MR consumes every part file of a previous job's output
 directory).
+
+Durability contract (README "Fault tolerance"): MapReduce job outputs are
+only real once the ``_SUCCESS`` marker lands, and a failed task's partial
+output is never trusted (Dean & Ghemawat, OSDI 2004).  This module
+enforces that contract on the write AND read side:
+
+- :class:`OutputWriter` stages every part file to a temp path in the same
+  directory and publishes it with ``fsync + os.replace`` — a crash
+  mid-write leaves the previous artifact intact, never a torn file at the
+  final path.  Before ``_SUCCESS`` it writes a ``_MANIFEST`` sidecar
+  (per-part byte length + sha1), also atomically.
+- Readers (:func:`read_lines`, :func:`read_field_matrix`, the serving
+  registry loaders, DAG artifact refs — everything funneling through
+  :func:`_input_files`) validate the manifest when one is present: a part
+  whose size or checksum disagrees raises :class:`TornArtifactError`
+  instead of silently consuming half an artifact.  Validation results are
+  cached per (directory, manifest stat) so repeated reads of an unchanged
+  artifact hash its parts once.
+- ``io.require.success=true`` (:func:`configure_from_config`) adds the
+  strict mode: a DIRECTORY input without a ``_SUCCESS`` marker is refused
+  with an error naming the path — DAG stage inputs opt in so a
+  half-written upstream output fails the consumer fast.
+- :func:`atomic_write_text` is the same temp+fsync+replace primitive for
+  single-file artifacts written outside :class:`OutputWriter` (the
+  decision-tree JSON, regression coefficient history); the tier-2 lint
+  (tests/test_resilience_coverage.py) keeps every artifact-path
+  ``open(..., "w")`` either atomic or on ``NON_ATOMIC_WRITES`` with a
+  written reason.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import re
-from typing import Dict, Iterable, Iterator, List, Optional
+import tempfile
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+from . import faultinject
+
+KEY_REQUIRE_SUCCESS = "io.require.success"
+
+MANIFEST_NAME = "_MANIFEST"
+SUCCESS_NAME = "_SUCCESS"
+MANIFEST_VERSION = 1
+
+
+class TornArtifactError(RuntimeError):
+    """A job-output artifact failed durability validation (torn part,
+    missing/mismatched manifest entry, or — in strict mode — a missing
+    ``_SUCCESS`` marker).  The message names the path and the repair
+    (re-run the producing job); consumers that hold an older healthy
+    version (the serving registry's hot-swap reload) keep serving it."""
+
+
+_REQUIRE_SUCCESS = False
+
+#: truncate-mode write sites ("module:qualname") that are deliberately
+#: NOT routed through the atomic publish layer (OutputWriter /
+#: atomic_write_text), each with the reason the torn-on-crash shape is
+#: acceptable there.  The tier-2 lint
+#: (tests/test_resilience_coverage.py) fails on any ``open(..., "w")``
+#: outside the atomic primitives that is not on this list, and on any
+#: stale entry whose call site was removed or made atomic.
+NON_ATOMIC_WRITES: Dict[str, str] = {
+    "core/checkpoint.py:StreamCheckpointer.save":
+        "atomic by construction: pickles to a same-dir mkstemp fd and "
+        "lands via os.replace (binary payload, so atomic_write_text's "
+        "text surface does not fit) — a crash mid-save leaves the "
+        "previous generation intact",
+    "core/checkpoint.py:WorkflowCheckpointer.record":
+        "atomic by construction, same tmp+replace shape as "
+        "StreamCheckpointer.save",
+    "core/obs.py:Tracer.export_jsonl":
+        "diagnostic trace export, not a job artifact: no reader "
+        "validates it, a torn trace breaks no downstream job, and "
+        "re-running with --trace is the recovery path",
+    "core/obs.py:Tracer.export_chrome_trace":
+        "diagnostic trace export, same contract as export_jsonl",
+    "core/resilience.py:RowQuarantine._write":
+        "quarantine audit sidecar: first open truncates a stale sidecar "
+        "from a previous run, then appends evidence rows as they are "
+        "quarantined — an audit trail, not a consumed artifact; the "
+        "authoritative recovery object is the job's (atomic) output",
+    "datagen/cli.py:main":
+        "synthetic dataset generator (input-side dev tooling): "
+        "re-generating is the recovery path, and job inputs are "
+        "validated by the ingest layer, not published by it",
+}
+
+
+def set_require_success(flag: bool) -> bool:
+    """Install the strict ``_SUCCESS``-marker mode for directory inputs;
+    returns the previous setting so callers can restore it."""
+    global _REQUIRE_SUCCESS
+    prev = _REQUIRE_SUCCESS
+    _REQUIRE_SUCCESS = bool(flag)
+    return prev
+
+
+def configure_from_config(config) -> None:
+    """Apply the ``io.*`` config surface (called by every CLI entry point
+    next to the resilience configure)."""
+    set_require_success(config.get_boolean(KEY_REQUIRE_SUCCESS, False))
+
+
+def _durability_counters():
+    """The process-global ``Durability`` counter group (rides the
+    telemetry registry, so ``--metrics-out`` exports recovery events)."""
+    from . import telemetry
+    return telemetry.get_metrics().counters
 
 
 class ArtifactStore:
@@ -89,14 +194,17 @@ class ArtifactStore:
             return None
         self.memory_reads += 1
         if self.verify and ap not in self._verified:
-            self._verified.add(ap)
             if os.path.exists(ap):
+                # may raise TornArtifactError (manifest validation) — a
+                # failed check must NOT mark the artifact verified, so a
+                # later read re-checks after a repair
                 on_disk = list(_read_lines_files(ap))
                 if on_disk != lines:
                     raise AssertionError(
                         f"artifact store: in-memory lines for {ap} differ "
                         f"from the file round-trip ({len(lines)} vs "
                         f"{len(on_disk)} lines) — handoff parity broken")
+            self._verified.add(ap)
         return lines
 
 
@@ -117,13 +225,117 @@ def get_artifact_store() -> Optional[ArtifactStore]:
     return _ARTIFACTS
 
 
+def _sha1_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha1()
+    with open(path, "rb") as fh:
+        while True:
+            block = fh.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
+def load_manifest(dir_path: str) -> Optional[dict]:
+    """The directory's ``_MANIFEST`` document, or None when absent.
+    An unreadable/garbled manifest IS a torn artifact (the publish died
+    between the part replace and the manifest replace can never produce
+    one — the manifest write is atomic — so garbage here means external
+    corruption)."""
+    mpath = os.path.join(dir_path, MANIFEST_NAME)
+    if not os.path.exists(mpath):
+        return None
+    try:
+        with open(mpath, "r") as fh:
+            doc = json.load(fh)
+        if not isinstance(doc.get("parts"), dict):
+            raise ValueError("manifest has no parts table")
+        return doc
+    except (ValueError, OSError) as e:
+        _durability_counters().incr("Durability", "Torn artifacts")
+        raise TornArtifactError(
+            f"{mpath} is unreadable ({e}) — artifact torn; re-run the "
+            f"producing job") from None
+
+
+#: validation memo: (dir abspath) -> (manifest stat sig, part stat sigs)
+#: so repeated reads of an unchanged artifact hash its parts once
+_VALIDATED: Dict[str, Tuple] = {}
+_VALIDATED_CAP = 256
+
+
+def _stat_sig(path: str):
+    st = os.stat(path)
+    return (st.st_size, st.st_mtime_ns)
+
+
+def validate_artifact_dir(path: str, files: List[str]) -> None:
+    """Durability validation for one directory input: the strict
+    ``_SUCCESS`` check (``io.require.success=true``), then — when a
+    ``_MANIFEST`` is present — per-part byte length + sha1 against it.
+    Raises :class:`TornArtifactError` naming the path and part."""
+    if _REQUIRE_SUCCESS and not os.path.exists(
+            os.path.join(path, SUCCESS_NAME)):
+        _durability_counters().incr("Durability", "Unmarked inputs refused")
+        raise TornArtifactError(
+            f"{path}: no {SUCCESS_NAME} marker — the producing job did not "
+            f"complete (half-written upstream output?); re-run the "
+            f"producer or unset {KEY_REQUIRE_SUCCESS}")
+    mpath = os.path.join(path, MANIFEST_NAME)
+    if not os.path.exists(mpath):
+        return
+    ap = os.path.abspath(path)
+    sig = (_stat_sig(mpath), tuple(_stat_sig(fp) for fp in files))
+    if _VALIDATED.get(ap) == sig:
+        return
+    doc = load_manifest(path)
+    parts = doc["parts"]
+    for fp in files:
+        name = os.path.basename(fp)
+        rec = parts.get(name)
+        if not isinstance(rec, dict):
+            _durability_counters().incr("Durability", "Torn artifacts")
+            raise TornArtifactError(
+                f"{path}: part {name} is not in {MANIFEST_NAME} — "
+                f"partial overwrite detected; re-run the producing job")
+        size = os.path.getsize(fp)
+        if size != rec.get("bytes"):
+            _durability_counters().incr("Durability", "Torn artifacts")
+            raise TornArtifactError(
+                f"{path}: part {name} is {size} bytes but {MANIFEST_NAME} "
+                f"records {rec.get('bytes')} — torn artifact (crash "
+                f"mid-write?); re-run the producing job")
+        if _sha1_file(fp) != rec.get("sha1"):
+            _durability_counters().incr("Durability", "Torn artifacts")
+            raise TornArtifactError(
+                f"{path}: part {name} checksum mismatch against "
+                f"{MANIFEST_NAME} — torn/corrupt artifact; re-run the "
+                f"producing job")
+    # the reverse direction: every manifest entry must still exist on
+    # disk, or the read silently consumes a PARTIAL artifact
+    listed = {os.path.basename(fp) for fp in files}
+    lost = sorted(set(parts) - listed)
+    if lost:
+        _durability_counters().incr("Durability", "Torn artifacts")
+        raise TornArtifactError(
+            f"{path}: {MANIFEST_NAME} records part(s) {', '.join(lost)} "
+            f"that no longer exist — partial artifact (deleted/lost "
+            f"part?); re-run the producing job")
+    if len(_VALIDATED) >= _VALIDATED_CAP:
+        _VALIDATED.clear()
+    _VALIDATED[ap] = sig
+    _durability_counters().incr("Durability", "Artifacts validated")
+
+
 def _input_files(path: str) -> List[str]:
     if os.path.isdir(path):
-        return sorted(
+        files = sorted(
             os.path.join(path, f)
             for f in os.listdir(path)
             if not f.startswith(("_", ".")) and os.path.isfile(os.path.join(path, f))
         )
+        validate_artifact_dir(path, files)
+        return files
     return [path]
 
 
@@ -199,14 +411,61 @@ def read_field_matrix(path: str, delim_regex: str = ","):
     return np.asarray(flat, dtype=str).reshape(len(lines), n_delim + 1)
 
 
-class OutputWriter:
-    """Writes job output in the reference's directory layout.
+def _fsync_dir(dir_path: str) -> None:
+    """Best-effort directory fsync so the rename itself is durable."""
+    try:
+        fd = os.open(dir_path or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
-    ``OutputWriter(dir)`` produces ``dir/part-r-00000`` (plus ``_SUCCESS`` on
-    close). ``shard`` selects the part number so callers can emulate
-    partitioned reducer output (tree/DataPartitioner.java writes one part file
-    per segment); with ``as_dir=False`` the path is written as a bare file
-    (truncating any existing content) and ``shard`` is rejected.
+
+def atomic_write_text(path: str, text: str) -> None:
+    """Crash-safe single-file write: stage to a temp file in the same
+    directory, flush + fsync, then ``os.replace`` — a reader (or a
+    resumed run) sees either the previous complete content or the new
+    complete content, never a torn file.  The atomic primitive for
+    artifact files written outside :class:`OutputWriter` (the
+    decision-tree JSON checkpoint, the regression coefficient history)."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(prefix="." + os.path.basename(path) + ".",
+                               dir=d)
+    try:
+        with os.fdopen(fd, "w") as fh:
+            fh.write(text)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+class OutputWriter:
+    """Writes job output in the reference's directory layout,
+    crash-safely.
+
+    ``OutputWriter(dir)`` produces ``dir/part-r-00000`` plus, on a
+    successful close, a ``_MANIFEST`` sidecar (per-part byte length +
+    sha1) and the ``_SUCCESS`` marker.  The part file is STAGED to a temp
+    path in the same directory and published with ``fsync +
+    os.replace`` — a crash mid-write leaves any previous part intact and
+    the stage discarded, never a torn file under the final name (the old
+    ``open(path, "w")`` tore in place).  ``shard`` selects the part
+    number so callers can emulate partitioned reducer output
+    (tree/DataPartitioner.java writes one part file per segment); shard
+    manifests merge, so every part of a partitioned output validates.
+    With ``as_dir=False`` the path is written as a bare file (atomic
+    replace, no manifest/marker) and ``shard`` is rejected.
     """
 
     def __init__(self, out_path: str, shard: Optional[int] = None, as_dir: bool = True):
@@ -222,7 +481,11 @@ class OutputWriter:
             if parent:
                 os.makedirs(parent, exist_ok=True)
             self.file_path = out_path
-        self._fh = open(self.file_path, "w")
+        d = os.path.dirname(self.file_path) or "."
+        fd, self._tmp_path = tempfile.mkstemp(
+            prefix="." + os.path.basename(self.file_path) + ".", dir=d)
+        self._fh = os.fdopen(fd, "w")
+        self._closed = False
 
     def write(self, line: str) -> None:
         self._fh.write(line)
@@ -232,10 +495,76 @@ class OutputWriter:
         for line in lines:
             self.write(line)
 
+    def _tear(self) -> None:
+        """The ``torn_write`` fault point: simulate the LEGACY in-place
+        writer crashing mid-write — half the staged bytes land under the
+        final name, no manifest update, no ``_SUCCESS`` — then die.  Any
+        stale ``_MANIFEST`` from a previous publish now disagrees with
+        the torn bytes, which is exactly what reader validation (and the
+        torn-artifact reload test) must catch."""
+        with open(self._tmp_path, "rb") as fh:
+            data = fh.read()
+        with open(self.file_path, "wb") as out:
+            out.write(data[:max(len(data) // 2, 1)])
+        try:
+            os.unlink(self._tmp_path)
+        except OSError:
+            pass
+        raise faultinject.InjectedFault(
+            f"injected torn write ({self.file_path})")
+
+    def _update_manifest(self) -> None:
+        """Merge this part into the directory's ``_MANIFEST`` (other
+        shards' entries survive) and rewrite it atomically."""
+        parts: Dict[str, dict] = {}
+        existing = os.path.join(self.out_path, MANIFEST_NAME)
+        if os.path.exists(existing):
+            try:
+                with open(existing, "r") as fh:
+                    doc = json.load(fh)
+                if isinstance(doc.get("parts"), dict):
+                    parts = doc["parts"]
+            except (ValueError, OSError):
+                pass        # rewrite from scratch: this part is the truth
+        name = os.path.basename(self.file_path)
+        parts[name] = {"bytes": os.path.getsize(self.file_path),
+                       "sha1": _sha1_file(self.file_path)}
+        # drop entries whose part no longer exists (a re-run that writes
+        # fewer shards must not leave the manifest naming ghosts)
+        parts = {n: rec for n, rec in parts.items()
+                 if os.path.exists(os.path.join(self.out_path, n))}
+        atomic_write_text(existing, json.dumps(
+            {"version": MANIFEST_VERSION, "parts": parts}, indent=1))
+
     def close(self, success_marker: bool = True) -> None:
-        self._fh.close()
-        if self.as_dir and success_marker:
-            open(os.path.join(self.out_path, "_SUCCESS"), "w").close()
+        if self._closed:
+            return
+        self._closed = True
+        fh = self._fh
+        fh.flush()
+        if success_marker:
+            try:
+                os.fsync(fh.fileno())
+            except OSError:
+                pass
+        fh.close()
+        if not success_marker:
+            # aborted write: discard the stage — any previous artifact
+            # at the final path stays intact and validated
+            try:
+                os.unlink(self._tmp_path)
+            except OSError:
+                pass
+            return
+        fi = faultinject.get_injector()
+        if fi is not None and fi.armed("torn_write") is not None:
+            self._tear()
+        os.replace(self._tmp_path, self.file_path)
+        _fsync_dir(os.path.dirname(self.file_path))
+        _VALIDATED.pop(os.path.abspath(self.out_path), None)
+        if self.as_dir:
+            self._update_manifest()
+            open(os.path.join(self.out_path, SUCCESS_NAME), "w").close()
 
     def __enter__(self) -> "OutputWriter":
         return self

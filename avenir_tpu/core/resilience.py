@@ -190,11 +190,33 @@ NON_RETRYABLE: Dict[str, str] = {
         "monolithic fallback loader, same fail-fast artifact-read contract "
         "as read_lines; the streaming hot path retries via _read_buffer",
     "core/io.py:OutputWriter.__init__":
-        "output-side writes: a failed emit fails the job after compute; "
-        "re-running the job (or --resume) is the recovery path, not a "
-        "mid-write retry that could duplicate part-file content",
+        "output-side writes (staged temp part file): a failed emit fails "
+        "the job after compute; re-running the job (or --resume) is the "
+        "recovery path, not a mid-write retry that could duplicate "
+        "part-file content",
     "core/io.py:OutputWriter.close":
         "output-side _SUCCESS marker, same contract as OutputWriter writes",
+    "core/io.py:OutputWriter._tear":
+        "torn_write fault-injection path only: deliberately simulates the "
+        "crash the durability layer must detect — retrying would defeat "
+        "the injection",
+    "core/io.py:OutputWriter._update_manifest":
+        "output-side _MANIFEST sidecar (atomic via atomic_write_text), "
+        "same fail-fast contract as the part-file writes it describes",
+    "core/io.py:atomic_write_text":
+        "output-side atomic single-file publish (tmp+fsync+replace): a "
+        "failed write must fail the producing job loudly; retrying a "
+        "rename-landing write risks publishing a half-regenerated "
+        "artifact as current",
+    "core/io.py:_sha1_file":
+        "manifest checksum validation read: runs at artifact-load time "
+        "next to the fail-fast read_lines reads of the same files; a "
+        "checksum mismatch must surface as TornArtifactError, not be "
+        "retried into a different answer",
+    "core/io.py:load_manifest":
+        "_MANIFEST sidecar read at artifact-load time: an unreadable "
+        "manifest IS the torn-artifact signal (TornArtifactError), not a "
+        "transient to retry through",
     "core/config.py:JobConfig.from_file":
         "config load is a fail-fast user error (bad -Dconf.path); retrying "
         "cannot repair a wrong path",
@@ -211,13 +233,14 @@ NON_RETRYABLE: Dict[str, str] = {
         "checkpoint sidecar write: a failed save must NOT retry-stall the "
         "stream; the job continues and the previous checkpoint remains "
         "valid (write is atomic via tmp+rename)",
-    "core/checkpoint.py:StreamCheckpointer.load":
-        "resume-time sidecar read: a missing/unreadable checkpoint falls "
-        "back to a full re-run, which is always correct",
-    "core/checkpoint.py:WorkflowCheckpointer.__init__":
-        "resume-time workflow sidecar read, same contract as "
-        "StreamCheckpointer.load: a missing sidecar falls back to a full "
-        "re-run; an unreadable one fails fast with the path named",
+    "core/checkpoint.py:_load_payload":
+        "resume-time sidecar read: a missing sidecar falls back to a full "
+        "re-run and an unreadable one surfaces as CheckpointCorrupt so "
+        "the generation walk (newest->oldest->cold) can degrade — "
+        "retrying cannot repair corrupt bytes",
+    "core/checkpoint.py:_maybe_corrupt_sidecar":
+        "ckpt_corrupt fault-injection path only: deliberately truncates "
+        "the sidecar the generation fallback must then survive",
     "core/checkpoint.py:WorkflowCheckpointer.record":
         "stage-completion sidecar write, same contract as "
         "StreamCheckpointer.save: atomic via tmp+rename, and a failed "

@@ -44,7 +44,8 @@ from jax.sharding import PartitionSpec as P
 
 from ..core.config import JobConfig
 from ..core.obs import traced_run
-from ..core.io import read_lines, split_line, write_output
+from ..core.io import (atomic_write_text, read_lines, split_line,
+                       write_output)
 from ..core.metrics import Counters
 from ..core.schema import FeatureSchema
 from ..parallel.mesh import get_mesh, pad_rows
@@ -131,9 +132,11 @@ class LogisticRegressionJob:
         return [l for l in read_lines(path)]
 
     def _write_history(self, lines: List[str]) -> None:
-        with open(self.config.must("coeff.file.path"), "w") as f:
-            for line in lines:
-                f.write(line + "\n")
+        # the coefficient history drives iterative restart (README
+        # "Failure recovery"): atomic replace, so a crash mid-iteration
+        # leaves the previous complete history, never a torn file
+        atomic_write_text(self.config.must("coeff.file.path"),
+                          "".join(line + "\n" for line in lines))
 
     # -- data ---------------------------------------------------------------
     def _load(self, in_path: str, mesh=None):

@@ -67,7 +67,8 @@ import numpy as np
 from ..core.binning import Vocab
 from ..core.obs import traced_run
 from ..core.config import JobConfig
-from ..core.io import OutputWriter, read_lines, split_line, write_output
+from ..core.io import (OutputWriter, atomic_write_text, read_lines,
+                       split_line, write_output)
 from ..core.metrics import Counters
 from ..core.schema import FeatureField, FeatureSchema
 from ..ops.counting import (count_on_mxu, count_table, masked_onehot,
@@ -604,8 +605,7 @@ class DecisionTreeBuilder:
 
         dpl = DecisionPathList(
             [DecisionPath([ROOT_PATH], int(counts.sum()), stat, False)])
-        with open(self.decision_file, "w") as fh:
-            fh.write(dpl.to_json(self.schema))
+        atomic_write_text(self.decision_file, dpl.to_json(self.schema))
         write_output(out_path, (f"{ROOT_PATH}{delim}{l}" for l in lines))
         return counters
 
@@ -667,10 +667,9 @@ class DecisionTreeBuilder:
             for p in path_objs:
                 if p is not None:
                     p.stopped = True
-            with open(self.decision_file, "w") as fh:
-                fh.write(DecisionPathList(
-                    [p for p in path_objs if p is not None]
-                ).to_json(self.schema))
+            atomic_write_text(self.decision_file, DecisionPathList(
+                [p for p in path_objs if p is not None]
+            ).to_json(self.schema))
             write_output(out_path, (raw[i] for i in range(len(raw))
                                     if path_objs[path_id[i]] is not None))
             return counters
@@ -699,8 +698,8 @@ class DecisionTreeBuilder:
             path_objs, active, passthrough, cand_attrs, preds, pred_attr,
             counts, stopping)
 
-        with open(self.decision_file, "w") as fh:
-            fh.write(new_dpl.to_json(self.schema))
+        atomic_write_text(self.decision_file,
+                          new_dpl.to_json(self.schema))
 
         # output: every record once per satisfied predicate OF THE SELECTED
         # attribute, path extended; stopped paths pass through.  (The
@@ -866,10 +865,9 @@ class DecisionTreeBuilder:
             for p in path_objs:
                 if p is not None:
                     p.stopped = True
-            with open(self.decision_file, "w") as fh:
-                fh.write(DecisionPathList(
-                    [p for p in path_objs if p is not None]
-                ).to_json(self.schema))
+            atomic_write_text(self.decision_file, DecisionPathList(
+                [p for p in path_objs if p is not None]
+            ).to_json(self.schema))
             with OutputWriter(out_path) as w:
                 for lines in pipeline.iter_line_chunks(in_path, chunk_rows):
                     path_c, _, _ = parse_chunk(lines)
@@ -894,8 +892,8 @@ class DecisionTreeBuilder:
         new_dpl, selected_attr = self._level_cleanup(
             path_objs, active, passthrough, cand_attrs, preds, pred_attr,
             counts, stopping)
-        with open(self.decision_file, "w") as fh:
-            fh.write(new_dpl.to_json(self.schema))
+        atomic_write_text(self.decision_file,
+                          new_dpl.to_json(self.schema))
 
         # pass 2: re-stream the input and emit routed records per chunk.
         # Only predicates of SELECTED attributes are ever consulted here
@@ -1026,9 +1024,9 @@ class DataPartitioner:
         for si in range(split.segment_count):
             seg_dir = os.path.join(out_base, f"segment={si}", "data")
             os.makedirs(seg_dir, exist_ok=True)
-            with open(os.path.join(seg_dir, "partition.txt"), "w") as fh:
-                for i in np.nonzero(seg == si)[0]:
-                    fh.write(lines[i] + "\n")
+            atomic_write_text(
+                os.path.join(seg_dir, "partition.txt"),
+                "".join(lines[i] + "\n" for i in np.nonzero(seg == si)[0]))
             counters.set("Partition", f"segment {si}",
                          int((seg == si).sum()))
         return counters

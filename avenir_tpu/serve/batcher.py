@@ -38,13 +38,40 @@ Graceful degradation (this PR's resilience layer):
   worker (called defensively on submit and periodically by the server's
   watchdog thread), so a single escaped exception can never permanently
   wedge the queue: pending requests are drained by the replacement.
+
+Poison-batch isolation (``serve.poison.*``; README "Fault tolerance"):
+micro-batching co-schedules unrelated clients' rows, so ONE hostile row
+used to fail its whole batch — innocent cohabitants got the scorer's
+exception and the shared breaker counted a failure for everyone.  With
+``serve.poison.isolate=true``, a failed batch is BISECT-RESCORED: halves
+re-score recursively until the offending row(s) are isolated as
+singletons.  Innocent rows get their real results; only poison rows get
+a structured :class:`PoisonRowError`; the breaker records a SUCCESS
+(the scorer is demonstrably healthy — it scored the innocents) unless
+every row of a MULTI-row batch fails alone, which is a systemic scorer
+failure and feeds the breaker exactly as before.  A failed SINGLETON
+batch is locally indistinguishable from poison, so history breaks the
+tie: a row with recorded offenses is a KNOWN offender and classifies
+poison unconditionally (a hot lone poison client accumulates to
+quarantine and never trips the breaker), and a NEW row classifies
+poison only when the previous batch scored something — a new row
+failing right after a fully-failed batch is consecutive total failure,
+which is scorer-shaped and feeds the breaker as systemic (so a
+genuinely sick scorer under batch-size-1 traffic still trips it, and
+innocent retried rows stop accumulating quarantine offenses once the
+systemic classification takes over).  Repeat offenders land in a bounded
+:class:`PoisonQuarantine` signature cache (shared across a model's
+replicas) and are refused AT SUBMIT after
+``serve.poison.quarantine.threshold`` offenses — a hot poison client
+stops costing scorer time at all.
 """
 
 from __future__ import annotations
 
+import hashlib
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from concurrent.futures import Future
 from typing import Callable, List, Optional
 
@@ -55,9 +82,96 @@ from .breaker import CircuitBreaker, CircuitOpenError
 
 SERVE_GROUP = "Serve"
 
+KEY_POISON_ISOLATE = "serve.poison.isolate"
+KEY_POISON_THRESHOLD = "serve.poison.quarantine.threshold"
+KEY_POISON_CACHE = "serve.poison.cache.size"
+
+DEFAULT_POISON_THRESHOLD = 3
+DEFAULT_POISON_CACHE = 1024
+
 
 class ShedError(RuntimeError):
     """Raised by submit() when the queue is at ``serve.queue.max.depth``."""
+
+
+class PoisonRowError(RuntimeError):
+    """A row individually failed the scorer (isolated by bisect) or was
+    refused at submit after repeat offenses — a PER-ROW structured
+    error: cohabiting rows in the same wire request/micro-batch are
+    unaffected, and poison failures never feed the circuit breaker."""
+
+
+class PoisonQuarantine:
+    """Bounded LRU signature cache of repeat-offender rows, shared by
+    every replica (and variant) of one model.
+
+    ``record`` counts an isolated poison failure for a row's signature;
+    once a signature reaches ``threshold`` offenses, ``quarantined``
+    turns true and submits of that row are refused immediately with
+    :class:`PoisonRowError` — no queue slot, no scorer time, no bisect.
+    The cache is capped at ``serve.poison.cache.size`` signatures
+    (least-recently-offended evicted), so an adversarial stream of
+    unique poison rows cannot grow it without bound."""
+
+    def __init__(self, threshold: int = DEFAULT_POISON_THRESHOLD,
+                 cap: int = DEFAULT_POISON_CACHE):
+        self.threshold = max(1, int(threshold))
+        self.cap = max(1, int(cap))
+        self._counts: "OrderedDict[str, int]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_config(cls, config) -> Optional["PoisonQuarantine"]:
+        """None when quarantine is disabled
+        (``serve.poison.quarantine.threshold=0``)."""
+        threshold = config.get_int(KEY_POISON_THRESHOLD,
+                                   DEFAULT_POISON_THRESHOLD)
+        if threshold <= 0:
+            return None
+        return cls(threshold,
+                   config.get_int(KEY_POISON_CACHE, DEFAULT_POISON_CACHE))
+
+    @staticmethod
+    def signature(line: str) -> str:
+        return hashlib.sha1(line.encode("utf-8", "replace")).hexdigest()[:16]
+
+    def record(self, line: str) -> int:
+        """Count one isolated poison failure; returns the new offense
+        count for the row's signature."""
+        sig = self.signature(line)
+        with self._lock:
+            n = self._counts.pop(sig, 0) + 1
+            self._counts[sig] = n
+            while len(self._counts) > self.cap:
+                self._counts.popitem(last=False)
+            return n
+
+    def quarantined(self, line: str) -> bool:
+        sig = self.signature(line)
+        with self._lock:
+            n = self._counts.get(sig)
+            if n is None:
+                return False
+            self._counts.move_to_end(sig)
+            return n >= self.threshold
+
+    def offenses(self, line: str) -> int:
+        """Recorded offense count for the row (0 = never seen): a row
+        with history is a KNOWN offender — the batcher's singleton
+        tie-breaker classifies its repeat failures as poison even
+        right after a fully-failed batch."""
+        with self._lock:
+            return self._counts.get(self.signature(line), 0)
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._counts)
+
+    def clear(self) -> None:
+        """Forget every offense (a model reload may have repaired the
+        scorer-side cause, so quarantined rows deserve a fresh trial)."""
+        with self._lock:
+            self._counts.clear()
 
 
 class _Request:
@@ -83,7 +197,9 @@ class MicroBatcher:
                  hist_buckets: Optional[int] = None,
                  deadline_ms: float = 0.0,
                  breaker: Optional[CircuitBreaker] = None,
-                 fault_tag: Optional[str] = None):
+                 fault_tag: Optional[str] = None,
+                 poison_isolate: bool = False,
+                 quarantine: Optional[PoisonQuarantine] = None):
         self.name = name
         self.predict_fn = predict_fn
         self.counters = counters
@@ -91,6 +207,10 @@ class MicroBatcher:
         # the model VARIANT so a plan like scorer_slow[f32]@*:40 slows
         # exactly one variant's scorers (the router-demotion test)
         self.fault_tag = fault_tag
+        self.poison_isolate = bool(poison_isolate)
+        # shared across the model's replicas (the pool passes one), so a
+        # poison client bouncing between replicas still accumulates
+        self.quarantine = quarantine
         self.max_batch = max(1, int(max_batch))
         self.max_delay = max(0.0, float(max_delay_ms)) / 1000.0
         self.max_queue_depth = max(1, int(max_queue_depth))
@@ -99,6 +219,11 @@ class MicroBatcher:
         self._q: deque = deque()
         self._cv = threading.Condition()
         self._closed = False
+        # did the previous batch fail in its entirety?  Breaks the
+        # poison-vs-systemic tie for failed SINGLETON batches: one
+        # failure after demonstrated health is poison; consecutive
+        # total failure is scorer-shaped and feeds the breaker
+        self._last_all_failed = False
         # per-request latency distributions: the shared log-bucketed
         # histogram (core.obs) — bounded memory under sustained traffic,
         # internally locked, mergeable across batchers
@@ -123,12 +248,31 @@ class MicroBatcher:
                 f"model {self.name!r} circuit breaker is "
                 f"{self.breaker.state} after consecutive scorer failures")
 
+    def _quarantine_check(self, line: str) -> Optional[Future]:
+        """A pre-resolved PoisonRowError future when the row is
+        quarantined (refused at submit — no queue slot, no scorer time),
+        else None."""
+        if self.quarantine is None or not self.quarantine.quarantined(line):
+            return None
+        self.counters.incr(SERVE_GROUP, "Poison quarantined submits")
+        f: Future = Future()
+        f.set_exception(PoisonRowError(
+            f"row quarantined after >= {self.quarantine.threshold} "
+            f"isolated poison failures (serve.poison.quarantine."
+            f"threshold); fix the row or reload the model to clear the "
+            f"quarantine"))
+        return f
+
     def submit(self, line: str) -> Future:
         """Enqueue one request line; the Future resolves to the output
         line (or raises).  Sheds with ShedError past the depth limit;
         fails fast with CircuitOpenError while the model's breaker is
-        open."""
+        open; a quarantined poison row resolves immediately to
+        PoisonRowError without ever reaching the queue."""
         self._admit()
+        poisoned = self._quarantine_check(line)
+        if poisoned is not None:
+            return poisoned
         req = _Request(line, self.deadline_s)
         with self._cv:
             if self._closed:
@@ -160,6 +304,11 @@ class MicroBatcher:
                 raise RuntimeError(f"batcher {self.name} is closed")
             room = self.max_queue_depth - len(self._q)
             for line in lines:
+                poisoned = self._quarantine_check(line)
+                if poisoned is not None:
+                    # quarantined row: pre-resolved error, no queue slot
+                    futures.append(poisoned)
+                    continue
                 if room <= 0:
                     self.counters.incr(SERVE_GROUP, "Shed")
                     futures.append(None)
@@ -215,6 +364,46 @@ class MicroBatcher:
                 live.append(r)
         return live
 
+    def _score_lines(self, lines: List[str]) -> List[Optional[str]]:
+        """One scorer invocation with its fault points (shared by the
+        main batch path and every bisect rescore sub-batch — a
+        content-based ``scorer_poison`` plan re-fails exactly the
+        sub-batches still holding the poison row)."""
+        fi = faultinject.get_injector()
+        if fi is not None:
+            fi.fire("scorer", tag=self.fault_tag)
+            fi.fire("scorer_slow", tag=self.fault_tag)
+            fi.fire_poison(lines, tag=self.fault_tag)
+        return self.predict_fn(lines)
+
+    def _isolate(self, batch: List[_Request]):
+        """Bisect-rescore a failed batch to isolate the poison row(s):
+        halves re-score recursively; a failing SINGLETON is poison.
+        Returns ``(outputs, poison)`` where ``poison`` maps batch index
+        -> the row's own exception and ``outputs`` carries real results
+        for every innocent row.  Cost: innocents re-score O(log n)
+        times, bounded by the batch size (<= 2n-1 scorer calls) — paid
+        only on failed batches."""
+        outputs: List[Optional[str]] = [None] * len(batch)
+        poison: dict = {}
+        segments = deque([(0, len(batch))])
+        while segments:
+            lo, hi = segments.popleft()
+            lines = [batch[i].line for i in range(lo, hi)]
+            try:
+                self.counters.incr(SERVE_GROUP, "Poison rescores")
+                outs = self._score_lines(lines)
+            except Exception as e:              # noqa: BLE001
+                if hi - lo == 1:
+                    poison[lo] = e
+                else:
+                    mid = (lo + hi) // 2
+                    segments.append((lo, mid))
+                    segments.append((mid, hi))
+                continue
+            outputs[lo:hi] = outs
+        return outputs, poison
+
     def _run(self) -> None:
         try:
             self._run_loop()
@@ -256,29 +445,69 @@ class MicroBatcher:
             self.counters.incr(SERVE_GROUP, "Batches")
             with tracer.span("serve.batch", model=self.name,
                              batch=len(batch)):
+                poison: dict = {}
                 try:
                     with tracer.span("serve.score", model=self.name,
                                      batch=len(batch)):
-                        fi_score = faultinject.get_injector()
-                        if fi_score is not None:
-                            fi_score.fire("scorer", tag=self.fault_tag)
-                            fi_score.fire("scorer_slow",
-                                          tag=self.fault_tag)
-                        outputs = self.predict_fn([r.line for r in batch])
+                        outputs = self._score_lines(
+                            [r.line for r in batch])
+                    self._last_all_failed = False
                 except Exception as e:                 # noqa: BLE001
-                    self.counters.incr(SERVE_GROUP, "Batch errors")
-                    # per-request failure accounting: the SLO monitor's
-                    # windowed error rate diffs this counter
+                    if self.poison_isolate:
+                        with tracer.span("serve.poison.isolate",
+                                         model=self.name,
+                                         batch=len(batch)):
+                            outputs, poison = self._isolate(batch)
+                    known_offender = (
+                        len(batch) == 1 and self.quarantine is not None
+                        and self.quarantine.offenses(batch[0].line) > 0)
+                    if not self.poison_isolate or (
+                            len(poison) == len(batch)
+                            and (len(batch) > 1
+                                 or (self._last_all_failed
+                                     and not known_offender))):
+                        # isolation off, every row of a MULTI-row batch
+                        # fails alone, or a NEW (no offense history)
+                        # singleton right after a fully-failed batch —
+                        # a systemic scorer failure, not poison: the
+                        # pre-existing whole-batch failure path (and
+                        # the breaker hears about it).  A known
+                        # offender's singleton, or any singleton after
+                        # demonstrated health, is classified poison
+                        # below: one hostile row alone in a batch must
+                        # not feed the breaker, and its offenses must
+                        # accumulate toward quarantine.
+                        self._last_all_failed = True
+                        self.counters.incr(SERVE_GROUP, "Batch errors")
+                        # per-request failure accounting: the SLO
+                        # monitor's windowed error rate diffs this
+                        self.counters.incr(SERVE_GROUP, "Failed requests",
+                                           len(batch))
+                        if self.breaker is not None:
+                            self.breaker.record_failure()
+                        for r in batch:
+                            if not r.future.set_running_or_notify_cancel():
+                                continue
+                            r.future.set_exception(e)
+                        continue
+                    # poison isolated: innocents scored (or the scorer
+                    # demonstrated health on the previous batch) — the
+                    # failures do NOT feed the breaker (one hot poison
+                    # client must not trip the whole replica for
+                    # everyone)
+                    self._last_all_failed = len(poison) == len(batch)
+                    self.counters.incr(SERVE_GROUP, "Poison batches")
+                    self.counters.incr(SERVE_GROUP, "Poison rows",
+                                       len(poison))
                     self.counters.incr(SERVE_GROUP, "Failed requests",
-                                       len(batch))
-                    if self.breaker is not None:
-                        self.breaker.record_failure()
-                    for r in batch:
-                        if not r.future.set_running_or_notify_cancel():
-                            continue
-                        r.future.set_exception(e)
-                    continue
-                if self.breaker is not None:
+                                       len(poison))
+                    if self.quarantine is not None:
+                        for i in poison:
+                            self.quarantine.record(batch[i].line)
+                if self.breaker is not None and len(poison) < len(batch):
+                    # at least one row actually scored — demonstrated
+                    # health; an all-poison (singleton) batch proved
+                    # nothing either way, so the breaker hears nothing
                     self.breaker.record_success()
                 # rate-limited device residency sample per scored batch
                 telemetry.sample_device_memory()
@@ -291,10 +520,15 @@ class MicroBatcher:
                         "serve.e2e", int(oldest * 1e9),
                         int((done - oldest) * 1e9), model=self.name,
                         batch=len(batch))
-                for r, out in zip(batch, outputs):
+                for i, (r, out) in enumerate(zip(batch, outputs)):
                     if not r.future.set_running_or_notify_cancel():
                         continue
-                    if out is None:
+                    if i in poison:
+                        r.future.set_exception(PoisonRowError(
+                            f"row failed the scorer in isolation "
+                            f"(poison row; cohabiting requests "
+                            f"unaffected): {poison[i]}"))
+                    elif out is None:
                         self.counters.incr(SERVE_GROUP, "Unscorable")
                         r.future.set_exception(
                             ValueError("record not scorable by this model"))

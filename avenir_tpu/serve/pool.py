@@ -40,7 +40,8 @@ import threading
 from typing import Callable, Dict, List, Optional
 
 from ..core.metrics import Counters
-from .batcher import MicroBatcher, ShedError
+from .batcher import (KEY_POISON_ISOLATE, MicroBatcher, PoisonQuarantine,
+                      ShedError)
 from .breaker import CircuitBreaker, CircuitOpenError
 from .registry import DEFAULT_VARIANT, ModelEntry, ModelRegistry
 
@@ -342,6 +343,11 @@ class ScorerPool:
         self._lock = threading.Lock()
         # model -> variant (declared cost order) -> group
         self.groups: Dict[str, Dict[str, VariantGroup]] = {}
+        # poison-batch isolation (serve.poison.*; batcher.py): one
+        # quarantine per MODEL, shared by every replica of every variant
+        # so a poison client bouncing between replicas still accumulates
+        self.poison_isolate = config.get_boolean(KEY_POISON_ISOLATE, False)
+        self.quarantines: Dict[str, Optional[PoisonQuarantine]] = {}
         try:
             for name in registry.model_names():
                 self._load_model(name)
@@ -360,7 +366,8 @@ class ScorerPool:
         return MicroBatcher(
             entry.name, predict_fn, entry.counters,
             breaker=CircuitBreaker.from_config(self.config, entry.name),
-            fault_tag=tag, **self.batch_kw)
+            fault_tag=tag, poison_isolate=self.poison_isolate,
+            quarantine=self.quarantines.get(entry.name), **self.batch_kw)
 
     def _build_replica(self, name: str, variant: str, index: int, device,
                        counters: Optional[Counters] = None) -> Replica:
@@ -378,6 +385,9 @@ class ScorerPool:
         return Replica(name, variant, index, device, entry, batcher)
 
     def _load_model(self, name: str) -> None:
+        if self.poison_isolate and name not in self.quarantines:
+            self.quarantines[name] = PoisonQuarantine.from_config(
+                self.config)
         variants = self.registry.variant_names(name)
         n = _resolve_replicas(self.config, name)
         devices = _devices_for(n)
@@ -467,7 +477,18 @@ class ScorerPool:
         one variant, or the whole model) from the artifact files.  Each
         replica swaps independently — a fresh adapter + batcher + BREAKER
         (a repaired artifact must not inherit an open circuit) while its
-        siblings keep serving; counters carry over per replica."""
+        siblings keep serving; counters carry over per replica.
+
+        Durability contract: every fresh replica of EVERY group in the
+        reload scope is FULLY built before anything swaps — a build
+        failure (e.g. a
+        :class:`~avenir_tpu.core.io.TornArtifactError` from manifest
+        validation of a half-published artifact, in any variant) closes
+        the already-built fresh replicas and leaves the OLD version
+        serving untouched across all variants (asserted by the
+        torn-artifact reload tests).  A whole-model reload also clears
+        the model's poison quarantine: the repaired artifact deserves a
+        fresh trial for previously poison rows."""
         groups = {g.variant: g for g in self.variant_groups(model)}
         if variant is not None and variant not in groups:
             raise KeyError(
@@ -476,26 +497,41 @@ class ScorerPool:
             replica = int(replica)
         primary = None
         swapped = 0
-        for v, g in groups.items():
-            if variant is not None and v != variant:
-                continue
-            new_reps, retired = [], []
-            for rep in g.replicas:
-                if replica is not None and rep.index != replica:
-                    new_reps.append(rep)
+        # phase 1: build EVERY fresh replica across the whole scope —
+        # nothing observable changes until all of them exist
+        plans = []          # (group, new_reps, retired, any_built)
+        built = []
+        try:
+            for v, g in groups.items():
+                if variant is not None and v != variant:
                     continue
-                fresh = self._build_replica(
-                    model, v, rep.index, rep.device,
-                    counters=rep.entry.counters)
-                fresh.entry.counters.incr(SERVE_GROUP, "Reloads")
-                new_reps.append(fresh)
-                retired.append(rep)
-                swapped += 1
+                new_reps, retired = [], []
+                for rep in g.replicas:
+                    if replica is not None and rep.index != replica:
+                        new_reps.append(rep)
+                        continue
+                    fresh = self._build_replica(
+                        model, v, rep.index, rep.device,
+                        counters=rep.entry.counters)
+                    built.append(fresh)
+                    new_reps.append(fresh)
+                    retired.append(rep)
+                    swapped += 1
+                plans.append((g, new_reps, retired))
+        except BaseException:
+            # torn/missing artifact (or any build failure) in ANY
+            # variant: stop every fresh replica this call already
+            # started — no group's replica list was touched, the old
+            # version keeps serving everywhere
+            for fresh in built:
+                fresh.batcher.close(drain=False)
+            raise
+        # phase 2: swap FIRST, drain the old batchers after: new
+        # traffic lands on the fresh replicas immediately (with the
+        # default single replica, draining before the swap would fail
+        # every request for the whole drain window)
+        for g, new_reps, retired in plans:
             if retired:
-                # swap FIRST, drain the old batcher after: new traffic
-                # lands on the fresh replica immediately (with the
-                # default single replica, draining before the swap would
-                # fail every request for the whole drain window)
                 g.replicas = new_reps
                 # new facade identity -> the variant's SLO window restarts
                 g.stats_facade = _GroupStats(g)
@@ -504,10 +540,17 @@ class ScorerPool:
                     rep.batcher.close(drain=True)
             if primary is None:
                 primary = g.replicas[0].entry
+        for fresh in built:
+            # count only reloads that actually swapped in
+            fresh.entry.counters.incr(SERVE_GROUP, "Reloads")
         if replica is not None and swapped == 0:
             raise KeyError(
                 f"model {model!r} has no replica {replica!r} in the "
                 f"reload scope (indices 0..{len(next(iter(groups.values())).replicas) - 1})")
+        if variant is None and replica is None:
+            q = self.quarantines.get(model)
+            if q is not None:
+                q.clear()
         variants = self.registry.variant_names(model)
         head = groups[variants[0]].replicas[0].entry
         self.registry.adopt(head)

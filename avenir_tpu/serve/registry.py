@@ -42,6 +42,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from ..core.config import JobConfig, parse_properties
+from ..core.io import TornArtifactError
 from ..core.metrics import Counters
 from .engine import (ADAPTER_KINDS, VARIANT_PRESETS, ModelAdapter,
                      ScorerCompileCache, pow2_bucket, pow2_buckets)
@@ -181,10 +182,18 @@ class ModelRegistry:
         mconf = JobConfig(props)
         version = mconf.get("version", "1")
         counters = counters if counters is not None else Counters()
-        adapter = cls(mconf, counters,
-                      cache=ScorerCompileCache(counters),
-                      max_bucket=pow2_bucket(self.max_batch),
-                      mesh=self.mesh)
+        try:
+            adapter = cls(mconf, counters,
+                          cache=ScorerCompileCache(counters),
+                          max_bucket=pow2_bucket(self.max_batch),
+                          mesh=self.mesh)
+        except TornArtifactError as e:
+            # manifest validation caught a half-published artifact: name
+            # the model so a failed `reload` response is actionable — no
+            # swap happened, the previously adopted version keeps serving
+            raise TornArtifactError(
+                f"model {name!r} variant {variant!r}: {e} "
+                f"(the currently served version is unaffected)") from None
         return ModelEntry(name, version, kind, adapter, counters,
                           variant=variant,
                           latency_class=spec["latency_class"],

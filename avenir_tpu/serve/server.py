@@ -60,7 +60,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..core import obs, telemetry
 from ..core.config import JobConfig, load_job_config, parse_cli_args
-from .batcher import MicroBatcher, ShedError
+from .batcher import MicroBatcher, PoisonRowError, ShedError
 from .breaker import CircuitOpenError
 from .frontend import (DEFAULT_BACKLOG, DEFAULT_IO_THREADS,
                        DEFAULT_PIPELINE_MAX, EventLoopFrontend, KEY_BACKLOG,
@@ -302,6 +302,14 @@ class PredictionServer:
                       model=name, variant=v, replica=r.index)
             g("serve.router.demotions", self.router.demotions(name),
               model=name)
+            # poison-isolation state (serve.poison.*): cumulative poison
+            # rows + the bounded quarantine cache's live size
+            merged = counters[f"Serve.{name}"]
+            g("serve.poison.rows", merged.get("Poison rows", 0),
+              model=name)
+            q = self.pool.quarantines.get(name)
+            if q is not None:
+                g("serve.poison.quarantine.size", q.size(), model=name)
         if self._frontend is not None:
             g("serve.frontend.connections", self._frontend.connections())
         return {"gauges": gauges, "hists": hists, "counters": counters}
@@ -430,7 +438,8 @@ class PredictionServer:
                            shed, degraded, last_err)
 
     def _assemble(self, sub: _Submission, outputs: List[Optional[str]],
-                  errors: int, timeouts: int, last_err: str) -> dict:
+                  errors: int, timeouts: int, last_err: str,
+                  poisons: int = 0) -> dict:
         resp: dict = {"model": sub.entry.name, "version": sub.entry.version}
         if sub.multi_variant or "pinned" in sub.decision:
             resp["variant"] = sub.decision["variant"]
@@ -452,6 +461,10 @@ class PredictionServer:
                 resp["error"] = last_err
                 if timeouts:
                     resp["timeout"] = True
+                if poisons:
+                    # this row individually failed the scorer (or is
+                    # quarantined) — cohabiting requests were unaffected
+                    resp["poison"] = True
                 return resp
             resp["output"] = outputs[0]
             return resp
@@ -464,6 +477,8 @@ class PredictionServer:
             resp["timeouts"] = timeouts
         if errors:
             resp["errors"] = errors
+        if poisons:
+            resp["poison"] = poisons
         return resp
 
     def _predict(self, obj: dict) -> dict:
@@ -479,7 +494,7 @@ class PredictionServer:
         # bounded by the legacy serve.request.timeout.sec either way
         wait_s = (min(self.deadline_s, self.timeout) if self.deadline_s
                   else self.timeout)
-        outputs, errors, timeouts = [], 0, 0
+        outputs, errors, timeouts, poisons = [], 0, 0, 0
         last_err = sub.last_err
         for f in sub.futures:
             if f is None:
@@ -499,8 +514,11 @@ class PredictionServer:
             except Exception as e:                  # noqa: BLE001
                 outputs.append(None)
                 errors += 1
+                if isinstance(e, PoisonRowError):
+                    poisons += 1
                 last_err = str(e)
-        return self._assemble(sub, outputs, errors, timeouts, last_err)
+        return self._assemble(sub, outputs, errors, timeouts, last_err,
+                              poisons)
 
     # -- async dispatch (the event-loop frontend's entry) ------------------
     def dispatch_line(self, line: str, cb: Callable[[dict], None]) -> None:
@@ -643,6 +661,11 @@ class PredictionServer:
                 "variants": {grp.variant: grp.section() for grp in groups},
                 "router": self.router.section(name),
             }
+            q = self.pool.quarantines.get(name)
+            if q is not None:
+                models[name]["poison"] = {
+                    "quarantine_size": q.size(),
+                    "threshold": q.threshold}
         out = {"models": models, "obs": obs.get_tracer().stats(),
                "slo": self.slo.section()}
         if self._frontend is not None:
@@ -705,8 +728,8 @@ class _AsyncCollector:
     reaper when its deadline passes first."""
 
     __slots__ = ("server", "sub", "cb", "deadline", "_lock", "_left",
-                 "_outputs", "_errors", "_timeouts", "_last_err",
-                 "_finished")
+                 "_outputs", "_errors", "_timeouts", "_poisons",
+                 "_last_err", "_finished")
 
     def __init__(self, server: PredictionServer, sub: _Submission,
                  cb: Callable[[dict], None],
@@ -720,6 +743,7 @@ class _AsyncCollector:
         self._outputs: List[Optional[str]] = [None] * len(sub.futures)
         self._errors = 0
         self._timeouts = 0
+        self._poisons = 0
         self._last_err = sub.last_err
         self._finished = False
 
@@ -739,7 +763,7 @@ class _AsyncCollector:
 
     def _done(self, i: int, fut) -> None:
         out: Optional[str] = None
-        err = timeout = 0
+        err = timeout = poison = 0
         last = None
         exc = fut.exception()
         if exc is None:
@@ -750,12 +774,15 @@ class _AsyncCollector:
             if isinstance(exc, (TimeoutError, _FutureTimeout)):
                 timeout = 1
                 last = str(exc) or "request deadline exceeded"
+            elif isinstance(exc, PoisonRowError):
+                poison = 1
         with self._lock:
             if self._finished:
                 return          # the reaper already answered this one
             self._outputs[i] = out
             self._errors += err
             self._timeouts += timeout
+            self._poisons += poison
             if last is not None:
                 self._last_err = last
             self._left -= 1
@@ -785,7 +812,7 @@ class _AsyncCollector:
         try:
             resp = self.server._assemble(
                 self.sub, self._outputs, self._errors, self._timeouts,
-                self._last_err)
+                self._last_err, self._poisons)
         except Exception as e:                      # noqa: BLE001
             resp = {"error": f"{type(e).__name__}: {e}"}
         self.cb(resp)

@@ -188,6 +188,250 @@ def bench_resilience_overhead():
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_durability_overhead():
+    """Durability tax on the CLEAN path (core/io.py atomic publish +
+    manifest validation; README "Self-healing durability"): measured on
+    the worst-case artifact-heavy job — a Projection whose output is as
+    large as its input, so the per-part sha1 (write side) + manifest
+    validation hash (first read) dominate every other durability cost
+    (temp staging and rename are same-directory metadata ops; fsync of
+    freshly written data is bounded by the write itself).  Overhead =
+    (publish sha1 + first-read validation hash) / min e2e wall of the
+    job that produced the artifact.  Asserted < 2%."""
+    import shutil
+    import tempfile
+
+    from avenir_tpu.cli import _lazy, resolve
+    from avenir_tpu.core import JobConfig
+    from avenir_tpu.core import io as cio
+    from avenir_tpu.datagen import gen_telecom_churn
+
+    tmp = tempfile.mkdtemp(prefix="durability_bench_")
+    try:
+        base = gen_telecom_churn(50_000, seed=5)
+        reps_factor = 8                            # ~400k rows, ~17 MB
+        in_dir = os.path.join(tmp, "in")
+        os.makedirs(in_dir)
+        block = "\n".join(",".join(r) for r in base) + "\n"
+        with open(os.path.join(in_dir, "part-00000"), "w") as fh:
+            for _ in range(reps_factor):
+                fh.write(block)
+
+        modname, clsname, prefix = resolve("org.chombo.mr.Projection")
+        cfg = JobConfig({"projection.operation": "project",
+                         "projection.field": "0,1,2,3,4,5,6,7",
+                         "pipeline.chunk.rows": str(1 << 15)}, prefix)
+        out = os.path.join(tmp, "out")
+
+        def run_once():
+            _lazy(modname, clsname)(cfg).run(in_dir, out)
+
+        run_once()                                  # warmup
+        e2e = samples_of(run_once)
+
+        parts = [os.path.join(out, f) for f in sorted(os.listdir(out))
+                 if f.startswith("part-")]
+        out_bytes = sum(os.path.getsize(p) for p in parts)
+        # write side: the manifest's per-part sha1 is the only
+        # data-proportional cost the atomic publish adds
+        t_sha1 = best_of(lambda: [cio._sha1_file(p) for p in parts])
+        # read side: first-read manifest validation re-hashes the parts
+        # (memoized per stat afterwards) — measure cold vs memoized
+        def cold_read():
+            cio._VALIDATED.clear()
+            for _ in cio.read_lines(out):
+                pass
+
+        def warm_read():
+            for _ in cio.read_lines(out):
+                pass
+
+        cold_read()
+        t_cold, t_warm = best_of(cold_read), best_of(warm_read)
+        t_validate = max(t_cold - t_warm, 0.0)
+        overhead_pct = round(100 * (t_sha1 + t_validate) / min(e2e), 3)
+        assert overhead_pct < 2.0, (
+            f"durability overhead {overhead_pct}% >= 2% "
+            f"(sha1 {t_sha1 * 1000:.1f} ms + validate "
+            f"{t_validate * 1000:.1f} ms over e2e {min(e2e):.3f}s)")
+        out_doc = {"metric": "durability_overhead_pct",
+                   "value": overhead_pct,
+                   "unit": "% of artifact-heavy (Projection) job e2e "
+                           "spent on atomic-publish sha1 + first-read "
+                           "manifest validation; asserted < 2",
+                   "vs_baseline": None,
+                   "artifact_bytes": out_bytes,
+                   "publish_sha1_ms": round(t_sha1 * 1000, 2),
+                   "first_read_validate_ms": round(t_validate * 1000, 2),
+                   "e2e_sec": round(min(e2e), 4)}
+        return finish_metric(out_doc, e2e, bigger_is_better=False)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def bench_chaos_recovery():
+    """chaos_recovery_ms (README "Self-healing durability"): median
+    observed time from an injected failure to recovery, for the three
+    self-healing paths —
+
+    - ``serving_failover_ms``: a replica's dispatch worker is killed
+      (injected batcher death); recovery = the next successful response
+      through the 2-replica pool (least-loaded dispatch around the dead
+      replica + the defensive ensure_worker restart), median over 9
+      kills.  This is the headline value: user-visible time a replica
+      death costs.
+    - ``reload_swap_ms``: artifact repair path — median time from
+      issuing a whole-model ``reload`` to the first response served by
+      the freshly built replicas (TF-Serving-style swap; the torn half
+      of that path is correctness-tested in tests/test_chaos.py).
+    - ``batch_resume_ms``: NB streamed train killed mid-scan by an
+      injected H2D fault; recovery = time from resume-run start to the
+      FIRST resumed fold (checkpoint-generation load + fingerprint
+      validation + chunk-boundary re-derivation + offset skip), read
+      off the obs tracer's ``ingest.fold`` spans, median over 5
+      kill/resume pairs."""
+    import shutil
+    import statistics as _stats
+    import tempfile
+    import time as _time
+
+    from avenir_tpu.core import JobConfig, faultinject
+    from avenir_tpu.core import obs
+    from avenir_tpu.core.faultinject import FaultInjector, parse_plan
+    from avenir_tpu.core.io import write_output
+    from avenir_tpu.datagen import gen_telecom_churn
+    from avenir_tpu.models.bayesian import BayesianDistribution
+    from avenir_tpu.serve import PredictionServer
+
+    tmp = tempfile.mkdtemp(prefix="chaos_bench_")
+    try:
+        schema = dict(_CHURN_SCHEMA)
+        schema["fields"] = [dict(f) for f in _CHURN_SCHEMA["fields"]]
+        schema["fields"][1]["cardinality"] = ["planA", "planB"]
+        schema_path = os.path.join(tmp, "schema.json")
+        with open(schema_path, "w") as fh:
+            fh.write(json.dumps(schema))
+        rows = gen_telecom_churn(8_000, seed=9)
+        write_output(os.path.join(tmp, "train"),
+                     [",".join(r) for r in rows])
+        BayesianDistribution(JobConfig(
+            {"feature.schema.file.path": schema_path})).run(
+            os.path.join(tmp, "train"), os.path.join(tmp, "model"))
+        line = ",".join(rows[0])
+
+        srv = PredictionServer(JobConfig({
+            "serve.models": "churn",
+            "serve.model.churn.kind": "naiveBayes",
+            "serve.model.churn.feature.schema.file.path": schema_path,
+            "serve.model.churn.bayesian.model.file.path":
+                os.path.join(tmp, "model"),
+            "serve.pool.replicas": "2",
+            "serve.warmup": "false",
+            "serve.batch.max.delay.ms": "1",
+            "telemetry.interval.sec": "0"}))
+        failover, reload_swap = [], []
+        try:
+            group = srv.pool.variant_groups("churn")[0]
+            group.submit(line).result(timeout=60)        # compile warmup
+            for _ in range(9):
+                faultinject.set_injector(FaultInjector(
+                    parse_plan("batcher_death@0")))
+                # serve one request; the worker that served it dies at
+                # its next loop top (injected hard death)
+                group.submit(line).result(timeout=60)
+                deadline = _time.perf_counter() + 5.0
+                while (all(r.batcher.worker_alive()
+                           for r in group.replicas)
+                       and _time.perf_counter() < deadline):
+                    _time.sleep(0.001)
+                faultinject.set_injector(None)
+                assert not all(r.batcher.worker_alive()
+                               for r in group.replicas), \
+                    "injected batcher death never landed"
+                t0 = _time.perf_counter()
+                assert group.submit(line).result(timeout=60)
+                failover.append((_time.perf_counter() - t0) * 1000)
+                for r in group.replicas:             # heal for next kill
+                    r.batcher.ensure_worker()
+            for _ in range(REPS):
+                t0 = _time.perf_counter()
+                srv.pool.reload("churn")
+                grp = srv.pool.variant_groups("churn")[0]
+                assert grp.submit(line).result(timeout=60)
+                reload_swap.append((_time.perf_counter() - t0) * 1000)
+        finally:
+            faultinject.set_injector(None)
+            srv.stop()
+
+        # -- batch: kill at an injected H2D fault, resume, time to the
+        # first resumed fold (tracer-observed)
+        n_copies = 4                                 # ~200k rows
+        in_dir = os.path.join(tmp, "in")
+        os.makedirs(in_dir)
+        block = "\n".join(",".join(r)
+                          for r in gen_telecom_churn(50_000, seed=3))
+        with open(os.path.join(in_dir, "part-00000"), "w") as fh:
+            for _ in range(n_copies):
+                fh.write(block + "\n")
+        cfg = {"feature.schema.file.path": schema_path,
+               "pipeline.chunk.rows": str(1 << 12),
+               "pipeline.prefetch.depth": "2",
+               "checkpoint.interval.chunks": "8"}
+        out = os.path.join(tmp, "nb_out")
+        resume = []
+        prev_tracer = obs.get_tracer()
+        try:
+            for _ in range(REPS):
+                faultinject.set_injector(FaultInjector(
+                    parse_plan("h2d@40")))
+                try:
+                    BayesianDistribution(JobConfig(dict(cfg))).run(
+                        in_dir, out)
+                    raise AssertionError("injected kill did not fire")
+                except faultinject.InjectedFault:
+                    pass
+                faultinject.set_injector(None)
+                assert os.path.exists(out + ".ckpt")
+                tracer = obs.set_tracer(obs.Tracer(enabled=True,
+                                                   buffer_spans=8192))
+                with tracer.span("bench.resume"):
+                    BayesianDistribution(JobConfig(dict(
+                        cfg, **{"checkpoint.resume": "true"}))).run(
+                        in_dir, out)
+                outer = tracer.spans("bench.resume")[0]
+                folds = [s for s in tracer.spans("ingest.fold")
+                         if s.t0_ns >= outer.t0_ns]
+                assert folds, "resumed run recorded no fold spans"
+                resume.append(
+                    (min(f.t0_ns for f in folds) - outer.t0_ns) / 1e6)
+        finally:
+            faultinject.set_injector(None)
+            obs.set_tracer(prev_tracer)
+
+        out_doc = {"metric": "chaos_recovery_ms",
+                   "value": round(_stats.median(failover), 2),
+                   "unit": "median ms from injected replica-worker "
+                           "death to next successful pooled response",
+                   "vs_baseline": None,
+                   "serving_failover_ms": {
+                       "median": round(_stats.median(failover), 2),
+                       "min": round(min(failover), 2),
+                       "max": round(max(failover), 2),
+                       "kills": len(failover)},
+                   "reload_swap_ms": {
+                       "median": round(_stats.median(reload_swap), 2),
+                       "min": round(min(reload_swap), 2),
+                       "max": round(max(reload_swap), 2)},
+                   "batch_resume_ms": {
+                       "median": round(_stats.median(resume), 2),
+                       "min": round(min(resume), 2),
+                       "max": round(max(resume), 2),
+                       "kills": len(resume)}}
+        return finish_metric(out_doc, bigger_is_better=False)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _history_values():
     """{metric_name: [prior values...]} from committed BENCH_r*.json."""
     hist = {}
@@ -1957,6 +2201,8 @@ def main():
                      ("obs_overhead", bench_obs_overhead),
                      ("telemetry_overhead", bench_telemetry_overhead),
                      ("resilience_overhead", bench_resilience_overhead),
+                     ("durability_overhead", bench_durability_overhead),
+                     ("chaos_recovery", bench_chaos_recovery),
                      ("streaming", bench_streaming_rl)):
         print(f"[bench] {nm}...", file=sys.stderr, flush=True)
         extra.append(fn_b())

@@ -1,0 +1,488 @@
+"""Seeded chaos soak for the self-healing durability layer (README
+"Fault tolerance"): under mixed, randomized-but-seeded fault schedules,
+
+- the DAG workflow killed at a random chunk with its NEWEST checkpoint
+  generation corrupted resumes byte-identical from an older generation
+  (or a cold start — always correct, never a crash),
+- a 2-replica serving pool under a poison-row storm answers every
+  innocent request correctly while only poison rows get structured
+  errors, the circuit breaker stays closed, and zero requests are
+  dropped or hung,
+- a torn model artifact fails ``reload`` with a structured error while
+  the old version keeps serving, and a repaired artifact swaps in.
+
+Every schedule is deterministic per seed (fault plans are seeded and
+content-based); the suite runs each scenario under three distinct
+seeds.  Recovery events are asserted on the ``Durability/*`` telemetry
+counters and the ``serve.poison.*`` gauges."""
+
+import json
+import os
+import random
+import socket
+import threading
+
+import pytest
+
+from avenir_tpu.cli import _job_resolver
+from avenir_tpu.core import JobConfig, faultinject, telemetry
+from avenir_tpu.core.dag import run_workflow
+from avenir_tpu.core.faultinject import FaultInjector, parse_plan
+from avenir_tpu.core.io import write_output
+from avenir_tpu.core.metrics import Counters
+from avenir_tpu.datagen.generators import gen_telecom_churn
+from avenir_tpu.models.bayesian import BayesianDistribution, BayesianPredictor
+from avenir_tpu.serve import PredictionServer
+from avenir_tpu.serve.batcher import (MicroBatcher, PoisonQuarantine,
+                                      PoisonRowError)
+from avenir_tpu.serve.breaker import CircuitBreaker
+from avenir_tpu.serve.server import request, request_text
+
+SEEDS = [11, 23, 47]
+
+SCHEMA = {"fields": [
+    {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+    {"name": "plan", "ordinal": 1, "dataType": "categorical",
+     "feature": True, "cardinality": ["planA", "planB"]},
+    {"name": "minUsed", "ordinal": 2, "dataType": "int", "feature": True,
+     "min": 0, "max": 2200, "bucketWidth": 200},
+    {"name": "dataUsed", "ordinal": 3, "dataType": "int", "feature": True,
+     "min": 0, "max": 1000, "bucketWidth": 100},
+    {"name": "csCall", "ordinal": 4, "dataType": "int", "feature": True,
+     "min": 0, "max": 14, "bucketWidth": 2},
+    {"name": "csEmail", "ordinal": 5, "dataType": "int", "feature": True,
+     "min": 0, "max": 22, "bucketWidth": 4},
+    {"name": "network", "ordinal": 6, "dataType": "int", "feature": True,
+     "min": 0, "max": 12, "bucketWidth": 2},
+    {"name": "churned", "ordinal": 7, "dataType": "categorical",
+     "cardinality": ["N", "Y"]}]}
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    yield
+    faultinject.set_injector(None)
+    from avenir_tpu.core.io import set_artifact_store
+    set_artifact_store(None)
+
+
+def _durability(name):
+    return telemetry.get_metrics().counters.get("Durability", name)
+
+
+# ---------------------------------------------------------------------------
+# batch soak: DAG workflow under kill + checkpoint-corruption schedules
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def wf_data(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("chaos_wf")
+    schema_path = tmp / "schema.json"
+    schema_path.write_text(json.dumps(SCHEMA))
+    rows = gen_telecom_churn(1200, seed=31)
+    (tmp / "train").mkdir()
+    (tmp / "train" / "part-00000").write_text(
+        "\n".join(",".join(r) for r in rows) + "\n")
+    return {"schema": str(schema_path), "train": str(tmp / "train")}
+
+
+STAGES = "bin,nb,mi,select,retrain"
+
+
+def _wf_manifest(data, **extra):
+    props = {
+        "workflow.stages": STAGES,
+        "workflow.stage.bin.class": "org.chombo.mr.Projection",
+        "workflow.stage.bin.projection.operation": "project",
+        "workflow.stage.bin.projection.field": "0,1,2,3,4,5,6,7",
+        "workflow.stage.nb.class": "BayesianDistribution",
+        "workflow.stage.nb.input": "bin",
+        "workflow.stage.nb.feature.schema.file.path": data["schema"],
+        "workflow.stage.mi.class": "MutualInformation",
+        "workflow.stage.mi.input": "bin",
+        "workflow.stage.mi.feature.schema.file.path": data["schema"],
+        "workflow.stage.select.class": "FeatureSelect",
+        "workflow.stage.select.input": "mi",
+        "workflow.stage.select.select.schema.file.path": data["schema"],
+        "workflow.stage.select.select.top.features": "4",
+        "workflow.stage.retrain.class": "BayesianDistribution",
+        "workflow.stage.retrain.input": "bin",
+        "workflow.stage.retrain.feature.schema.file.path": "@select",
+        "pipeline.chunk.rows": "128",
+        "pipeline.prefetch.depth": "2",
+        "checkpoint.interval.chunks": "2",
+        "workflow.fuse": "always",
+    }
+    props.update(extra)
+    return props
+
+
+def _read_stage(base, sid):
+    p = os.path.join(base, sid)
+    if os.path.isfile(p):
+        return open(p).read()
+    return open(os.path.join(p, "part-r-00000")).read()
+
+
+@pytest.fixture(scope="module")
+def wf_ref(wf_data, tmp_path_factory, mesh8):
+    """The uninterrupted workflow's outputs — the byte-parity oracle."""
+    ref = str(tmp_path_factory.mktemp("chaos_ref") / "ref")
+    run_workflow(JobConfig(_wf_manifest(wf_data)), wf_data["train"], ref,
+                 _job_resolver, mesh=mesh8)
+    return {sid: _read_stage(ref, sid) for sid in STAGES.split(",")}
+
+
+def _sidecars(base):
+    """Every checkpoint sidecar generation under the workflow output."""
+    found = []
+    for root, _, files in os.walk(base):
+        for f in files:
+            if ".ckpt" in f:
+                found.append(os.path.join(root, f))
+    return sorted(found)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_chaos_workflow_kill_corrupt_resume_byte_parity(
+        wf_data, wf_ref, tmp_path, mesh8, seed):
+    """Kill the workflow at a seeded random chunk, corrupt the NEWEST
+    generation of every sidecar the crash left behind (and, on some
+    seeds, ALSO truncate the workflow sidecar the way a dying disk
+    would), then resume: the run must recover from an older generation
+    (or degrade to a cold start) and finish byte-identical to the
+    uninterrupted oracle — never crash, never serve a torn artifact."""
+    rng = random.Random(seed)
+    out = str(tmp_path / "out")
+
+    # kill the prefetch worker inside the fused nb+mi scan (an h2d
+    # fault there would WITHDRAW the job to a standalone re-run, not
+    # crash — worker death is the hard-kill), late enough that at least
+    # two generations exist (interval=2 -> saves at chunks 2,4,..)
+    plan = f"worker_death@{rng.randint(5, 8)}"
+    faultinject.set_injector(FaultInjector(parse_plan(plan)))
+    with pytest.raises(RuntimeError):
+        run_workflow(JobConfig(_wf_manifest(wf_data)), wf_data["train"],
+                     out, _job_resolver, mesh=mesh8)
+    faultinject.set_injector(None)
+
+    # corrupt the newest generation of every sidecar (primary path only:
+    # the .1 generation stays valid, so resume must FALL BACK, not die)
+    newest = [p for p in _sidecars(out) if p.endswith(".ckpt")]
+    assert newest, "the killed run must leave checkpoint sidecars"
+    scan_newest = [p for p in newest
+                   if not p.endswith("_workflow.ckpt")]
+    assert any(os.path.exists(p + ".1") for p in scan_newest), \
+        "late kill must have rotated at least one older scan generation"
+    for p in newest:
+        if p.endswith("_workflow.ckpt") and rng.random() < 0.5:
+            continue                    # some seeds spare the wf sidecar
+        size = os.path.getsize(p)
+        mode = rng.choice(["truncate", "garble"])
+        if mode == "truncate":
+            with open(p, "rb+") as fh:
+                fh.truncate(rng.randrange(1, max(2, size // 2)))
+        else:
+            with open(p, "rb+") as fh:
+                fh.seek(0)
+                fh.write(bytes(rng.randrange(256) for _ in range(
+                    min(64, size))))
+
+    before_corrupt = _durability("Checkpoint corrupt") + _durability(
+        "Workflow sidecar corrupt")
+    before_fallback = _durability("Generation fallbacks")
+
+    props = _wf_manifest(wf_data, **{"checkpoint.resume": "true"})
+    msgs = []
+    run_workflow(JobConfig(props), wf_data["train"], out, _job_resolver,
+                 mesh=mesh8, log=msgs.append)
+
+    got = {sid: _read_stage(out, sid) for sid in STAGES.split(",")}
+    assert got == wf_ref, f"resume under {plan!r} broke byte parity"
+    assert not _sidecars(out), "success must sweep every generation"
+    assert (_durability("Checkpoint corrupt")
+            + _durability("Workflow sidecar corrupt")) > before_corrupt, \
+        "the corrupted newest generation must have been detected"
+    assert _durability("Generation fallbacks") > before_fallback, \
+        "resume must have recovered from an OLDER generation"
+
+
+# ---------------------------------------------------------------------------
+# deterministic breaker contract: poison never feeds the breaker
+# ---------------------------------------------------------------------------
+
+def test_poison_isolation_never_feeds_breaker():
+    """A hair-trigger breaker (threshold 1) stays CLOSED through an
+    isolated poison batch — the strongest form of "poison failures do
+    not count": a single counted failure would trip it."""
+    def scorer(lines):
+        if any("POISON" in l for l in lines):
+            raise RuntimeError("scorer choked on hostile row")
+        return [l.upper() for l in lines]
+
+    breaker = CircuitBreaker("m", failure_threshold=1)
+    q = PoisonQuarantine(threshold=3, cap=64)
+    b = MicroBatcher("m", scorer, Counters(), max_batch=8,
+                     max_delay_ms=1.0, breaker=breaker,
+                     poison_isolate=True, quarantine=q)
+    try:
+        futs = [b.submit(l) for l in ["a", "POISON-x", "b", "c"]]
+        assert futs[0].result(10) == "A"
+        assert futs[2].result(10) == "B"
+        assert futs[3].result(10) == "C"
+        with pytest.raises(PoisonRowError, match="isolation"):
+            futs[1].result(10)
+        assert breaker.state == "closed"
+        # SINGLETON poison batches from a KNOWN offender are still
+        # poison, not systemic — even BACK-TO-BACK with no intervening
+        # traffic (the second singleton runs with the all-failed flag
+        # set) a hot lone poison client must not feed the breaker, and
+        # offenses accumulate (third offense -> quarantined)
+        for _ in range(2):
+            with pytest.raises(PoisonRowError):
+                b.submit("POISON-x").result(10)
+            assert breaker.state == "closed"
+        assert q.quarantined("POISON-x")
+        # the third submit is refused AT SUBMIT (pre-resolved future)
+        with pytest.raises(PoisonRowError, match="quarantined"):
+            b.submit("POISON-x").result(10)
+        assert b.counters.get("Serve", "Poison quarantined submits") == 1
+        assert breaker.state == "closed"
+        # a SYSTEMIC failure (every row of a multi-row batch fails
+        # alone) still trips it — submit_many enqueues atomically, so
+        # both rows land in one batch
+        (f1, f2), _ = b.submit_many(["POISON-a", "POISON-b"])
+        for f in (f1, f2):
+            with pytest.raises(RuntimeError):
+                f.result(10)
+        assert breaker.state == "open"
+    finally:
+        b.close(drain=False)
+
+
+def test_sick_scorer_singleton_traffic_still_trips_breaker():
+    """The singleton tie-breaker's other half: CONSECUTIVE fully-failed
+    batches are scorer-shaped, so a genuinely dead scorer under
+    batch-size-1 traffic still trips the breaker — and the innocent
+    retried rows record at most one quarantine offense each (never
+    refused at submit)."""
+    from avenir_tpu.serve.breaker import CircuitOpenError
+
+    def scorer(lines):
+        raise RuntimeError("scorer is down")
+
+    breaker = CircuitBreaker("m", failure_threshold=2)
+    q = PoisonQuarantine(threshold=2, cap=64)
+    b = MicroBatcher("m", scorer, Counters(), max_batch=8,
+                     max_delay_ms=1.0, breaker=breaker,
+                     poison_isolate=True, quarantine=q)
+    try:
+        # first failure after startup: locally indistinguishable from
+        # poison, classified poison (no health history to contradict)
+        with pytest.raises(PoisonRowError):
+            b.submit("row-a").result(10)
+        assert breaker.state == "closed"
+        # consecutive total failures: systemic — raw scorer error to
+        # the caller, breaker counts each one
+        for row in ("row-b", "row-c"):
+            f = b.submit(row)
+            with pytest.raises(RuntimeError) as ei:
+                f.result(10)
+            assert not isinstance(ei.value, PoisonRowError), row
+        assert breaker.state == "open"
+        with pytest.raises(CircuitOpenError):
+            b.submit("row-d")
+        # no innocent row accumulated toward quarantine past the first
+        # pre-systemic offense, and none is refused
+        assert q.size() == 1 and not q.quarantined("row-a")
+        assert not q.quarantined("row-b")
+    finally:
+        b.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# serving soak: poison storm + torn-artifact reload on a 2-replica pool
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def serve_art(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("chaos_serve")
+    schema_path = tmp / "schema.json"
+    schema_path.write_text(json.dumps(SCHEMA))
+    rows = gen_telecom_churn(400, seed=23)
+    train, test = rows[:320], rows[320:]
+    write_output(str(tmp / "train"), [",".join(r) for r in train])
+    write_output(str(tmp / "test"), [",".join(r) for r in test])
+    BayesianDistribution(JobConfig(
+        {"feature.schema.file.path": str(schema_path)})).run(
+        str(tmp / "train"), str(tmp / "model"))
+    out = tmp / "pred"
+    BayesianPredictor(JobConfig(
+        {"feature.schema.file.path": str(schema_path),
+         "bayesian.model.file.path": str(tmp / "model")})).run(
+        str(tmp / "test"), str(out))
+    return {
+        "dir": tmp,
+        "model": str(tmp / "model"),
+        "props": {"feature.schema.file.path": str(schema_path),
+                  "bayesian.model.file.path": str(tmp / "model")},
+        "lines": [",".join(r) for r in test],
+        "expect": (out / "part-r-00000").read_text().splitlines(),
+    }
+
+
+def _serve_config(art, **overrides):
+    props = {
+        "serve.models": "churn",
+        "serve.model.churn.kind": "naiveBayes",
+        "serve.pool.replicas": "2",
+        "serve.poison.isolate": "true",
+        "serve.poison.quarantine.threshold": "2",
+        "serve.batch.max.size": "32",
+        "serve.batch.max.delay.ms": "2",
+        "serve.queue.max.depth": "4096",
+        "serve.port": "0",
+        "serve.warmup": "false",
+        "telemetry.interval.sec": "0",
+        # the storm can slice an all-poison micro-batch (counted as
+        # systemic); keep the trip threshold above the whole storm so
+        # "breaker stays closed" is a guarantee, not an accident of
+        # batching — the hair-trigger contract is asserted above
+        "serve.breaker.failures": "200",
+    }
+    for k, v in art["props"].items():
+        props[f"serve.model.churn.{k}"] = v
+    props.update({k: str(v) for k, v in overrides.items()})
+    return JobConfig(props)
+
+
+def _pipelined(port, items, out, errs):
+    """One client connection: pipeline all requests, then read the
+    responses in order (the frontend guarantees per-connection request
+    order).  Appends (request, response) pairs to ``out``."""
+    try:
+        with socket.create_connection(("127.0.0.1", port),
+                                      timeout=60) as s:
+            s.sendall(b"".join(
+                json.dumps({"model": "churn", "row": line}).encode()
+                + b"\n" for _, line in items))
+            f = s.makefile("rb")
+            for item in items:
+                out.append((item, json.loads(f.readline())))
+    except Exception as e:              # noqa: BLE001
+        errs.append(e)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_chaos_serving_poison_storm_and_torn_reload(serve_art, seed):
+    """The serving half of the soak, one seed per schedule: a poison
+    client's rows fail ALONE while cohabiting clients' requests all
+    succeed with byte-exact outputs, nothing drops or hangs, the
+    breaker stays closed — then a torn model artifact fails reload
+    WITHOUT unseating the serving version, and a repaired artifact
+    swaps in and clears the quarantine."""
+    rng = random.Random(seed)
+    srv = PredictionServer(_serve_config(serve_art))
+    port = srv.start()
+    part = os.path.join(serve_art["model"], "part-r-00000")
+    original = open(part, "rb").read()
+    try:
+        lines = serve_art["lines"]
+        expect = {l: serve_art["expect"][i] for i, l in enumerate(lines)}
+        poison_rows = []
+        for k in range(3):
+            donor = lines[rng.randrange(len(lines))].split(",")
+            donor[0] = f"POISON-{seed}-{k}"
+            poison_rows.append(",".join(donor))
+        deck = [("ok", l) for l in lines] + \
+               [("poison", p) for p in poison_rows * 4]
+        rng.shuffle(deck)
+        faultinject.set_injector(FaultInjector(
+            parse_plan("scorer_poison@*x100000:POISON")))
+
+        # 4 concurrent clients, each pipelining a slice of the deck
+        results, errs, threads = [], [], []
+        for w in range(4):
+            t = threading.Thread(
+                target=_pipelined,
+                args=(port, deck[w::4], results, errs))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=120)
+        assert not errs, errs
+        assert not any(t.is_alive() for t in threads), "hung client"
+        assert len(results) == len(deck), "dropped request"
+
+        poison_flagged = 0
+        for (kind, line), resp in results:
+            if kind == "ok":
+                # the core guarantee: NO innocent request ever fails
+                assert resp.get("output") == expect[line], (line, resp)
+            else:
+                assert "error" in resp, (line, resp)
+                poison_flagged += 1 if resp.get("poison") else 0
+        assert poison_flagged >= 1      # isolation observed in the storm
+        h = request("127.0.0.1", port, {"cmd": "health"})
+        assert h["ok"] is True, h     # breaker closed, nothing degraded
+
+        # recovery events ride the telemetry surface
+        txt = request_text("127.0.0.1", port, {"cmd": "metrics"})
+        assert 'avenir_serve_poison_rows{model="churn"}' in txt
+        assert 'avenir_serve_poison_quarantine_size{model="churn"}' in txt
+
+        # drive each poison row to quarantine DETERMINISTICALLY:
+        # sequential clean/poison alternation, so every poison failure
+        # follows demonstrated scorer health (singleton tie-breaker ->
+        # classified poison, offense recorded) until refused at submit
+        probe0 = lines[0]
+        for p in poison_rows:
+            for _ in range(4):
+                ok = request("127.0.0.1", port,
+                             {"model": "churn", "row": probe0})
+                assert ok.get("output") == expect[probe0], ok
+                resp = request("127.0.0.1", port,
+                               {"model": "churn", "row": p})
+                assert "error" in resp, resp
+        stats = request("127.0.0.1", port, {"cmd": "stats"})
+        psec = stats["models"]["churn"]["poison"]
+        assert psec["quarantine_size"] >= len(poison_rows)
+
+        # every poison row is now quarantined: refused at submit even
+        # with the injector disarmed (signature cache, not injection)
+        faultinject.set_injector(None)
+        for p in poison_rows:
+            resp = request("127.0.0.1", port, {"model": "churn", "row": p})
+            assert resp.get("poison") is True, resp
+
+        # -- torn-artifact reload: old version keeps serving -----------
+        probe = lines[rng.randrange(len(lines))]
+        cut = rng.randrange(len(original) // 4, len(original) // 2)
+        with open(part, "wb") as fh:
+            fh.write(original[:cut])
+        resp = request("127.0.0.1", port, {"cmd": "reload",
+                                           "model": "churn"})
+        assert "TornArtifactError" in resp.get("error", ""), resp
+        assert "unaffected" in resp["error"]
+        out = request("127.0.0.1", port, {"model": "churn", "row": probe})
+        assert out.get("output") == expect[probe], \
+            "old version must keep serving after a failed reload"
+
+        # repair + reload: swaps in and clears the poison quarantine
+        with open(part, "wb") as fh:
+            fh.write(original)
+        resp = request("127.0.0.1", port, {"cmd": "reload",
+                                           "model": "churn"})
+        assert resp.get("ok") is True, resp
+        out = request("127.0.0.1", port, {"model": "churn", "row": probe})
+        assert out.get("output") == expect[probe]
+        stats = request("127.0.0.1", port, {"cmd": "stats"})
+        assert stats["models"]["churn"]["poison"]["quarantine_size"] == 0
+        for p in poison_rows:          # fresh trial, injector disarmed
+            resp = request("127.0.0.1", port, {"model": "churn", "row": p})
+            assert "output" in resp, resp
+    finally:
+        faultinject.set_injector(None)
+        with open(part, "wb") as fh:
+            fh.write(original)
+        srv.stop()

@@ -384,9 +384,12 @@ def test_optional_sink_skips_the_file_write(data, tmp_path, mesh8):
 
 
 def test_handoff_parity_guard_catches_divergence(tmp_path):
-    """The overlay's first memory read asserts byte parity against the
-    file round-trip; a divergent file (simulated corruption) raises."""
-    from avenir_tpu.core.io import (ArtifactStore, read_lines,
+    """Two independent guards catch a divergent artifact file: manifest
+    validation (the durability layer) sees the tampered bytes first;
+    with the manifest gone, the overlay's first-memory-read byte-parity
+    assert still catches the divergence."""
+    from avenir_tpu.core.io import (MANIFEST_NAME, ArtifactStore,
+                                    TornArtifactError, read_lines,
                                     set_artifact_store, write_output)
 
     store = ArtifactStore(verify=True)
@@ -397,6 +400,9 @@ def test_handoff_parity_guard_catches_divergence(tmp_path):
         write_output(out, ["a,1", "b,2"])
         with open(os.path.join(out, "part-r-00000"), "a") as fh:
             fh.write("tampered,3\n")
+        with pytest.raises(TornArtifactError, match="part-r-00000"):
+            list(read_lines(out))
+        os.unlink(os.path.join(out, MANIFEST_NAME))
         with pytest.raises(AssertionError, match="handoff parity"):
             list(read_lines(out))
     finally:

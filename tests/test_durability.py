@@ -1,0 +1,381 @@
+"""Unit seams of the self-healing durability layer (README "Fault
+tolerance"): crash-safe artifact publish (atomic part staging, the
+``_MANIFEST`` sidecar, ``TornArtifactError`` reader validation, the
+``io.require.success`` strict mode, ``atomic_write_text``), checkpoint
+generations + corruption fallback (``checkpoint.keep`` rotation,
+``CheckpointCorrupt``, the newest→oldest→cold walk, the workflow
+sidecar's degrade-to-fresh-run), the ``torn_write``/``ckpt_corrupt``
+fault points, and the serving poison quarantine cache.  The seeded
+end-to-end chaos soak lives in tests/test_chaos.py."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core import JobConfig, faultinject
+from avenir_tpu.core import io as cio
+from avenir_tpu.core.checkpoint import (CheckpointCorrupt,
+                                        StreamCheckpointer,
+                                        WorkflowCheckpointer,
+                                        generation_paths)
+from avenir_tpu.core.faultinject import (FaultInjector, InjectedFault,
+                                         parse_plan)
+from avenir_tpu.core.io import (MANIFEST_NAME, SUCCESS_NAME, OutputWriter,
+                                TornArtifactError, atomic_write_text,
+                                read_lines, write_output)
+from avenir_tpu.serve.batcher import PoisonQuarantine
+
+
+@pytest.fixture(autouse=True)
+def _clear_globals():
+    yield
+    faultinject.set_injector(None)
+    cio.set_require_success(False)
+
+
+# ---------------------------------------------------------------------------
+# crash-safe artifact publish
+# ---------------------------------------------------------------------------
+
+def test_publish_writes_manifest_then_success(tmp_path):
+    out = str(tmp_path / "out")
+    part = write_output(out, ["a,1", "b,2"])
+    names = sorted(os.listdir(out))
+    assert names == [MANIFEST_NAME, SUCCESS_NAME, "part-r-00000"]
+    doc = json.load(open(os.path.join(out, MANIFEST_NAME)))
+    rec = doc["parts"]["part-r-00000"]
+    assert rec["bytes"] == os.path.getsize(part)
+    assert len(rec["sha1"]) == 40
+    assert list(read_lines(out)) == ["a,1", "b,2"]
+
+
+def test_aborted_write_keeps_previous_artifact(tmp_path):
+    """An exception mid-write discards the staged temp file: the
+    previous artifact stays intact AND valid (the old in-place writer
+    left a torn part under the final name)."""
+    out = str(tmp_path / "out")
+    write_output(out, ["good,1"])
+    with pytest.raises(RuntimeError, match="boom"):
+        with OutputWriter(out) as w:
+            w.write("half,")
+            raise RuntimeError("boom")
+    assert list(read_lines(out)) == ["good,1"]
+    # no temp litter either
+    assert sorted(os.listdir(out)) == [MANIFEST_NAME, SUCCESS_NAME,
+                                       "part-r-00000"]
+
+
+def test_torn_part_raises_structured_error(tmp_path):
+    out = str(tmp_path / "out")
+    part = write_output(out, [f"r{i},{i}" for i in range(50)])
+    with open(part, "r+") as fh:
+        fh.truncate(os.path.getsize(part) // 2)
+    with pytest.raises(TornArtifactError, match="part-r-00000"):
+        list(read_lines(out))
+    # republish heals: validation re-runs after the repair
+    write_output(out, ["fixed,1"])
+    assert list(read_lines(out)) == ["fixed,1"]
+
+
+def test_checksum_mismatch_same_size_detected(tmp_path):
+    out = str(tmp_path / "out")
+    part = write_output(out, ["abcd,1"])
+    data = open(part, "rb").read()
+    with open(part, "wb") as fh:
+        fh.write(b"X" * len(data))          # same length, different bytes
+    with pytest.raises(TornArtifactError, match="checksum"):
+        list(read_lines(out))
+
+
+def test_unmanifested_part_detected(tmp_path):
+    out = str(tmp_path / "out")
+    write_output(out, ["a,1"])
+    with open(os.path.join(out, "part-r-00099"), "w") as fh:
+        fh.write("stray,1\n")
+    with pytest.raises(TornArtifactError, match="part-r-00099"):
+        list(read_lines(out))
+
+
+def test_lost_part_detected(tmp_path):
+    """The reverse of the unmanifested-part check: a manifest entry
+    whose part file was deleted/lost must refuse the read — otherwise a
+    partial artifact is silently consumed."""
+    out = str(tmp_path / "out")
+    write_output(out, ["s0,1"], shard=0)
+    write_output(out, ["s1,1"], shard=1)
+    os.unlink(os.path.join(out, "part-r-00001"))
+    with pytest.raises(TornArtifactError, match="part-r-00001"):
+        list(read_lines(out))
+
+
+def test_garbled_manifest_is_torn(tmp_path):
+    out = str(tmp_path / "out")
+    write_output(out, ["a,1"])
+    with open(os.path.join(out, MANIFEST_NAME), "w") as fh:
+        fh.write("{not json")
+    with pytest.raises(TornArtifactError, match="unreadable"):
+        list(read_lines(out))
+
+
+def test_sharded_manifests_merge(tmp_path):
+    """DataPartitioner-style multi-shard output: each shard's close
+    merges its entry; every part validates."""
+    out = str(tmp_path / "out")
+    write_output(out, ["s0,1"], shard=0)
+    write_output(out, ["s1,1"], shard=1)
+    doc = json.load(open(os.path.join(out, MANIFEST_NAME)))
+    assert sorted(doc["parts"]) == ["part-r-00000", "part-r-00001"]
+    assert list(read_lines(out)) == ["s0,1", "s1,1"]
+
+
+def test_manifest_drops_ghost_entries_on_rewrite(tmp_path):
+    """A re-run that writes fewer shards must not leave the manifest
+    naming parts that no longer exist."""
+    out = str(tmp_path / "out")
+    write_output(out, ["s0,1"], shard=0)
+    write_output(out, ["s1,1"], shard=1)
+    os.unlink(os.path.join(out, "part-r-00001"))
+    write_output(out, ["s0,2"], shard=0)
+    doc = json.load(open(os.path.join(out, MANIFEST_NAME)))
+    assert sorted(doc["parts"]) == ["part-r-00000"]
+    assert list(read_lines(out)) == ["s0,2"]
+
+
+def test_strict_success_mode_refuses_unmarked_dirs(tmp_path):
+    plain = tmp_path / "plain"
+    plain.mkdir()
+    (plain / "data.csv").write_text("a,1\n")
+    assert list(read_lines(str(plain))) == ["a,1"]       # lenient default
+    cio.configure_from_config(JobConfig({"io.require.success": "true"}))
+    with pytest.raises(TornArtifactError) as ei:
+        list(read_lines(str(plain)))
+    # actionable: names the path and the key
+    assert str(plain) in str(ei.value)
+    assert "io.require.success" in str(ei.value)
+    (plain / SUCCESS_NAME).write_text("")
+    assert list(read_lines(str(plain))) == ["a,1"]
+    # published outputs carry the marker and pass strict mode
+    out = str(tmp_path / "out")
+    write_output(out, ["b,2"])
+    assert list(read_lines(out)) == ["b,2"]
+    cio.configure_from_config(JobConfig({}))
+    assert not cio._REQUIRE_SUCCESS
+
+
+def test_torn_write_fault_point(tmp_path):
+    """The ``torn_write`` injection reproduces the legacy crash: half
+    the bytes under the final name, stale manifest, and the reader
+    catches it."""
+    out = str(tmp_path / "out")
+    write_output(out, [f"v1,{i}" for i in range(100)])
+    faultinject.set_injector(FaultInjector(parse_plan("torn_write@0")))
+    with pytest.raises(InjectedFault, match="torn write"):
+        write_output(out, [f"v2,{i}" for i in range(100)])
+    faultinject.set_injector(None)
+    with pytest.raises(TornArtifactError):
+        list(read_lines(out))
+    write_output(out, [f"v2,{i}" for i in range(100)])   # republish heals
+    assert len(list(read_lines(out))) == 100
+
+
+def test_atomic_write_text_replaces_whole_file(tmp_path):
+    p = str(tmp_path / "nested" / "artifact.json")
+    atomic_write_text(p, "v1")
+    atomic_write_text(p, "v2-longer-content")
+    assert open(p).read() == "v2-longer-content"
+    assert os.listdir(tmp_path / "nested") == ["artifact.json"]  # no litter
+
+
+def test_bare_file_output_is_atomic(tmp_path):
+    p = str(tmp_path / "model.txt")
+    write_output(p, ["v1"], as_dir=False)
+    with pytest.raises(RuntimeError):
+        with OutputWriter(p, as_dir=False) as w:
+            w.write("v2")
+            raise RuntimeError("crash")
+    assert open(p).read() == "v1\n"
+
+
+# ---------------------------------------------------------------------------
+# checkpoint generations + corruption fallback
+# ---------------------------------------------------------------------------
+
+def _stream_ck(tmp_path, inp, keep=3, fallback="cold", resume=False):
+    return StreamCheckpointer(str(tmp_path / "x.ckpt"), interval=2,
+                              kind="t", in_path=inp, params={"p": 1},
+                              keep=keep, fallback=fallback, resume=resume)
+
+
+@pytest.fixture()
+def ckpt_input(tmp_path):
+    inp = tmp_path / "in.txt"
+    inp.write_text("a,b\n" * 100)
+    return str(inp)
+
+
+def test_generations_rotate_and_newest_wins(tmp_path, ckpt_input):
+    ck = _stream_ck(tmp_path, ckpt_input)
+    for i, off in ((1, 10), (3, 30), (5, 50), (7, 70)):
+        ck.save(ck.token(i, off, {"s": i}), {"c": np.ones(2) * i})
+    gens = [p for p in generation_paths(ck.path, 3) if os.path.exists(p)]
+    assert len(gens) == 3                       # keep bounds the set
+    loaded = _stream_ck(tmp_path, ckpt_input, resume=True).load()
+    assert loaded["offset"] == 70
+
+
+def test_corrupt_newest_falls_back_to_older_generation(tmp_path,
+                                                       ckpt_input):
+    ck = _stream_ck(tmp_path, ckpt_input)
+    ck.save(ck.token(1, 10, {"s": 1}), None)
+    ck.save(ck.token(3, 30, {"s": 3}), None)
+    with open(ck.path, "wb") as fh:
+        fh.write(b"\x80garbage-not-a-pickle")
+    loaded = _stream_ck(tmp_path, ckpt_input, resume=True).load()
+    assert loaded["offset"] == 10               # the older generation
+    assert loaded["state"] == {"s": 1}
+
+
+def test_all_generations_corrupt_cold_vs_fail(tmp_path, ckpt_input):
+    ck = _stream_ck(tmp_path, ckpt_input)
+    ck.save(ck.token(1, 10, {}), None)
+    ck.save(ck.token(3, 30, {}), None)
+    for g in generation_paths(ck.path, 3):
+        if os.path.exists(g):
+            with open(g, "wb") as fh:
+                fh.write(b"junk")
+    # cold (default): degrade to a full run
+    assert _stream_ck(tmp_path, ckpt_input, resume=True).load() is None
+    with pytest.raises(CheckpointCorrupt, match="every checkpoint"):
+        _stream_ck(tmp_path, ckpt_input, resume=True,
+                   fallback="fail").load()
+
+
+def test_keep_one_is_the_pre_generation_behavior(tmp_path, ckpt_input):
+    ck = _stream_ck(tmp_path, ckpt_input, keep=1)
+    ck.save(ck.token(1, 10, {}), None)
+    ck.save(ck.token(3, 30, {}), None)
+    assert not os.path.exists(ck.path + ".1")
+    assert _stream_ck(tmp_path, ckpt_input, keep=1,
+                      resume=True).load()["offset"] == 30
+
+
+def test_complete_removes_every_generation(tmp_path, ckpt_input):
+    ck = _stream_ck(tmp_path, ckpt_input)
+    for i in (1, 3, 5):
+        ck.save(ck.token(i, i * 10, {}), None)
+    ck.complete()
+    assert not any(os.path.exists(p)
+                   for p in generation_paths(ck.path, 3))
+
+
+def test_ckpt_corrupt_fault_point_truncates_by_save_index(tmp_path,
+                                                          ckpt_input):
+    ck = _stream_ck(tmp_path, ckpt_input, keep=2)
+    faultinject.set_injector(FaultInjector(parse_plan("ckpt_corrupt@1")))
+    ck.save(ck.token(1, 10, {}), None)          # save 0: intact
+    ck.save(ck.token(3, 30, {}), None)          # save 1: truncated
+    faultinject.set_injector(None)
+    loaded = _stream_ck(tmp_path, ckpt_input, keep=2, resume=True).load()
+    assert loaded["offset"] == 10               # fell back past the newest
+
+
+def test_mismatch_still_raises_not_walks(tmp_path, ckpt_input):
+    """A fingerprint/params mismatch is a config error an older
+    generation of the same wrong run cannot repair — it must raise, not
+    silently cold-start."""
+    from avenir_tpu.core.checkpoint import CheckpointMismatch
+    ck = _stream_ck(tmp_path, ckpt_input)
+    ck.save(ck.token(1, 10, {}), None)
+    other = StreamCheckpointer(ck.path, interval=2, kind="t",
+                               in_path=ckpt_input, params={"p": 2},
+                               resume=True, keep=3)
+    with pytest.raises(CheckpointMismatch):
+        other.load()
+
+
+def test_workflow_sidecar_corrupt_degrades_to_fresh_run(tmp_path,
+                                                        ckpt_input):
+    """The satellite bugfix: a corrupt byte in the workflow sidecar used
+    to crash ``dag --resume`` inside the bare ``pickle.load`` — now it
+    degrades to a fresh run with a warning counter."""
+    from avenir_tpu.core import telemetry
+    path = str(tmp_path / "wf.ckpt")
+    ck = WorkflowCheckpointer(path, ckpt_input)
+    ck.record("s1", "pk", {"$input": ckpt_input}, {})
+    # resume against an intact sidecar: the stage is remembered
+    ok = WorkflowCheckpointer(path, ckpt_input, resume=True)
+    assert "s1" in ok._stages and ok.degraded_reason is None
+    with open(path, "wb") as fh:
+        fh.write(b"\x00corrupt")
+    before = telemetry.get_metrics().counters.get(
+        "Durability", "Workflow sidecar corrupt")
+    degraded = WorkflowCheckpointer(path, ckpt_input, resume=True, keep=1)
+    assert degraded._stages == {}
+    assert "fresh run" in (degraded.degraded_reason or "")
+    assert telemetry.get_metrics().counters.get(
+        "Durability", "Workflow sidecar corrupt") == before + 1
+    with pytest.raises(CheckpointCorrupt):
+        WorkflowCheckpointer(path, ckpt_input, resume=True, keep=1,
+                             fallback="fail")
+
+
+def test_workflow_sidecar_generation_fallback(tmp_path, ckpt_input):
+    path = str(tmp_path / "wf.ckpt")
+    ck = WorkflowCheckpointer(path, ckpt_input, keep=2)
+    ck.record("s1", "pk", {"$input": ckpt_input}, {})
+    ck.record("s2", "pk", {"$input": ckpt_input}, {})   # rotates s1-only
+    with open(path, "wb") as fh:
+        fh.write(b"garbage")
+    loaded = WorkflowCheckpointer(path, ckpt_input, resume=True, keep=2)
+    # the older generation (holding s1 only) is the recovered state
+    assert list(loaded._stages) == ["s1"]
+    assert loaded.degraded_reason is None
+
+
+def test_checkpoint_fallback_key_validated():
+    from avenir_tpu.core.checkpoint import _fallback_from_config
+    assert _fallback_from_config(JobConfig({})) == "cold"
+    assert _fallback_from_config(
+        JobConfig({"checkpoint.fallback": "fail"})) == "fail"
+    with pytest.raises(ValueError, match="checkpoint.fallback"):
+        _fallback_from_config(JobConfig({"checkpoint.fallback": "retry"}))
+
+
+# ---------------------------------------------------------------------------
+# poison quarantine cache
+# ---------------------------------------------------------------------------
+
+def test_poison_quarantine_threshold_and_clear():
+    q = PoisonQuarantine(threshold=2, cap=8)
+    assert not q.quarantined("row")
+    assert q.record("row") == 1
+    assert not q.quarantined("row")
+    assert q.record("row") == 2
+    assert q.quarantined("row")
+    q.clear()
+    assert not q.quarantined("row") and q.size() == 0
+
+
+def test_poison_quarantine_cache_is_bounded_lru():
+    q = PoisonQuarantine(threshold=1, cap=4)
+    for i in range(8):
+        q.record(f"row{i}")
+    assert q.size() == 4
+    assert not q.quarantined("row0")            # evicted
+    assert q.quarantined("row7")
+    # touching an entry protects it from eviction
+    q.quarantined("row4")
+    q.record("rowNEW")
+    assert q.quarantined("row4")
+    assert not q.quarantined("row5")
+
+
+def test_poison_quarantine_from_config():
+    assert PoisonQuarantine.from_config(
+        JobConfig({"serve.poison.quarantine.threshold": "0"})) is None
+    q = PoisonQuarantine.from_config(JobConfig(
+        {"serve.poison.quarantine.threshold": "5",
+         "serve.poison.cache.size": "16"}))
+    assert q.threshold == 5 and q.cap == 16

@@ -653,6 +653,56 @@ def test_drain_deadline_times_out_stuck_requests(artifacts):
         srv.stop()
 
 
+def test_reload_racing_drain_drops_nothing_and_swapped_replica_serves(
+        artifacts):
+    """Durability satellite: a per-replica hot swap RACING the graceful
+    drain — every already-read in-flight request completes with the
+    right bytes (the retired batcher drains, the fresh one admits), and
+    the swapped-in replica serves the first post-drain submission."""
+    srv = PredictionServer(_config(artifacts, **{
+        "serve.pool.replicas": "2",
+        "serve.batch.max.size": "2",
+        "serve.batch.max.delay.ms": "1"}))
+    port = srv.start()
+    try:
+        # slow every replica's scorer so requests are still in flight
+        # when the drain and the reload race each other
+        for grp in srv.pool.variant_groups("churn"):
+            for rep in grp.replicas:
+                real = rep.batcher.predict_fn
+                rep.batcher.predict_fn = (
+                    lambda f: lambda ls: (time.sleep(0.03), f(ls))[1])(real)
+        old0 = srv.pool.variant_groups("churn")[0].replicas[0].entry
+        n = 12
+        with socket.create_connection(("127.0.0.1", port),
+                                      timeout=30) as s:
+            s.sendall(b"".join(
+                json.dumps({"model": "churn",
+                            "row": artifacts["nb_test_lines"][i]}).encode()
+                + b"\n" for i in range(n)))
+            time.sleep(0.05)               # let the frontend read them
+            reloaded = {}
+            rt = threading.Thread(target=lambda: reloaded.update(
+                entry=srv.pool.reload("churn", replica=0)))
+            srv._frontend.begin_drain()
+            rt.start()
+            f = s.makefile("rb")
+            got = [json.loads(f.readline()) for _ in range(n)]
+            assert f.readline() == b""      # drained: socket closed
+            rt.join(timeout=30)
+            assert not rt.is_alive() and "entry" in reloaded
+        for i, r in enumerate(got):
+            assert r.get("output") == artifacts["nb_batch"]["f32"][i], (i, r)
+        group = srv.pool.variant_groups("churn")[0]
+        assert group.replicas[0].entry is not old0          # swapped
+        # the swapped replica answers the first post-drain submission
+        out = group.replicas[0].batcher.submit(
+            artifacts["nb_test_lines"][0]).result(timeout=10)
+        assert out == artifacts["nb_batch"]["f32"][0]
+    finally:
+        srv.stop()
+
+
 def test_new_connections_refused_while_draining(artifacts):
     srv = PredictionServer(_config(artifacts))
     port = srv.start()

@@ -4,12 +4,25 @@ modules must either run under ``core.resilience.with_retries`` (directly,
 or as a helper invoked through it) or appear on the explicit
 ``NON_RETRYABLE`` exclusion registry with a written reason — so new I/O
 on the ingest path cannot silently skip the retry layer, and stale
-exclusions cannot linger after a call site is removed or wrapped."""
+exclusions cannot linger after a call site is removed or wrapped.
+
+Durability lint (the self-healing layer, README "Fault tolerance"):
+every truncate-mode write (``open``/``os.fdopen`` with a ``w*`` mode)
+anywhere in the package must live inside the atomic publish primitives
+(:class:`core.io.OutputWriter` / :func:`core.io.atomic_write_text`) or
+sit on ``core.io.NON_ATOMIC_WRITES`` with a written reason — so a new
+artifact writer cannot silently reintroduce the torn-on-crash in-place
+``open(path, "w")`` this layer exists to kill.  And every
+``checkpoint.*`` / ``io.*`` / ``serve.poison.*`` config key must be
+KEY_-bound, read through a JobConfig accessor, and README-documented
+(pattern of test_dag_coverage)."""
 
 import ast
 import os
+import re
 
 import avenir_tpu
+from avenir_tpu.core.io import NON_ATOMIC_WRITES
 from avenir_tpu.core.resilience import NON_RETRYABLE
 
 PKG_DIR = os.path.dirname(avenir_tpu.__file__)
@@ -134,3 +147,177 @@ def test_retry_wrappers_exist():
     assert "native/__init__.py:_read_part" in wrapped
     assert "native/__init__.py:_cc_run" in wrapped
     assert "core/pipeline.py:_open_text" in wrapped
+
+
+# ---------------------------------------------------------------------------
+# durability: truncate-mode writes are atomic or excluded with a reason
+# ---------------------------------------------------------------------------
+
+#: quals that ARE the atomic publish layer (writes inside them stage to
+#: a temp path and land via fsync + os.replace)
+ATOMIC_PRIMITIVES = ("core/io.py:atomic_write_text",
+                     "core/io.py:OutputWriter.")
+
+
+class _WriteScan(ast.NodeVisitor):
+    """Collects ``open``/``os.fdopen`` calls whose mode argument is a
+    ``w*`` constant (truncate-rewrite: the torn-on-crash shape) or a
+    non-constant expression (flagged conservatively).  Read-mode and
+    append-mode calls pass."""
+
+    def __init__(self):
+        self.stack = []
+        self.sites = {}              # qualname -> [lineno...]
+
+    def _qual(self):
+        return ".".join(self.stack) if self.stack else "<module>"
+
+    def visit_ClassDef(self, node):
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    @staticmethod
+    def _truncating(node) -> bool:
+        mode = node.args[1] if len(node.args) >= 2 else None
+        for kw in node.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        if mode is None:
+            return False                      # default: read
+        if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+            return mode.value.startswith("w")
+        return True                           # dynamic mode: flag it
+
+    def visit_Call(self, node):
+        fn = node.func
+        is_write = False
+        if isinstance(fn, ast.Name) and fn.id == "open":
+            is_write = self._truncating(node)
+        elif (isinstance(fn, ast.Attribute) and fn.attr == "fdopen"
+              and isinstance(fn.value, ast.Name)
+              and fn.value.id == "os"):
+            is_write = self._truncating(node)
+        if is_write:
+            self.sites.setdefault(self._qual(), []).append(node.lineno)
+        self.generic_visit(node)
+
+
+def _scan_writes():
+    sites = {}
+    for root, _dirs, files in os.walk(PKG_DIR):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            rel = os.path.relpath(path, PKG_DIR)
+            scan = _WriteScan()
+            scan.visit(ast.parse(open(path).read(), filename=path))
+            for qual, lines in scan.sites.items():
+                sites[f"{rel}:{qual}"] = lines
+    return sites
+
+
+def _is_atomic(key: str) -> bool:
+    return key.startswith(ATOMIC_PRIMITIVES)
+
+
+def test_truncate_writes_are_atomic_or_excluded():
+    sites = _scan_writes()
+    bad = [f"{k} (lines {v})" for k, v in sorted(sites.items())
+           if not _is_atomic(k) and k not in NON_ATOMIC_WRITES]
+    assert not bad, (
+        "truncate-mode writes outside the atomic publish layer "
+        "(OutputWriter / atomic_write_text): route them through "
+        "core.io.atomic_write_text, or add to core.io.NON_ATOMIC_WRITES "
+        f"with a written reason: {bad}")
+
+
+def test_non_atomic_exclusions_are_live_and_reasoned():
+    sites = _scan_writes()
+    for key, reason in NON_ATOMIC_WRITES.items():
+        assert reason and reason.strip(), f"empty exclusion reason: {key}"
+        assert key in sites, (
+            f"stale NON_ATOMIC_WRITES entry {key!r}: no such write site "
+            f"exists anymore — drop it")
+        assert not _is_atomic(key), (
+            f"NON_ATOMIC_WRITES entry {key!r} is inside the atomic "
+            f"publish layer — drop the redundant exclusion")
+
+
+def test_atomic_publish_layer_really_writes():
+    """Guards the whitelist itself: the atomic primitives contain the
+    package's staged write sites (a refactor that renames them must
+    update ATOMIC_PRIMITIVES, not silently stop linting)."""
+    sites = _scan_writes()
+    assert any(k.startswith("core/io.py:OutputWriter.") for k in sites)
+    assert any(k.startswith("core/io.py:atomic_write_text")
+               for k in sites)
+
+
+# ---------------------------------------------------------------------------
+# durability config keys: KEY_-bound, JobConfig-read, README-documented
+# ---------------------------------------------------------------------------
+
+_DUR_PREFIX = r"(?:checkpoint|io|serve\.poison)\."
+
+_DUR_CONST_RE = re.compile(
+    r'^(KEY_[A-Z0-9_]+)\s*=\s*"(' + _DUR_PREFIX + r'[a-z0-9.]+)"',
+    re.MULTILINE)
+_DUR_LITERAL_RE = re.compile(
+    r'\.(?:get|get_int|get_float|get_boolean|get_list|must|must_int|'
+    r'must_float|must_list)\(\s*"(' + _DUR_PREFIX + r'[a-z0-9.]+)"')
+
+
+def _package_sources():
+    for root, _dirs, files in os.walk(PKG_DIR):
+        for fn in sorted(files):
+            if fn.endswith(".py"):
+                path = os.path.join(root, fn)
+                yield path, open(path).read()
+
+
+def _durability_keys():
+    keys = {}
+    for _path, text in _package_sources():
+        for m in _DUR_CONST_RE.finditer(text):
+            keys.setdefault(m.group(2), m.group(1))
+        for m in _DUR_LITERAL_RE.finditer(text):
+            keys.setdefault(m.group(1), None)
+    return keys
+
+
+def test_durability_keys_are_constants_read_through_jobconfig():
+    keys = _durability_keys()
+    # the surface this PR wired must be visible to the lint at all
+    for expected in ("checkpoint.keep", "checkpoint.fallback",
+                     "io.require.success", "serve.poison.isolate",
+                     "serve.poison.quarantine.threshold",
+                     "serve.poison.cache.size"):
+        assert expected in keys, f"{expected} not found (lint broken?)"
+    sources = list(_package_sources())
+    bad = []
+    for key, const in sorted(keys.items()):
+        if const is None:
+            bad.append((key, "no KEY_ constant binds this literal"))
+            continue
+        accessor = re.compile(
+            r"\.(?:get|get_int|get_float|get_boolean|get_list|must|"
+            r"must_int|must_float|must_list)\(\s*(?:\w+\.)?" + const + r"\b")
+        if not any(accessor.search(text) for _p, text in sources):
+            bad.append((key, f"{const} never read via a JobConfig accessor"))
+    assert not bad, f"durability config keys failing the lint: {bad}"
+
+
+def test_durability_keys_documented_in_readme():
+    readme = open(os.path.join(PKG_DIR, "..", "README.md")).read()
+    missing = [k for k in sorted(_durability_keys()) if k not in readme]
+    assert not missing, (
+        f"durability config keys missing from README: {missing}")
