@@ -150,12 +150,14 @@ def extract_resume_flag(argv):
 
 def configure_resilience(config) -> None:
     """Apply the resilience-layer config surfaces (retry policy + fault
-    injection plan + the io durability strict mode) — called by every
-    CLI entry point next to the obs configure."""
-    from .core import faultinject, io, resilience
+    injection plan + the io durability strict mode + the flight
+    recorder's dump surface) — called by every CLI entry point next to
+    the obs configure."""
+    from .core import faultinject, flight, io, resilience
     resilience.configure_from_config(config)
     faultinject.configure_from_config(config)
     io.configure_from_config(config)
+    flight.configure_from_config(config)
 
 
 def _init_runtime() -> None:
@@ -221,6 +223,11 @@ def multi_main(argv) -> int:
     try:
         results = run_multi(config, in_path, out_base, _job_resolver,
                             log=lambda m: print(m, file=sys.stderr))
+    except BaseException as exc:
+        # a fatal workflow exception still leaves the black box behind
+        from .core import flight
+        flight.fatal(exc)
+        raise
     finally:
         if flusher is not None:
             flusher.stop()
@@ -264,6 +271,11 @@ def dag_main(argv) -> int:
     try:
         results = run_workflow(config, in_path, out_base, _job_resolver,
                                log=lambda m: print(m, file=sys.stderr))
+    except BaseException as exc:
+        # a fatal workflow exception still leaves the black box behind
+        from .core import flight
+        flight.fatal(exc)
+        raise
     finally:
         if flusher is not None:
             flusher.stop()
@@ -355,6 +367,12 @@ def main(argv=None) -> int:
                 result = job.run(positional[0], positional[1])
         else:
             result = job.run(positional[0], positional[1])
+    except BaseException as exc:
+        # fatal batch-job exception: force one flight dump (black box)
+        # before the normal finally-path exports run
+        from .core import flight
+        flight.fatal(exc)
+        raise
     finally:
         # export even when the job raises or is interrupted — a trace of
         # the failing/slow run is the one the user most needs; the

@@ -82,7 +82,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 from .config import JobConfig, parse_properties
 from .io import ArtifactStore, read_lines, set_artifact_store, write_output
 from .metrics import Counters
-from .obs import get_tracer, traced_run
+from .obs import get_tracer, new_trace_context, traced_run
 from . import telemetry
 
 # -- config surface (tier-2 lint: tests/test_dag_coverage.py) --------------
@@ -649,8 +649,14 @@ def run_workflow(config: JobConfig, in_path: str, out_base: Optional[str],
     prev_strict = set_require_success(
         config.get_boolean(KEY_REQUIRE_SUCCESS, False))
     prev_store = set_artifact_store(store)
+    # the workflow's trace context: every stage span (and, through the
+    # thread-local, the multiscan/pipeline spans of fused scans and the
+    # prefetch workers they adopt) stamps this trace id, so one Perfetto
+    # export shows the whole workflow's stage lineage as one trace
+    wf_ctx = new_trace_context(sampled=True) if tracer.enabled else None
     try:
-        with tracer.span("dag.run", stages=",".join(by_id)):
+        with tracer.span("dag.run", stages=",".join(by_id), ctx=wf_ctx,
+                         span_id=wf_ctx.span_id if wf_ctx else None):
             while len(done) < len(stages):
                 ready = [s for s in stages if s.sid not in done
                          and all(d in done for d in s.deps)]

@@ -63,7 +63,22 @@ class TornArtifactError(RuntimeError):
     missing/mismatched manifest entry, or — in strict mode — a missing
     ``_SUCCESS`` marker).  The message names the path and the repair
     (re-run the producing job); consumers that hold an older healthy
-    version (the serving registry's hot-swap reload) keep serving it."""
+    version (the serving registry's hot-swap reload) keep serving it.
+
+    Every construction is an anomaly trigger: the flight recorder
+    (core.flight) marks its ring — and dumps it when configured — so a
+    torn artifact detected anywhere (batch input read, DAG stage skip
+    validation, serving reload) leaves the black box behind.  Hooking
+    the exception itself covers every raise site; the tier-2 lint in
+    tests/test_obs_coverage.py asserts this stays true."""
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        from . import flight
+        try:
+            flight.trigger("torn_artifact", detail=str(self))
+        except Exception:                               # noqa: BLE001
+            pass        # the black box must never mask the real error
 
 
 _REQUIRE_SUCCESS = False

@@ -259,6 +259,7 @@ class MultiScanEngine:
         identical remaining chunks."""
         tracer = get_tracer()
         parent = tracer.current_span_id()
+        trace = tracer.current_trace_id()
         stager = pipeline.HostStager()
         xfer_fixed = pipeline.ChunkTransfer(self.mesh,
                                             capacity=self.chunk_rows,
@@ -390,7 +391,7 @@ class MultiScanEngine:
                                                 start_offset=resume_offset)
         pipeline.drive_prefetched(chunks, encode_chunk, consume,
                                   self.prefetch_depth, tracer=tracer,
-                                  parent=parent,
+                                  parent=parent, trace=trace,
                                   thread_name="avenir-multiscan-prefetch")
         if saver is not None:
             saver.flush()
@@ -580,8 +581,17 @@ def run_multi(config: JobConfig, in_path: str, out_base: Optional[str],
         engine.register(e.spec)
         fused[e.jid] = e
 
+    # the scan roots a fresh workflow trace context unless one is
+    # already active (a DAG stage run inherits the workflow's trace via
+    # the thread-local set by dag.run's root span)
+    scan_ctx = None
+    if tracer.enabled and tracer.current_trace_id() is None:
+        from .obs import new_trace_context
+        scan_ctx = new_trace_context(sampled=True)
     results: Dict[str, Counters] = {}
-    with tracer.span("multiscan.scan", jobs=",".join(fused)):
+    with tracer.span("multiscan.scan", jobs=",".join(fused),
+                     ctx=scan_ctx,
+                     span_id=scan_ctx.span_id if scan_ctx else None):
         results.update(engine.run(
             in_path, config.field_delim_regex(), checkpointer=ck,
             resume_carries=resume_carries, resume_offset=resume_offset,

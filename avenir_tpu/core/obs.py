@@ -32,6 +32,22 @@ Config surface (the .properties files every job loads):
   (default 65536; oldest records drop first)
 - ``obs.histogram.buckets``  — log buckets across the 1µs..100s span
   (default 96, i.e. 12/decade — ~21% worst-case quantile ratio error)
+- ``obs.sample.rate``        — fraction of wire requests that get their
+  per-request causal trace recorded while tracing is enabled (default
+  1.0; Dapper-style head sampling — errors/shed/poison requests are
+  always sampled retroactively at response time)
+
+Causal request tracing (the Dapper shape): every wire request carries a
+:class:`TraceContext` — a ``trace_id`` (client-supplied or generated),
+the request's pre-allocated root ``span_id``, and the head-sampling
+decision.  The context travels WITH the request object across thread
+boundaries (frontend I/O shard -> router -> replica batcher worker);
+spans created with ``span(..., ctx=ctx)`` parent to the context's root
+and stamp its ``trace`` attr, and :meth:`Tracer.adopt` accepts a context
+so a worker thread's whole span tree joins the trace.  Micro-batch
+fan-in is linked explicitly: the shared ``serve.batch`` span records its
+member requests' span ids and each member's ``serve.score`` span records
+the batch span id (see serve/batcher.py).
 """
 
 from __future__ import annotations
@@ -41,6 +57,7 @@ import functools
 import itertools
 import json
 import os
+import random
 import threading
 import time
 from collections import deque
@@ -51,11 +68,42 @@ from .metrics import Counters
 KEY_TRACE_ENABLE = "obs.trace.enable"
 KEY_TRACE_BUFFER = "obs.trace.buffer.spans"
 KEY_HIST_BUCKETS = "obs.histogram.buckets"
+KEY_SAMPLE_RATE = "obs.sample.rate"
 
 DEFAULT_BUFFER_SPANS = 1 << 16
 DEFAULT_HIST_BUCKETS = 96
+DEFAULT_SAMPLE_RATE = 1.0
 HIST_LO_SEC = 1e-6            # smallest resolvable latency bucket edge
 HIST_HI_SEC = 100.0           # largest; beyond lands in the overflow bucket
+
+
+# ---------------------------------------------------------------------------
+# trace context (causal request identity)
+# ---------------------------------------------------------------------------
+
+class TraceContext:
+    """One request's causal identity: the ``trace_id`` shared by every
+    span of the request, its pre-allocated root ``span_id`` (so fan-in
+    spans can reference the request before its root span is recorded —
+    root spans are recorded RETROACTIVELY at response time), and the
+    head-sampling decision.  ``sampled`` may be flipped True at response
+    time (errors/shed/poison are always sampled)."""
+
+    __slots__ = ("trace_id", "span_id", "sampled")
+
+    def __init__(self, trace_id: str, span_id: Optional[int],
+                 sampled: bool = True):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.sampled = bool(sampled)
+
+    def __repr__(self):
+        return (f"TraceContext({self.trace_id!r}, span={self.span_id}, "
+                f"sampled={self.sampled})")
+
+
+#: sentinel: this span did not change the thread's current trace id
+_NO_RESTORE = object()
 
 
 # ---------------------------------------------------------------------------
@@ -116,26 +164,52 @@ _NULL_SPAN = _NullSpan()
 
 
 class _SpanCtx:
-    """A live span context manager (enabled tracer only)."""
+    """A live span context manager (enabled tracer only).
 
-    __slots__ = ("_tracer", "name", "attrs", "span_id", "parent_id", "_t0")
+    ``ctx`` joins the span to a :class:`TraceContext`: without an
+    explicit ``span_id`` the span is a CHILD of the context (parent =
+    ``ctx.span_id``); with one it IS the context's root span (own id =
+    ``ctx.span_id``, parentage from the thread as usual).  Either way
+    the thread's current trace id is set for the span's extent, so
+    nested spans stamp the same ``trace`` attr."""
+
+    __slots__ = ("_tracer", "name", "attrs", "span_id", "parent_id", "_t0",
+                 "_ctx", "_own_id", "_trace_saved")
 
     def __init__(self, tracer: "Tracer", name: str,
-                 parent: Optional[int], attrs: dict):
+                 parent: Optional[int], attrs: dict,
+                 ctx: Optional[TraceContext] = None,
+                 span_id: Optional[int] = None):
         self._tracer = tracer
         self.name = name
         self.attrs = attrs
         self.parent_id = parent
         self.span_id = None
         self._t0 = 0
+        self._ctx = ctx
+        self._own_id = span_id
+        self._trace_saved = _NO_RESTORE
 
     def __enter__(self):
         tr = self._tracer
         stack = tr._stack()
+        ctx = self._ctx
         if self.parent_id is None:
-            self.parent_id = (stack[-1] if stack
-                              else getattr(tr._tls, "base_parent", None))
-        self.span_id = next(tr._ids)
+            if ctx is not None and self._own_id is None:
+                self.parent_id = ctx.span_id
+            else:
+                self.parent_id = (stack[-1] if stack
+                                  else getattr(tr._tls, "base_parent", None))
+        if ctx is not None:
+            self._trace_saved = getattr(tr._tls, "trace", None)
+            tr._tls.trace = ctx.trace_id
+            self.attrs.setdefault("trace", ctx.trace_id)
+        else:
+            t = getattr(tr._tls, "trace", None)
+            if t is not None:
+                self.attrs.setdefault("trace", t)
+        self.span_id = (self._own_id if self._own_id is not None
+                        else next(tr._ids))
         stack.append(self.span_id)
         with tr._lock:
             tr._active += 1
@@ -148,6 +222,8 @@ class _SpanCtx:
         stack = tr._stack()
         if stack and stack[-1] == self.span_id:
             stack.pop()
+        if self._trace_saved is not _NO_RESTORE:
+            tr._tls.trace = self._trace_saved
         th = threading.current_thread()
         tr._append(Span(self.name, self.span_id, self.parent_id,
                         th.ident, th.name, self._t0, dur, self.attrs))
@@ -170,8 +246,10 @@ class Tracer:
     """
 
     def __init__(self, enabled: bool = False,
-                 buffer_spans: int = DEFAULT_BUFFER_SPANS):
+                 buffer_spans: int = DEFAULT_BUFFER_SPANS,
+                 sample_rate: float = DEFAULT_SAMPLE_RATE):
         self.enabled = bool(enabled)
+        self.sample_rate = float(sample_rate)
         self._buf: deque = deque(maxlen=max(int(buffer_spans), 1))
         self._ids = itertools.count(1)
         self._tls = threading.local()
@@ -181,23 +259,36 @@ class Tracer:
         self._epoch_ns = time.perf_counter_ns()
 
     # -- recording ---------------------------------------------------------
-    def span(self, name: str, parent: Optional[int] = None, **attrs):
+    def span(self, name: str, parent: Optional[int] = None,
+             ctx: Optional[TraceContext] = None,
+             span_id: Optional[int] = None, **attrs):
         """Context manager timing the enclosed block.  Disabled-mode cost
-        is one attribute check + a shared no-op object."""
+        is one attribute check + a shared no-op object.  ``ctx`` joins
+        the span to a request trace (see :class:`_SpanCtx`)."""
         if not self.enabled:
             return _NULL_SPAN
-        return _SpanCtx(self, name, parent, attrs)
+        return _SpanCtx(self, name, parent, attrs, ctx=ctx, span_id=span_id)
 
     def record_span(self, name: str, t0_ns: int, dur_ns: int,
-                    parent: Optional[int] = None, **attrs) -> None:
+                    parent: Optional[int] = None,
+                    ctx: Optional[TraceContext] = None,
+                    span_id: Optional[int] = None, **attrs) -> None:
         """Record an already-measured interval (e.g. queue wait computed
-        from an enqueue timestamp) without a with-block."""
+        from an enqueue timestamp) without a with-block.  With ``ctx``
+        the span stamps the trace id and (unless ``span_id`` names it as
+        the context's own root span) parents to the context's root; with
+        ``span_id`` the caller owns parentage — ``parent=None`` records
+        a detached root."""
         if not self.enabled:
             return
-        if parent is None:
-            parent = self.current_span_id()
+        if ctx is not None:
+            attrs.setdefault("trace", ctx.trace_id)
+        if parent is None and span_id is None:
+            parent = (ctx.span_id if ctx is not None
+                      else self.current_span_id())
         th = threading.current_thread()
-        self._append(Span(name, next(self._ids), parent, th.ident,
+        self._append(Span(name, span_id if span_id is not None
+                          else next(self._ids), parent, th.ident,
                           th.name, int(t0_ns), max(int(dur_ns), 0), attrs))
 
     def gauge(self, name: str, value) -> None:
@@ -229,10 +320,44 @@ class Tracer:
             return stack[-1]
         return getattr(self._tls, "base_parent", None)
 
-    def adopt(self, parent_id: Optional[int]) -> None:
+    def adopt(self, parent, trace: Optional[str] = None) -> None:
         """Seed this thread's root parent: subsequent top-level spans on
-        the calling thread parent to ``parent_id``."""
-        self._tls.base_parent = parent_id
+        the calling thread parent to ``parent``.  Accepts either a span
+        id (optionally with an explicit ``trace`` id so the worker's
+        spans join the caller's trace) or a whole :class:`TraceContext`
+        — adopt-by-context, the cross-thread half of causal request
+        tracing."""
+        if isinstance(parent, TraceContext):
+            self._tls.base_parent = parent.span_id
+            self._tls.trace = parent.trace_id
+            return
+        self._tls.base_parent = parent
+        if trace is not None:
+            self._tls.trace = trace
+
+    def current_trace_id(self) -> Optional[str]:
+        """The calling thread's current trace id (an enclosing
+        ctx-joined span or an adopt-by-context), or None."""
+        return getattr(self._tls, "trace", None)
+
+    def current_context(self) -> Optional[TraceContext]:
+        """The calling thread's (trace id, innermost span id) as a
+        TraceContext — the handle to pass a worker thread's ``adopt``.
+        None when no trace is active on this thread."""
+        t = getattr(self._tls, "trace", None)
+        if t is None:
+            return None
+        return TraceContext(t, self.current_span_id(), True)
+
+    def sample(self) -> bool:
+        """One head-sampling decision at ``obs.sample.rate`` (True only
+        while the tracer is enabled)."""
+        if not self.enabled:
+            return False
+        rate = self.sample_rate
+        if rate >= 1.0:
+            return True
+        return rate > 0.0 and random.random() < rate
 
     # -- inspection --------------------------------------------------------
     def records(self) -> List[object]:
@@ -415,9 +540,17 @@ class LatencyHistogram:
     interpolating between its edges, clamped to the observed min/max —
     worst-case ratio error is one bucket's growth factor
     (~21% at the default 12 buckets/decade, typically far less).
+
+    Exemplars: ``record(seconds, trace_id=...)`` retains the LAST sampled
+    trace id per bucket (with its exact value and epoch timestamp), so a
+    bad tail quantile links directly to a trace to open — surfaced as
+    OpenMetrics exemplars in the Prometheus exposition
+    (``core.telemetry.prometheus_text``) and as ``p99_exemplar`` in
+    :meth:`snapshot`.  Exemplars merge latest-timestamp-wins.
     """
 
-    __slots__ = ("bounds", "counts", "n", "total", "vmin", "vmax", "_lock")
+    __slots__ = ("bounds", "counts", "n", "total", "vmin", "vmax",
+                 "exemplars", "_lock")
 
     def __init__(self, n_buckets: int = DEFAULT_HIST_BUCKETS,
                  lo: float = HIST_LO_SEC, hi: float = HIST_HI_SEC):
@@ -429,10 +562,13 @@ class LatencyHistogram:
         self.total = 0.0
         self.vmin = float("inf")
         self.vmax = float("-inf")
+        # bucket index -> (trace_id, value seconds, epoch ts): the last
+        # sampled request that landed in the bucket
+        self.exemplars: Dict[int, tuple] = {}
         self._lock = threading.Lock()
 
     # -- recording ---------------------------------------------------------
-    def record(self, seconds: float) -> None:
+    def record(self, seconds: float, trace_id: Optional[str] = None) -> None:
         s = float(seconds)
         i = bisect.bisect_right(self.bounds, s)
         with self._lock:
@@ -443,9 +579,11 @@ class LatencyHistogram:
                 self.vmin = s
             if s > self.vmax:
                 self.vmax = s
+            if trace_id is not None:
+                self.exemplars[i] = (str(trace_id), s, time.time())
 
-    def record_ns(self, ns: int) -> None:
-        self.record(ns * 1e-9)
+    def record_ns(self, ns: int, trace_id: Optional[str] = None) -> None:
+        self.record(ns * 1e-9, trace_id=trace_id)
 
     def reset(self) -> None:
         with self._lock:
@@ -454,6 +592,7 @@ class LatencyHistogram:
             self.total = 0.0
             self.vmin = float("inf")
             self.vmax = float("-inf")
+            self.exemplars = {}
 
     def merge(self, other: "LatencyHistogram") -> "LatencyHistogram":
         """Fold ``other`` into this histogram (boundaries must match)."""
@@ -461,6 +600,7 @@ class LatencyHistogram:
             raise ValueError("cannot merge histograms with different "
                              "bucket boundaries")
         counts, n, total, vmin, vmax = other._state()
+        ex = other._exemplar_state()
         with self._lock:
             for i, c in enumerate(counts):
                 self.counts[i] += c
@@ -468,11 +608,19 @@ class LatencyHistogram:
             self.total += total
             self.vmin = min(self.vmin, vmin)
             self.vmax = max(self.vmax, vmax)
+            for i, e in ex.items():
+                cur = self.exemplars.get(i)
+                if cur is None or e[2] >= cur[2]:
+                    self.exemplars[i] = e
         return self
 
     def _state(self):
         with self._lock:
             return list(self.counts), self.n, self.total, self.vmin, self.vmax
+
+    def _exemplar_state(self) -> Dict[int, tuple]:
+        with self._lock:
+            return dict(self.exemplars)
 
     # -- quantiles ---------------------------------------------------------
     def quantile(self, q: float) -> Optional[float]:
@@ -513,12 +661,41 @@ class LatencyHistogram:
             return round(
                 self._quantile_from(counts, n, vmin, vmax, q) * 1000.0, 4)
 
-        return {"n": n,
-                "mean_ms": round(total / n * 1000.0, 4),
-                "min_ms": round(vmin * 1000.0, 4),
-                "max_ms": round(vmax * 1000.0, 4),
-                "p50_ms": pct(0.50), "p90_ms": pct(0.90),
-                "p95_ms": pct(0.95), "p99_ms": pct(0.99)}
+        out = {"n": n,
+               "mean_ms": round(total / n * 1000.0, 4),
+               "min_ms": round(vmin * 1000.0, 4),
+               "max_ms": round(vmax * 1000.0, 4),
+               "p50_ms": pct(0.50), "p90_ms": pct(0.90),
+               "p95_ms": pct(0.95), "p99_ms": pct(0.99)}
+        ex = self.exemplar_near(0.99)
+        if ex is not None:
+            out["p99_exemplar"] = ex
+        return out
+
+    def exemplar_near(self, q: float = 0.99) -> Optional[dict]:
+        """The retained exemplar closest at-or-below the bucket holding
+        the ``q``-quantile rank (nearest above as a fallback) — the
+        "p99 is bad, open THIS trace" link in stats/health."""
+        counts, n, _total, _vmin, _vmax = self._state()
+        ex = self._exemplar_state()
+        if n == 0 or not ex:
+            return None
+        target = max(q, 0.0) * n
+        cum = 0
+        bucket = len(counts) - 1
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= target:
+                bucket = i
+                break
+        order = list(range(bucket, -1, -1)) + list(range(bucket + 1,
+                                                         len(counts)))
+        for i in order:
+            e = ex.get(i)
+            if e is not None:
+                return {"trace_id": e[0],
+                        "value_ms": round(e[1] * 1000.0, 4), "ts": e[2]}
+        return None
 
     def state_dict(self) -> dict:
         """Mergeable raw state: sparse bucket counts + the shape params
@@ -527,12 +704,18 @@ class LatencyHistogram:
         multi-host aggregation is a fold over these dicts; see
         ``core.telemetry.merge_snapshots``)."""
         counts, n, total, vmin, vmax = self._state()
-        return {"n_buckets": len(self.bounds) - 1,
-                "lo": self.bounds[0], "hi": self.bounds[-1],
-                "counts": {str(i): c for i, c in enumerate(counts) if c},
-                "n": n, "total": total,
-                "vmin": (vmin if n else None),
-                "vmax": (vmax if n else None)}
+        out = {"n_buckets": len(self.bounds) - 1,
+               "lo": self.bounds[0], "hi": self.bounds[-1],
+               "counts": {str(i): c for i, c in enumerate(counts) if c},
+               "n": n, "total": total,
+               "vmin": (vmin if n else None),
+               "vmax": (vmax if n else None)}
+        ex = self._exemplar_state()
+        if ex:
+            out["exemplars"] = {
+                str(i): {"trace_id": t, "value": v, "ts": ts}
+                for i, (t, v, ts) in sorted(ex.items())}
+        return out
 
     @classmethod
     def from_state(cls, state: dict) -> "LatencyHistogram":
@@ -548,6 +731,9 @@ class LatencyHistogram:
         if h.n:
             h.vmin = float(state["vmin"])
             h.vmax = float(state["vmax"])
+        for i, e in (state.get("exemplars") or {}).items():
+            h.exemplars[int(i)] = (e["trace_id"], float(e["value"]),
+                                   float(e["ts"]))
         return h
 
 
@@ -649,8 +835,28 @@ def set_tracer(tracer: Tracer) -> Tracer:
     return tracer
 
 
+def new_trace_context(trace_id: Optional[str] = None,
+                      sampled: Optional[bool] = None) -> TraceContext:
+    """Mint one request's :class:`TraceContext` against the global
+    tracer: a client-supplied ``trace_id`` propagates (and forces the
+    sampling decision — the caller already committed to the trace, the
+    Dapper propagation rule); otherwise a random 64-bit hex id is
+    generated (``os.urandom`` — thread-safe, collision-free in practice)
+    and head sampling applies ``obs.sample.rate``.  The root span id is
+    pre-allocated from the tracer's id space so fan-in spans can
+    reference the request before its retroactive root span exists."""
+    tr = _GLOBAL_TRACER
+    client = trace_id is not None
+    if trace_id is None:
+        trace_id = os.urandom(8).hex()
+    if sampled is None:
+        sampled = (tr.enabled and client) or tr.sample()
+    return TraceContext(str(trace_id), next(tr._ids), bool(sampled))
+
+
 def configure(enabled: Optional[bool] = None,
-              buffer_spans: Optional[int] = None) -> Tracer:
+              buffer_spans: Optional[int] = None,
+              sample_rate: Optional[float] = None) -> Tracer:
     """Reconfigure the global tracer IN PLACE (every call site that
     already fetched it sees the change)."""
     tr = _GLOBAL_TRACER
@@ -659,6 +865,8 @@ def configure(enabled: Optional[bool] = None,
             tr._buf = deque(tr._buf, maxlen=max(int(buffer_spans), 1))
         if enabled is not None:
             tr.enabled = bool(enabled)
+        if sample_rate is not None:
+            tr.sample_rate = float(sample_rate)
     return tr
 
 
@@ -666,7 +874,8 @@ def configure_from_config(config, force_enable: bool = False) -> Tracer:
     """Apply the ``obs.*`` properties surface to the global tracer."""
     return configure(
         enabled=force_enable or config.get_boolean(KEY_TRACE_ENABLE, False),
-        buffer_spans=config.get_int(KEY_TRACE_BUFFER, DEFAULT_BUFFER_SPANS))
+        buffer_spans=config.get_int(KEY_TRACE_BUFFER, DEFAULT_BUFFER_SPANS),
+        sample_rate=config.get_float(KEY_SAMPLE_RATE, DEFAULT_SAMPLE_RATE))
 
 
 def histogram_buckets_from_config(config) -> int:

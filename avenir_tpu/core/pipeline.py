@@ -369,7 +369,7 @@ _DONE = object()
 
 
 def drive_prefetched(chunks: Iterable, produce: Callable, consume: Callable,
-                     depth: int, tracer=None, parent=None,
+                     depth: int, tracer=None, parent=None, trace=None,
                      thread_name: str = "avenir-ingest-prefetch") -> None:
     """Run ``consume(produce(chunk))`` over a chunk stream — serially
     when ``depth <= 0``, else with ``produce`` (parse + H2D transfer) on
@@ -398,7 +398,11 @@ def drive_prefetched(chunks: Iterable, produce: Callable, consume: Callable,
     worker_exc: list = [None]
 
     def worker():
-        tracer.adopt(parent)
+        # the worker joins the caller's span tree AND its trace (when
+        # the caller is running under a workflow/request trace context),
+        # so a Perfetto export shows the prefetch track as part of the
+        # same causal trace
+        tracer.adopt(parent, trace)
         try:
             for item in chunks:
                 # consumer died (fold error / Ctrl-C): stop parsing
@@ -807,7 +811,9 @@ def streaming_fold(chunks: Iterable[Tuple[np.ndarray, ...]],
     tracer = get_tracer()
     # worker-thread spans (H2D copies + the read/parse work the chunk
     # generator does on that thread) parent under the caller's open span
+    # and join the caller's trace (a workflow/request trace context)
     parent = tracer.current_span_id()
+    trace = tracer.current_trace_id()
 
     transfer = ChunkTransfer(mesh, capacity=capacity, tracer=tracer)
     cf = ChunkFold(local_fn, static_args=static_args,
@@ -843,7 +849,7 @@ def streaming_fold(chunks: Iterable[Tuple[np.ndarray, ...]],
                 saver.push(token, cf.snapshot())
 
     drive_prefetched(chunks, produce, consume, prefetch_depth,
-                     tracer=tracer, parent=parent)
+                     tracer=tracer, parent=parent, trace=trace)
     if saver is not None:
         saver.flush()
     return cf.result()

@@ -133,8 +133,22 @@ def build_snapshot(registry: Optional[Metrics] = None,
     return snap
 
 
+def merge_exemplar_states(a: Optional[dict], b: Optional[dict]) -> dict:
+    """Latest-timestamp-wins per-bucket merge of two ``state_dict``-form
+    exemplar maps (``{bucket: {"trace_id", "value", "ts"}}``) — the ONE
+    rule, shared by snapshot merging here and the replica-pool histogram
+    aggregation (serve.pool.merged_hist_state)."""
+    out = dict(a or {})
+    for i, e in (b or {}).items():
+        cur = out.get(i)
+        if cur is None or e["ts"] >= cur["ts"]:
+            out[i] = e
+    return out
+
+
 def _merge_hist_state(a: dict, b: dict) -> dict:
-    """Bucket-wise add of two histogram state dicts (same ladder)."""
+    """Bucket-wise add of two histogram state dicts (same ladder);
+    exemplars keep the latest-timestamped trace per bucket."""
     for k in ("n_buckets", "lo", "hi"):
         if a[k] != b[k]:
             raise ValueError(
@@ -152,6 +166,9 @@ def _merge_hist_state(a: dict, b: dict) -> dict:
     if n:
         out["vmin"] = min(vmins)
         out["vmax"] = max(vmaxs)
+    ex = merge_exemplar_states(a.get("exemplars"), b.get("exemplars"))
+    if ex:
+        out["exemplars"] = ex
     return out
 
 
@@ -284,6 +301,20 @@ def prometheus_text(snapshot: dict, prefix: str = "avenir") -> str:
             lbl = labels + "," if labels else ""
             bounds = obs._log_bounds(st["n_buckets"], st["lo"], st["hi"])
             counts = st.get("counts", {})
+            exemplars = st.get("exemplars") or {}
+
+            def _exemplar_suffix(i):
+                # OpenMetrics exemplar: ` # {trace_id="..."} value ts` —
+                # the last sampled trace that landed in the bucket, so a
+                # bad tail bucket links straight to a trace to open.
+                # The retained value lies inside its bucket by
+                # construction (the OpenMetrics validity rule).
+                e = exemplars.get(str(i))
+                if not e:
+                    return ""
+                return (f' # {{trace_id="{_esc(str(e["trace_id"]))}"}} '
+                        f'{_fmt(e["value"])} {_fmt(round(e["ts"], 3))}')
+
             cum = 0
             for i in range(st["n_buckets"] + 2):
                 c = counts.get(str(i), 0)
@@ -295,8 +326,9 @@ def prometheus_text(snapshot: dict, prefix: str = "avenir") -> str:
                 if i <= st["n_buckets"]:
                     edge = bounds[i] if i < len(bounds) else bounds[-1]
                     out.append(f'{full}_bucket{{{lbl}le="{_fmt(edge)}"}} '
-                               f"{cum}")
-            out.append(f'{full}_bucket{{{lbl}le="+Inf"}} {st["n"]}')
+                               f"{cum}" + _exemplar_suffix(i))
+            out.append(f'{full}_bucket{{{lbl}le="+Inf"}} {st["n"]}'
+                       + _exemplar_suffix(st["n_buckets"] + 1))
             out.append(f"{full}_sum{{{labels}}} {_fmt(st['total'])}"
                        if labels else f"{full}_sum {_fmt(st['total'])}")
             out.append(f"{full}_count{{{labels}}} {st['n']}"

@@ -75,9 +75,9 @@ from collections import OrderedDict, deque
 from concurrent.futures import Future
 from typing import Callable, List, Optional
 
-from ..core import faultinject, telemetry
+from ..core import faultinject, flight, telemetry
 from ..core.metrics import Counters
-from ..core.obs import LatencyHistogram, get_tracer
+from ..core.obs import LatencyHistogram, TraceContext, get_tracer
 from .breaker import CircuitBreaker, CircuitOpenError
 
 SERVE_GROUP = "Serve"
@@ -175,14 +175,19 @@ class PoisonQuarantine:
 
 
 class _Request:
-    __slots__ = ("line", "future", "t_enqueue", "deadline")
+    __slots__ = ("line", "future", "t_enqueue", "deadline", "ctx")
 
-    def __init__(self, line: str, deadline_s: float = 0.0):
+    def __init__(self, line: str, deadline_s: float = 0.0,
+                 ctx: Optional[TraceContext] = None):
         self.line = line
         self.future: Future = Future()
         self.t_enqueue = time.perf_counter()
         # absolute drop-dead time on the same clock (0 = no deadline)
         self.deadline = (self.t_enqueue + deadline_s) if deadline_s else 0.0
+        # the wire request's causal trace context: travels WITH the
+        # request across the submit-thread -> worker-thread boundary so
+        # the worker's fan-in spans link back to the request's trace
+        self.ctx = ctx
 
 
 class MicroBatcher:
@@ -263,17 +268,19 @@ class MicroBatcher:
             f"quarantine"))
         return f
 
-    def submit(self, line: str) -> Future:
+    def submit(self, line: str,
+               ctx: Optional[TraceContext] = None) -> Future:
         """Enqueue one request line; the Future resolves to the output
         line (or raises).  Sheds with ShedError past the depth limit;
         fails fast with CircuitOpenError while the model's breaker is
         open; a quarantined poison row resolves immediately to
-        PoisonRowError without ever reaching the queue."""
+        PoisonRowError without ever reaching the queue.  ``ctx`` is the
+        wire request's trace context (rides the queue entry)."""
         self._admit()
         poisoned = self._quarantine_check(line)
         if poisoned is not None:
             return poisoned
-        req = _Request(line, self.deadline_s)
+        req = _Request(line, self.deadline_s, ctx)
         with self._cv:
             if self._closed:
                 raise RuntimeError(f"batcher {self.name} is closed")
@@ -288,14 +295,16 @@ class MicroBatcher:
         self.ensure_worker()
         return req.future
 
-    def submit_many(self, lines: List[str]):
+    def submit_many(self, lines: List[str],
+                    ctx: Optional[TraceContext] = None):
         """Enqueue a client-side batch under ONE lock round (the wire
         protocol's ``"rows": [...]`` shape): returns ``(futures, shed)``
         where rows past the queue-depth limit hold ``None`` and count
         into ``shed``.  One breaker admission guards the whole wire
         request (a half-open probe window admits client batches, not
         rows).  Amortizes the per-row lock/notify/liveness cost that
-        dominates the event-loop frontend's submit path under load."""
+        dominates the event-loop frontend's submit path under load.
+        All rows share the wire request's one trace context."""
         self._admit()
         futures: List[Optional[Future]] = []
         shed = 0
@@ -314,7 +323,7 @@ class MicroBatcher:
                     futures.append(None)
                     shed += 1
                     continue
-                req = _Request(line, self.deadline_s)
+                req = _Request(line, self.deadline_s, ctx)
                 self._q.append(req)
                 room -= 1
                 futures.append(req.future)
@@ -413,6 +422,15 @@ class MicroBatcher:
             # watchdog restart path takes over
             return
 
+    @staticmethod
+    def _batch_trace(batch: List[_Request]) -> Optional[str]:
+        """The first member's trace id (anomaly dumps name themselves by
+        the offending request)."""
+        for r in batch:
+            if r.ctx is not None:
+                return r.ctx.trace_id
+        return None
+
     def _run_loop(self) -> None:
         tracer = get_tracer()
         while True:
@@ -432,19 +450,43 @@ class MicroBatcher:
             if not batch:
                 continue
             oldest = min(r.t_enqueue for r in batch)
+            sampled = [r for r in batch
+                       if r.ctx is not None and r.ctx.sampled]
             for r in batch:
-                self.queue_wait_hist.record(t_drain - r.t_enqueue)
+                self.queue_wait_hist.record(
+                    t_drain - r.t_enqueue,
+                    trace_id=(r.ctx.trace_id
+                              if r.ctx is not None and r.ctx.sampled
+                              else None))
             if tracer.enabled:
                 # queue-wait span: the oldest request's time in queue
                 # (recorded retroactively from its enqueue stamp)
                 tracer.record_span(
                     "serve.queue.wait", int(oldest * 1e9),
                     int((t_drain - oldest) * 1e9), model=self.name)
+                # per-request queue-wait spans, parented to each sampled
+                # request's root so the trace shows ITS time in queue
+                for r in sampled:
+                    tracer.record_span(
+                        "serve.queue.wait", int(r.t_enqueue * 1e9),
+                        int((t_drain - r.t_enqueue) * 1e9), ctx=r.ctx,
+                        model=self.name)
                 tracer.gauge(f"serve.{self.name}.queue.depth", self.depth())
             self.counters.incr(SERVE_GROUP, "Requests", len(batch))
             self.counters.incr(SERVE_GROUP, "Batches")
             with tracer.span("serve.batch", model=self.name,
-                             batch=len(batch)):
+                             batch=len(batch)) as bspan:
+                # fan-in linking: the shared batch span carries its
+                # member requests' span ids (and joins the first
+                # member's trace so Perfetto renders it connected);
+                # each member's serve.score span below records this
+                # batch span's id — the two directions of the link
+                batch_span_id = getattr(bspan, "span_id", None)
+                if batch_span_id is not None and sampled:
+                    bspan.attrs["members"] = [r.ctx.span_id
+                                              for r in sampled]
+                    bspan.attrs.setdefault("trace",
+                                           sampled[0].ctx.trace_id)
                 poison: dict = {}
                 try:
                     with tracer.span("serve.score", model=self.name,
@@ -483,8 +525,18 @@ class MicroBatcher:
                         # monitor's windowed error rate diffs this
                         self.counters.incr(SERVE_GROUP, "Failed requests",
                                            len(batch))
+                        tripped = False
                         if self.breaker is not None:
-                            self.breaker.record_failure()
+                            tripped = self.breaker.record_failure(
+                                trace_id=self._batch_trace(batch))
+                        if not tripped:
+                            # a trip already dumped the black box inside
+                            # record_failure; otherwise the uncaught
+                            # scorer exception is the anomaly itself
+                            flight.trigger(
+                                "scorer_error", model=self.name,
+                                trace_id=self._batch_trace(batch),
+                                error=f"{type(e).__name__}: {e}")
                         for r in batch:
                             if not r.future.set_running_or_notify_cancel():
                                 continue
@@ -503,7 +555,17 @@ class MicroBatcher:
                                        len(poison))
                     if self.quarantine is not None:
                         for i in poison:
-                            self.quarantine.record(batch[i].line)
+                            n = self.quarantine.record(batch[i].line)
+                            if n == self.quarantine.threshold:
+                                # crossing INTO quarantine is the
+                                # anomaly (repeat offenses past it are
+                                # refused at submit and stay quiet)
+                                flight.trigger(
+                                    "poison_quarantine", model=self.name,
+                                    trace_id=(batch[i].ctx.trace_id
+                                              if batch[i].ctx is not None
+                                              else None),
+                                    offenses=n)
                 if self.breaker is not None and len(poison) < len(batch):
                     # at least one row actually scored — demonstrated
                     # health; an all-poison (singleton) batch proved
@@ -513,13 +575,28 @@ class MicroBatcher:
                 telemetry.sample_device_memory()
                 done = time.perf_counter()
                 for r in batch:
-                    self.e2e_hist.record(done - r.t_enqueue)
+                    self.e2e_hist.record(
+                        done - r.t_enqueue,
+                        trace_id=(r.ctx.trace_id
+                                  if r.ctx is not None and r.ctx.sampled
+                                  else None))
                 if tracer.enabled:
                     # end-to-end span: oldest enqueue -> results ready
                     tracer.record_span(
                         "serve.e2e", int(oldest * 1e9),
                         int((done - oldest) * 1e9), model=self.name,
                         batch=len(batch))
+                    # per-request score spans: each sampled member's
+                    # slice of the shared batch, stamped with the batch
+                    # span id (the member -> batch half of the fan-in
+                    # link)
+                    if batch_span_id is not None:
+                        for r in sampled:
+                            tracer.record_span(
+                                "serve.score", int(t_drain * 1e9),
+                                int((done - t_drain) * 1e9), ctx=r.ctx,
+                                model=self.name, batch=len(batch),
+                                batch_span=batch_span_id)
                 for i, (r, out) in enumerate(zip(batch, outputs)):
                     if not r.future.set_running_or_notify_cancel():
                         continue

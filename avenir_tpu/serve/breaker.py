@@ -29,6 +29,8 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..core import flight
+
 CLOSED = "closed"
 OPEN = "open"
 HALF_OPEN = "half_open"
@@ -108,7 +110,12 @@ class CircuitBreaker:
                 self._state = CLOSED
                 self._opened_at = None
 
-    def record_failure(self) -> None:
+    def record_failure(self, trace_id: Optional[str] = None) -> bool:
+        """Record one batch failure; returns True when THIS failure
+        tripped the breaker (closed/half-open -> open).  A trip is an
+        anomaly: the flight recorder dumps its ring, named by the
+        offending request's ``trace_id`` when the caller has one."""
+        tripped = False
         with self._lock:
             self._consecutive += 1
             if self._state == HALF_OPEN:
@@ -116,11 +123,18 @@ class CircuitBreaker:
                 self._state = OPEN
                 self._opened_at = self._clock()
                 self.trips += 1
+                tripped = True
             elif (self._state == CLOSED
                   and self._consecutive >= self.failure_threshold):
                 self._state = OPEN
                 self._opened_at = self._clock()
                 self.trips += 1
+                tripped = True
+        if tripped:
+            flight.trigger("breaker_trip", trace_id=trace_id,
+                           breaker=self.name,
+                           consecutive_failures=self.failure_threshold)
+        return tripped
 
     # -- soft degrade (the SLO monitor's signal) ---------------------------
     def set_soft_degraded(self, flag: bool,

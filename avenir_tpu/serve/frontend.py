@@ -82,7 +82,7 @@ class _Conn:
 
     __slots__ = ("sock", "cid", "rbuf", "wbuf", "seq_next", "send_next",
                  "ready", "inflight", "skimming", "closed", "paused",
-                 "want_write", "eof")
+                 "want_write", "eof", "meta")
 
     _next_cid = [0]
     _cid_lock = threading.Lock()
@@ -106,6 +106,11 @@ class _Conn:
         self.paused = False      # reads unregistered (backpressure)
         self.want_write = False
         self.eof = False         # client half-closed; finish then close
+        # seq slot -> the request's client-supplied request_id (returned
+        # synchronously by dispatch_line): drain-timeout fillers for
+        # slots whose callback never fires still echo the client's
+        # identity.  Bounded by the pipeline cap; popped on flush.
+        self.meta: Dict[int, object] = {}
 
     def idle(self) -> bool:
         return self.inflight == 0 and not self.wbuf and not self.ready
@@ -265,9 +270,11 @@ class _Shard(threading.Thread):
                 conn.seq_next += 1
                 conn.inflight += 1
                 cid = conn.cid
-                self.frontend.server.dispatch_line(
+                meta = self.frontend.server.dispatch_line(
                     text, lambda resp, cid=cid, seq=seq: self.complete(
-                        cid, seq, resp))
+                        cid, seq, resp), conn=cid)
+                if meta is not None and meta.get("request_id") is not None:
+                    conn.meta[seq] = meta["request_id"]
             # the pipeline cap applies to EVERY slot-allocating branch —
             # oversized-line errors parked behind a pending response
             # must pause reads too, or conn.ready grows unbounded
@@ -318,6 +325,7 @@ class _Shard(threading.Thread):
         flushed = False
         while conn.send_next in conn.ready:
             conn.wbuf += conn.ready.pop(conn.send_next)
+            conn.meta.pop(conn.send_next, None)
             conn.send_next += 1
             conn.inflight -= 1
             flushed = True
@@ -396,12 +404,17 @@ class _Shard(threading.Thread):
     def fail_pending(self, message: str) -> None:
         for conn in list(self._conns.values()):
             while conn.send_next + len(conn.ready) < conn.seq_next:
-                # fill the earliest missing slot with the drain error
+                # fill the earliest missing slot with the drain error —
+                # echoing the slot's request_id (captured at dispatch)
+                # so even an abandoned request stays correlatable
                 seq = conn.send_next
                 while seq in conn.ready:
                     seq += 1
-                self._apply(conn, seq, render_response(
-                    {"error": message, "timeout": True}))
+                err = {"error": message, "timeout": True}
+                rid = conn.meta.get(seq)
+                if rid is not None:
+                    err["request_id"] = rid
+                self._apply(conn, seq, render_response(err))
 
     def stop(self) -> None:
         self._stopping = True

@@ -147,12 +147,18 @@ class _SummedHist:
 def merged_hist_state(hists) -> dict:
     """One mergeable ``state_dict`` summing several LatencyHistograms
     that share one bound ladder (a variant group's replicas) — the form
-    the telemetry overlay ships per (model, variant)."""
+    the telemetry overlay ships per (model, variant).  Each histogram is
+    snapshotted ONCE (counts and exemplars from the same state), with
+    exemplars merged latest-timestamp-wins via the shared telemetry
+    rule."""
+    from ..core.telemetry import merge_exemplar_states
+
     hists = list(hists)
     out = hists[0].state_dict()
     counts = {int(i): c for i, c in out.get("counts", {}).items()}
     vmin = out.get("vmin")
     vmax = out.get("vmax")
+    ex = dict(out.get("exemplars") or {})
     for h in hists[1:]:
         s = h.state_dict()
         for i, c in s.get("counts", {}).items():
@@ -163,9 +169,14 @@ def merged_hist_state(hists) -> dict:
             vmin = s["vmin"] if vmin is None else min(vmin, s["vmin"])
         if s.get("vmax") is not None:
             vmax = s["vmax"] if vmax is None else max(vmax, s["vmax"])
+        ex = merge_exemplar_states(ex, s.get("exemplars"))
     out["counts"] = {str(i): c for i, c in sorted(counts.items())}
     out["vmin"] = vmin
     out["vmax"] = vmax
+    if ex:
+        out["exemplars"] = {i: ex[i] for i in sorted(ex)}
+    elif "exemplars" in out:
+        del out["exemplars"]
     return out
 
 
@@ -300,19 +311,21 @@ class VariantGroup:
         raise open_exc if open_exc is not None else ShedError(
             f"no replica of {self.model}@{self.variant} accepted")
 
-    def submit(self, line: str):
+    def submit(self, line: str, ctx=None):
         """Least-loaded dispatch of one request line; see
-        :meth:`_try_replicas` for the skip/retry policy."""
-        return self._try_replicas(lambda rep: rep.batcher.submit(line))
+        :meth:`_try_replicas` for the skip/retry policy.  ``ctx`` is the
+        wire request's trace context, carried into the queue entry."""
+        return self._try_replicas(
+            lambda rep: rep.batcher.submit(line, ctx=ctx))
 
-    def submit_many(self, lines):
+    def submit_many(self, lines, ctx=None):
         """One wire request's client-side batch to ONE replica (the
         least-loaded), under one lock round (`MicroBatcher.submit_many`)
         — splitting a batch across replicas would only shrink every
         micro-batch.  Returns ``(futures, shed)`` with ``None`` slots
         for shed rows (per-row sheds never raise here)."""
         return self._try_replicas(
-            lambda rep: rep.batcher.submit_many(lines))
+            lambda rep: rep.batcher.submit_many(lines, ctx=ctx))
 
     def section(self, slo_stats: Optional[dict] = None) -> dict:
         """The per-variant dict health/stats report."""

@@ -58,7 +58,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional
 
-from ..core import obs, telemetry
+from ..core import flight, obs, telemetry
 from ..core.config import JobConfig, load_job_config, parse_cli_args
 from .batcher import MicroBatcher, PoisonRowError, ShedError
 from .breaker import CircuitOpenError
@@ -177,11 +177,22 @@ class PredictionServer:
         self._watchdog_thread = self._start_watchdog(
             config.get_float("serve.watchdog.interval.sec", 0.5))
         telemetry.configure_from_config(config)
+        flight.configure_from_config(config)
         self.telemetry = telemetry.TelemetryExporter(
             config.get_float(telemetry.KEY_INTERVAL,
                              telemetry.DEFAULT_INTERVAL_SEC),
             jsonl_path=config.get(telemetry.KEY_JSONL_PATH),
-            providers=[self._telemetry_overlay]).start()
+            providers=[self._telemetry_overlay,
+                       self._flight_snapshot_provider]).start()
+
+    @staticmethod
+    def _flight_snapshot_provider() -> None:
+        """Rides the telemetry exporter's tick: the flight recorder's
+        ring gets its periodic metrics snapshot even when no errors are
+        flowing (the 'what did the system look like BEFORE' half of an
+        anomaly dump)."""
+        flight.get_recorder().maybe_snapshot()
+        return None
 
     # -- watchdog ----------------------------------------------------------
     def _start_watchdog(self, interval_s: float) -> Optional[threading.Thread]:
@@ -329,24 +340,89 @@ class PredictionServer:
             "is served")
 
     # -- request handling --------------------------------------------------
+    @staticmethod
+    def _begin_request(obj: dict):
+        """Parse one request's identity: the client's ``request_id``
+        (echoed verbatim on every response) and its
+        :class:`~avenir_tpu.core.obs.TraceContext` — client-supplied
+        ``trace_id`` propagated (and force-sampled), else generated and
+        head-sampled at ``obs.sample.rate``."""
+        rid = obj.get("request_id")
+        raw = obj.get("trace_id")
+        ctx = obs.new_trace_context(
+            raw if isinstance(raw, str) and raw else None)
+        return rid, ctx
+
+    def _finish_response(self, resp, rid, ctx, t0_ns: int,
+                         conn=None):
+        """The ONE response chokepoint: every response to a PARSED
+        request — success, structured error, shed, deadline, drain
+        timeout, poison — passes through here on both the sync
+        (``handle_line``) and async (``dispatch_line`` callback) paths.
+        It (a) echoes the client's ``request_id``, (b) echoes
+        ``trace_id`` when the request is sampled — error/shed/poison
+        responses are ALWAYS sampled retroactively (Dapper's
+        never-drop-the-interesting-ones rule), (c) retroactively records
+        the request's root ``serve.request`` span under its
+        pre-allocated span id, and (d) feeds error responses to the
+        flight recorder's wire-error ring.  The tier-2 lint
+        (tests/test_obs_coverage.py) asserts every response-construction
+        site in this module funnels here."""
+        if not isinstance(resp, dict) or "_text" in resp:
+            return resp         # raw-text exposition: no JSON identity
+        if rid is not None:
+            resp.setdefault("request_id", rid)
+        if ctx is None:
+            return resp
+        errorish = ("error" in resp or bool(resp.get("shed"))
+                    or bool(resp.get("poison"))
+                    or bool(resp.get("timeout")))
+        tracer = obs.get_tracer()
+        if errorish and tracer.enabled and not ctx.sampled:
+            ctx.sampled = True
+        if errorish or ctx.sampled:
+            resp.setdefault("trace_id", ctx.trace_id)
+        if ctx.sampled and tracer.enabled:
+            attrs = {"conn": conn} if conn is not None else {}
+            if resp.get("model") is not None:
+                attrs["model"] = resp["model"]
+            if errorish:
+                attrs["error"] = str(resp.get("error", ""))[:200]
+            tracer.record_span(
+                "serve.request", t0_ns,
+                time.perf_counter_ns() - t0_ns,
+                span_id=ctx.span_id, ctx=ctx, **attrs)
+        if errorish:
+            flight.record("wire.error", trace_id=ctx.trace_id,
+                          model=resp.get("model"),
+                          error=str(resp.get("error", ""))[:500],
+                          shed=bool(resp.get("shed")),
+                          poison=bool(resp.get("poison")),
+                          timeout=bool(resp.get("timeout")))
+        return resp
+
     def handle_line(self, line: str) -> dict:
         """Synchronous request path (embedded users, tests): parse,
         execute, and return the response dict, waiting on futures."""
-        with obs.get_tracer().span("serve.request"):
-            try:
-                obj = json.loads(line)
-            except json.JSONDecodeError as e:
-                return {"error": f"bad request JSON: {e}"}
-            if not isinstance(obj, dict):
-                return {"error": "request must be a JSON object"}
-            return self._handle_obj(obj)
+        t0 = time.perf_counter_ns()
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError as e:
+            # pre-parse failure: no request_id/trace to echo (lint
+            # exclusion — the identity was never readable)
+            return {"error": f"bad request JSON: {e}"}
+        if not isinstance(obj, dict):
+            return {"error": "request must be a JSON object"}
+        rid, ctx = self._begin_request(obj)
+        return self._finish_response(self._handle_obj(obj, ctx),
+                                     rid, ctx, t0)
 
-    def _handle_obj(self, obj: dict) -> dict:
+    def _handle_obj(self, obj: dict, ctx=None) -> dict:
         cmd = obj.get("cmd")
         try:
             if cmd is not None:
                 return self._command(cmd, obj)
-            return self._predict(obj)
+            return self._predict(obj, ctx)
         except (KeyError, ValueError) as e:
             return {"error": str(e)}
         except Exception as e:                      # noqa: BLE001
@@ -372,10 +448,12 @@ class PredictionServer:
         return {"error": f"unknown cmd {cmd!r}"}
 
     # -- predict: routing + submission (shared sync/async) -----------------
-    def _submit(self, obj: dict) -> object:
+    def _submit(self, obj: dict, ctx=None) -> object:
         """Validate, route, and submit one predict request's rows; returns
         a :class:`_Submission`, or a complete error-response dict for
-        malformed requests."""
+        malformed requests.  ``ctx`` (the request's trace context) rides
+        into the queue entries so the batcher worker can link its shared
+        batch span back to this request."""
         name = obj.get("model") or self._default_model()
         # version validation against the registry's adopted surface
         entry = self.registry.get(name, obj.get("version"))
@@ -398,10 +476,21 @@ class PredictionServer:
             # validate BEFORE submitting: one malformed entry must not
             # poison a shared micro-batch with other clients' requests
             return {"error": '"rows" must be a list of strings'}
+        tracer = obs.get_tracer()
+        traced = (ctx is not None and ctx.sampled and tracer.enabled)
         try:
-            group, decision = self.router.route(
-                name, slo_ms=float(slo_ms) if slo_ms is not None else None,
-                variant=pin)
+            if traced:
+                with tracer.span("serve.route", ctx=ctx, model=name):
+                    group, decision = self.router.route(
+                        name,
+                        slo_ms=float(slo_ms) if slo_ms is not None
+                        else None,
+                        variant=pin)
+            else:
+                group, decision = self.router.route(
+                    name,
+                    slo_ms=float(slo_ms) if slo_ms is not None else None,
+                    variant=pin)
         except SLOUnattainableError as e:
             return {"model": entry.name, "version": entry.version,
                     "error": str(e), "slo_unattainable": True}
@@ -411,7 +500,7 @@ class PredictionServer:
         last_err = "request failed"
         if single:
             try:
-                futures.append(group.submit(rows[0]))
+                futures.append(group.submit(rows[0], ctx=ctx))
             except ShedError:
                 futures.append(None)
                 shed += 1
@@ -426,7 +515,7 @@ class PredictionServer:
             # client-side batch: one replica, one lock round (and the
             # whole batch coalesces into that replica's micro-batches)
             try:
-                futures, shed = group.submit_many(rows)
+                futures, shed = group.submit_many(rows, ctx=ctx)
             except ShedError:
                 futures = [None] * len(rows)
                 shed = len(rows)
@@ -481,11 +570,11 @@ class PredictionServer:
             resp["poison"] = poisons
         return resp
 
-    def _predict(self, obj: dict) -> dict:
+    def _predict(self, obj: dict, ctx=None) -> dict:
         """Synchronous predict: submit, then WAIT on the futures (the
         embedded/handle_line path; the event-loop frontend uses
         ``_predict_async`` instead, which never blocks a thread)."""
-        sub = self._submit(obj)
+        sub = self._submit(obj, ctx)
         if isinstance(sub, dict):
             return sub
         t0 = time.perf_counter()
@@ -521,49 +610,59 @@ class PredictionServer:
                               poisons)
 
     # -- async dispatch (the event-loop frontend's entry) ------------------
-    def dispatch_line(self, line: str, cb: Callable[[dict], None]) -> None:
+    def dispatch_line(self, line: str, cb: Callable[[dict], None],
+                      conn=None) -> Optional[dict]:
         """Non-blocking request dispatch: ``cb(response)`` fires exactly
         once, on whatever thread resolves the request — immediately for
         malformed requests, on a command-executor thread for commands,
         and from the batcher workers' future callbacks for predictions.
-        NEVER blocks the calling (I/O shard) thread on a scorer."""
-        tracer = obs.get_tracer()
-        if tracer.enabled:
-            # the serve.request span, recorded retroactively at response
-            # time (no thread carries the request across the async hop)
-            t0 = time.perf_counter()
-            inner = cb
+        NEVER blocks the calling (I/O shard) thread on a scorer.
 
-            def cb(resp, _inner=inner, _t0=t0):
-                tracer.record_span(
-                    "serve.request", int(_t0 * 1e9),
-                    int((time.perf_counter() - _t0) * 1e9))
-                _inner(resp)
+        Returns the request's wire identity (``{"request_id": ...}``)
+        synchronously so the frontend can stamp drain-timeout fillers
+        for slots whose callback never fires; None when the line carried
+        no request_id (or never parsed)."""
+        t0 = time.perf_counter_ns()
         try:
             obj = json.loads(line)
         except json.JSONDecodeError as e:
+            # pre-parse failure: identity unreadable (lint exclusion)
             cb({"error": f"bad request JSON: {e}"})
-            return
+            return None
         if not isinstance(obj, dict):
             cb({"error": "request must be a JSON object"})
-            return
+            return None
+        rid, ctx = self._begin_request(obj)
+        inner = cb
+
+        def cb(resp, _inner=inner, _rid=rid, _ctx=ctx, _t0=t0,
+               _conn=conn):
+            # the response chokepoint rides the callback: the request's
+            # root serve.request span is recorded retroactively at
+            # response time (no thread carries the request across the
+            # async hop), identity echoed on every path
+            _inner(self._finish_response(resp, _rid, _ctx, _t0,
+                                         conn=_conn))
+
+        meta = {"request_id": rid} if rid is not None else None
         if obj.get("cmd") is not None:
             try:
-                self._cmd_pool.submit(lambda: cb(self._handle_obj(obj)))
+                self._cmd_pool.submit(
+                    lambda: cb(self._handle_obj(obj, ctx)))
             except RuntimeError:                     # executor shut down
                 cb({"error": "server shutting down"})
-            return
+            return meta
         try:
-            sub = self._submit(obj)
+            sub = self._submit(obj, ctx)
         except (KeyError, ValueError) as e:
             cb({"error": str(e)})
-            return
+            return meta
         except Exception as e:                      # noqa: BLE001
             cb({"error": f"{type(e).__name__}: {e}"})
-            return
+            return meta
         if isinstance(sub, dict):
             cb(sub)
-            return
+            return meta
         # the async path honors the same client-wait bound as the sync
         # one: a collector not finished by its deadline is force-timed
         # out by the reaper (a hung scorer whose worker thread is still
@@ -575,6 +674,7 @@ class PredictionServer:
         with self._inflight_lock:
             self._inflight.add(coll)
         coll.arm()
+        return meta
 
     def _reap_expired(self) -> None:
         """Time out every in-flight async request past its deadline
@@ -667,7 +767,8 @@ class PredictionServer:
                     "quarantine_size": q.size(),
                     "threshold": q.threshold}
         out = {"models": models, "obs": obs.get_tracer().stats(),
-               "slo": self.slo.section()}
+               "slo": self.slo.section(),
+               "flight": flight.get_recorder().stats()}
         if self._frontend is not None:
             out["frontend"] = {
                 "connections": self._frontend.connections(),
@@ -950,5 +1051,12 @@ def serve_main(argv) -> int:
             n = obs.get_tracer().export_chrome_trace(trace_path)
             print(f"obs: wrote {n} trace events to {trace_path} "
                   f"(open in chrome://tracing or ui.perfetto.dev)",
+                  file=sys.stderr)
+        # black-box flush: the SIGTERM/finally path leaves one final
+        # flight dump behind (flight.dump.dir configured), so even a
+        # killed serve still documents its last seconds
+        dump = flight.flush_on_exit()
+        if dump:
+            print(f"flight: wrote final black-box dump to {dump}",
                   file=sys.stderr)
     return 0

@@ -45,6 +45,7 @@ import time
 from collections import deque
 from typing import Dict, Optional
 
+from ..core import flight
 from ..core.obs import quantile_from_counts
 
 KEY_P99_MS = "serve.slo.p99.ms"
@@ -250,13 +251,19 @@ class SLOBoard:
         brk = batcher.breaker
         if brk is not None and mon.degrade_evals > 0:
             if stats["sustained"]:
-                brk.set_soft_degraded(
-                    True,
-                    f"SLO sustained violation: windowed "
-                    f"p99={stats['p99_ms']}ms "
-                    f"(target {mon.p99_ms or '-'}ms), "
-                    f"errors={stats['error_pct']}% "
-                    f"(target {mon.error_pct or '-'}%)")
+                reason = (f"SLO sustained violation: windowed "
+                          f"p99={stats['p99_ms']}ms "
+                          f"(target {mon.p99_ms or '-'}ms), "
+                          f"errors={stats['error_pct']}% "
+                          f"(target {mon.error_pct or '-'}%)")
+                was_degraded = brk.soft_degraded
+                brk.set_soft_degraded(True, reason)
+                if not was_degraded:
+                    # edge-triggered anomaly: the moment a variant goes
+                    # soft-degraded, dump the black box (re-evaluations
+                    # of an already-degraded window stay quiet)
+                    flight.trigger("slo_soft_degrade", monitor=name,
+                                   detail=reason)
             elif not stats["violation"]:
                 brk.set_soft_degraded(False)
         return stats

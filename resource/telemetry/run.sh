@@ -9,6 +9,10 @@
 #      slow scorer (scorer_slow@*) driving p99 past serve.slo.p99.ms
 #   4. drift gauges: append a shifted dataset and re-train against the
 #      stored baseline model (drift.<feature> gauges + Drift counters)
+#   5. causal tracing + flight recorder: send a trace-hinted request,
+#      trip the breaker with a fault-injected scorer, fetch the
+#      request's connected span chain from the Perfetto trace, and
+#      inspect the black-box flight dump the trip left behind
 set -euo pipefail
 cd "$(dirname "$0")"
 PY=${PYTHON:-python}
@@ -76,5 +80,48 @@ drift = {k.split(".", 1)[1]: round(v["value"], 4)
 print("drift gauges:", drift)
 assert drift["minUsed"] > 1.0, "shifted feature must show gross drift"
 assert drift["plan"] < 0.05, "untouched feature must stay near zero"
+EOF
+
+echo "=== 5. causal trace + flight recorder (traced request -> breaker trip -> black box) ==="
+DEMO_TRACE=deadbeefcafe0042
+$PY -m avenir_tpu serve -Dconf.path=serve.properties -Dserve.port=0 \
+    -Dserve.breaker.failures=1 -Dfault.inject.plan='scorer@4-9999x99' \
+    -Dflight.dump.dir=work/flight -Dflight.dump.min.interval.sec=600 \
+    --trace work/trace.json 2> work/server_trace.log &
+SERVER_PID=$!
+trap 'kill $SERVER_PID 2>/dev/null || true' EXIT
+$PY trace_demo.py work/server_trace.log work/test/part-00000 $DEMO_TRACE
+kill -INT $SERVER_PID; wait $SERVER_PID 2>/dev/null || true
+trap - EXIT
+$PY - work/trace.json work/flight $DEMO_TRACE <<'EOF'
+import json, os, sys
+trace_path, flight_dir, tid = sys.argv[1], sys.argv[2], sys.argv[3]
+# the Perfetto trace holds the hinted request's CONNECTED chain
+doc = json.load(open(trace_path))
+ev = [e for e in doc["traceEvents"]
+      if e.get("args", {}).get("trace") == tid]
+names = sorted({e["name"] for e in ev})
+print(f"trace events for {tid}: {names}")
+assert "serve.request" in names and "serve.route" in names
+assert "serve.score" in names, names
+root = next(e for e in ev if e["name"] == "serve.request")
+score = next(e for e in ev if e["name"] == "serve.score")
+batch_span = score["args"]["batch_span"]
+batch = next(e for e in doc["traceEvents"]
+             if e["name"] == "serve.batch"
+             and e["args"].get("id") == batch_span)
+assert root["args"]["id"] in batch["args"]["members"]
+print(f"fan-in link OK: request span {root['args']['id']} <-> "
+      f"batch span {batch_span} (members={batch['args']['members']})")
+# the breaker trip left its black box behind (+ the exit flush)
+dumps = sorted(os.listdir(flight_dir))
+print(f"flight dumps: {dumps}")
+assert any("breaker_trip" in d for d in dumps), dumps
+trip = next(d for d in dumps if "breaker_trip" in d)
+lines = [json.loads(l) for l in open(os.path.join(flight_dir, trip))]
+kinds = {l["kind"] for l in lines}
+print(f"dump {trip}: {len(lines)} records, kinds={sorted(kinds)}")
+assert lines[0]["kind"] == "flight.header"
+assert "metrics.snapshot" in kinds and "anomaly" in kinds
 EOF
 echo "telemetry runbook OK"

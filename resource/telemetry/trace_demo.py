@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Traced-request + flight-recorder demo client (runbook step 5):
+
+1. send a trace-hinted request (client-supplied ``trace_id`` +
+   ``request_id`` + an ``slo_ms`` routing hint) and check both ids echo
+   on the response — the causal-tracing wire contract;
+2. drive a few healthy requests, then keep going into the
+   fault-injection window until the scorer fails and the breaker trips
+   (``serve.breaker.failures=1``) — the anomaly that dumps the flight
+   recorder;
+3. confirm via ``stats`` that the flight recorder wrote a dump.
+
+The shell wrapper then SIGINTs the server (trace export + final flight
+flush) and verifies the Perfetto trace contains the hinted request's
+connected span chain and the dump names the offending trace.
+
+Usage: trace_demo.py <server.log> <test.csv> <trace_id>
+"""
+
+import json
+import re
+import socket
+import sys
+import time
+
+DEMO_TRACE = None
+
+
+def wait_for_port(log_path: str, timeout: float = 60.0):
+    deadline = time.time() + timeout
+    pat = re.compile(r"serving .* on ([\w.]+):(\d+)")
+    while time.time() < deadline:
+        try:
+            m = pat.search(open(log_path).read())
+        except OSError:
+            m = None
+        if m:
+            return m.group(1), int(m.group(2))
+        time.sleep(0.2)
+    raise SystemExit(f"server did not come up (see {log_path})")
+
+
+def request(host, port, obj):
+    with socket.create_connection((host, port), timeout=30) as sock:
+        sock.sendall((json.dumps(obj) + "\n").encode())
+        buf = b""
+        while not buf.endswith(b"\n"):
+            chunk = sock.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    return json.loads(buf.decode())
+
+
+def main():
+    log_path, test_csv, trace_id = sys.argv[1], sys.argv[2], sys.argv[3]
+    host, port = wait_for_port(log_path)
+    rows = [l.strip() for l in open(test_csv) if l.strip()]
+
+    # 1. the trace-hinted request: trace_id propagates (and forces the
+    # sampling decision), request_id echoes verbatim
+    resp = request(host, port, {"model": "churn", "row": rows[0],
+                                "request_id": "demo-1",
+                                "trace_id": trace_id, "slo_ms": 50})
+    print(f"traced request: request_id={resp.get('request_id')} "
+          f"trace_id={resp.get('trace_id')} output={'output' in resp}")
+    assert resp.get("request_id") == "demo-1", resp
+    assert resp.get("trace_id") == trace_id, resp
+    assert "output" in resp, resp
+
+    # 2. healthy traffic, then into the fault window until the breaker
+    # trips (every response still carries its request_id)
+    tripped = None
+    for i in range(40):
+        r = request(host, port, {"model": "churn",
+                                 "row": rows[(i + 1) % len(rows)],
+                                 "request_id": f"load-{i}"})
+        assert r.get("request_id") == f"load-{i}", r
+        if "error" in r:
+            tripped = r
+            break
+    assert tripped is not None, "fault plan never fired"
+    print(f"breaker tripped on request_id={tripped['request_id']}: "
+          f"{tripped['error'][:60]}... "
+          f"(trace_id={tripped.get('trace_id')})")
+    assert tripped.get("trace_id"), "errors must be force-sampled"
+
+    # 3. the flight recorder dumped the anomaly
+    time.sleep(0.2)
+    stats = request(host, port, {"cmd": "stats"})
+    fl = stats["flight"]
+    print(f"flight recorder: triggers={fl['triggers']} "
+          f"dumps={fl['dumps']} ring={fl['ring_records']} "
+          f"dir={fl['dump_dir']}")
+    assert fl["dumps"] >= 1, fl
+    print("trace demo OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -317,7 +317,7 @@ def test_metrics_command_full_exposition(nb_artifact):
         txt = request_text("127.0.0.1", port, {"cmd": "metrics"})
         types, samples = _parse_exposition(txt)
         by_name = {}
-        for name, labels, value in samples:
+        for name, labels, value, _ex in samples:
             by_name.setdefault(name, []).append((labels, value))
         # per-model latency histogram buckets
         fam = "avenir_serve_e2e_latency_seconds"

@@ -110,18 +110,41 @@ def test_hist_state_roundtrip():
 # Prometheus exposition: golden scraper-compatible parse
 # ---------------------------------------------------------------------------
 
+_NUM = r"-?(?:\d+\.?\d*(?:[eE][+-]?\d+)?|NaN|[+-]Inf)"
 _SAMPLE_RE = re.compile(
     r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
     r"(?:\{(?P<labels>[^}]*)\})? "
-    r"(?P<value>-?(?:\d+\.?\d*(?:[eE][+-]?\d+)?|NaN|[+-]Inf))$")
+    r"(?P<value>" + _NUM + r")"
+    # OpenMetrics exemplar: ` # {labels} value [timestamp]`
+    r"(?: # \{(?P<exlabels>[^}]*)\} (?P<exvalue>" + _NUM + r")"
+    r"(?: (?P<exts>" + _NUM + r"))?)?$")
 _LABEL_RE = re.compile(
     r'^[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"$')
 
 
+def _parse_labels(raw, line):
+    labels = {}
+    if raw:
+        for part in re.split(r",(?=[a-zA-Z_])", raw):
+            if not part:
+                continue
+            assert _LABEL_RE.match(part), f"bad label {part!r} in {line!r}"
+            k, _, v = part.partition("=")
+            labels[k] = v.strip('"')
+    return labels
+
+
+def _num(val):
+    return (float("nan") if val == "NaN" else
+            float("inf") if val == "+Inf" else float(val))
+
+
 def _parse_exposition(text):
-    """A strict scraper-grade parse of the Prometheus text format:
-    returns {family: type} and [(name, labels dict, value)].  Raises on
-    any line that a real scraper would reject."""
+    """A strict scraper-grade parse of the Prometheus/OpenMetrics text
+    format: returns {family: type} and [(name, labels dict, value,
+    exemplar-or-None)].  Raises on any line a real scraper would reject,
+    including OpenMetrics exemplar validity (exemplars only on
+    histogram ``_bucket`` lines, exemplar value inside the bucket)."""
     types, samples = {}, []
     lines = text.splitlines()
     assert lines[-1] == "# EOF"
@@ -140,18 +163,20 @@ def _parse_exposition(text):
         assert not line.startswith("#"), f"unknown comment: {line}"
         m = _SAMPLE_RE.match(line)
         assert m, f"unparseable sample line: {line!r}"
-        labels = {}
-        if m.group("labels"):
-            for part in re.split(r",(?=[a-zA-Z_])", m.group("labels")):
-                if not part:
-                    continue
-                assert _LABEL_RE.match(part), f"bad label {part!r} in {line!r}"
-                k, _, v = part.partition("=")
-                labels[k] = v.strip('"')
-        val = m.group("value")
-        samples.append((m.group("name"), labels,
-                        float("nan") if val == "NaN" else
-                        float("inf") if val == "+Inf" else float(val)))
+        labels = _parse_labels(m.group("labels"), line)
+        exemplar = None
+        if m.group("exlabels") is not None:
+            # exemplars are only legal on histogram bucket lines
+            assert m.group("name").endswith("_bucket"), line
+            exemplar = (_parse_labels(m.group("exlabels"), line),
+                        _num(m.group("exvalue")),
+                        _num(m.group("exts")) if m.group("exts") else None)
+            le = labels.get("le")
+            if le not in (None, "+Inf"):
+                assert exemplar[1] <= float(le), \
+                    f"exemplar value outside its bucket: {line!r}"
+        samples.append((m.group("name"), labels, _num(m.group("value")),
+                        exemplar))
     return types, samples
 
 
@@ -170,7 +195,7 @@ def test_prometheus_exposition_golden():
 
     types, samples = _parse_exposition(text)
     by_name = {}
-    for name, labels, value in samples:
+    for name, labels, value, _ex in samples:
         by_name.setdefault(name, []).append((labels, value))
 
     # counters
@@ -207,6 +232,47 @@ def test_prometheus_exposition_golden():
     assert types["avenir_span_count"] == "gauge"
     assert ({"name": "ingest.fold"}, 3.0) in by_name["avenir_span_count"]
     assert ({"name": "ingest.fold"}, 9.0) in by_name["avenir_span_ms"]
+
+
+def test_prometheus_exemplar_golden():
+    """OpenMetrics exemplar syntax on histogram bucket lines: the last
+    sampled trace per bucket rides the exposition as
+    `` # {trace_id="..."} value ts`` and parses under the scraper-grade
+    parser (which also enforces value-inside-bucket validity)."""
+    m = Metrics()
+    h = m.histogram('serve.e2e.latency{model="churn"}')
+    h.record(0.0015)                                  # unsampled
+    h.record(0.0016, trace_id="aaaa1111bbbb2222")     # sampled, same 1.5ms
+    h.record(0.8, trace_id="tail0000tail0000")        # sampled tail
+    h.record(500.0, trace_id="inf99999inf99999")      # overflow (+Inf)
+    text = telemetry.prometheus_text(m.mergeable_snapshot())
+
+    types, samples = _parse_exposition(text)
+    fam = "avenir_serve_e2e_latency_seconds"
+    assert types[fam] == "histogram"
+    buckets = [(labels, value, ex) for name, labels, value, ex in samples
+               if name == fam + "_bucket"]
+    with_ex = {ex[0]["trace_id"]: (labels, ex)
+               for labels, _v, ex in buckets if ex is not None}
+    assert set(with_ex) == {"aaaa1111bbbb2222", "tail0000tail0000",
+                            "inf99999inf99999"}
+    # the exemplar carries the exact recorded value + an epoch timestamp
+    _labels, (exl, exv, exts) = with_ex["tail0000tail0000"]
+    assert exv == pytest.approx(0.8)
+    assert exts is not None and exts > 1e9
+    # the overflow sample's exemplar rides the +Inf bucket line
+    inf_labels, _ = with_ex["inf99999inf99999"]
+    assert inf_labels["le"] == "+Inf"
+    # merged states keep exemplars (latest-ts-wins) through the
+    # snapshot merge used for multi-process aggregation
+    m2 = Metrics()
+    m2.histogram('serve.e2e.latency{model="churn"}').record(
+        0.0016, trace_id="newer000newer000")
+    merged = telemetry.merge_snapshots(m.mergeable_snapshot(),
+                                       m2.mergeable_snapshot())
+    text2 = telemetry.prometheus_text(merged)
+    assert 'trace_id="newer000newer000"' in text2
+    _parse_exposition(text2)
 
 
 # ---------------------------------------------------------------------------

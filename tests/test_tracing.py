@@ -1,0 +1,666 @@
+"""Causal request tracing + failure flight recorder + histogram
+exemplars (core/obs.py TraceContext, core/flight.py, serve wiring):
+
+- trace-context generation/propagation (hammer: unique ids under
+  threads; adopt-by-context joins a worker thread's spans to a trace)
+- the acceptance e2e: concurrent requests through a 2-REPLICA pool over
+  TCP yield connected traces whose shared ``serve.batch`` span links
+  >= 2 member requests across thread boundaries (and the export loads
+  as a Chrome/Perfetto trace)
+- wire identity: ``request_id`` echoed on every response path (success,
+  error, shed, drain-timeout, poison), ``trace_id`` echoed when sampled,
+  no cross-request bleed between pipelined requests on one connection
+- flight recorder: bounded ring, rate-limited atomic dumps, a
+  fault-injected breaker trip produces EXACTLY ONE dump naming the
+  offending trace_id with a pre-trip metrics snapshot, and a SIGTERM'd
+  serve subprocess still leaves its black box behind
+- histogram exemplars: per-bucket retention, merge semantics, p99 link
+"""
+
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from avenir_tpu.core import JobConfig, faultinject, flight, obs, telemetry
+from avenir_tpu.core.faultinject import FaultInjector, parse_plan
+from avenir_tpu.core.io import write_output
+from avenir_tpu.core.obs import LatencyHistogram, TraceContext
+from avenir_tpu.datagen import gen_telecom_churn
+from avenir_tpu.models.bayesian import BayesianDistribution
+from avenir_tpu.serve import PredictionServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHURN_SCHEMA = {"fields": [
+    {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+    {"name": "plan", "ordinal": 1, "dataType": "categorical",
+     "feature": True, "cardinality": ["planA", "planB"]},
+    {"name": "minUsed", "ordinal": 2, "dataType": "int", "feature": True,
+     "min": 0, "max": 2200, "bucketWidth": 200},
+    {"name": "dataUsed", "ordinal": 3, "dataType": "int", "feature": True,
+     "min": 0, "max": 1000, "bucketWidth": 100},
+    {"name": "csCall", "ordinal": 4, "dataType": "int", "feature": True,
+     "min": 0, "max": 14, "bucketWidth": 2},
+    {"name": "csEmail", "ordinal": 5, "dataType": "int", "feature": True,
+     "min": 0, "max": 22, "bucketWidth": 4},
+    {"name": "network", "ordinal": 6, "dataType": "int", "feature": True},
+    {"name": "churned", "ordinal": 7, "dataType": "categorical",
+     "cardinality": ["N", "Y"]},
+]}
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Every test leaves the global tracer, injector, and flight
+    recorder exactly as it found them."""
+    yield
+    faultinject.set_injector(None)
+    obs.configure(enabled=False, sample_rate=1.0)
+    obs.get_tracer().clear()
+    flight.set_recorder(flight.FlightRecorder())
+
+
+@pytest.fixture(scope="module")
+def artifacts(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("tracing_artifacts")
+    schema_path = tmp / "schema.json"
+    schema_path.write_text(json.dumps(CHURN_SCHEMA))
+    rows = gen_telecom_churn(400, seed=7)
+    write_output(str(tmp / "train"), [",".join(r) for r in rows[:320]])
+    BayesianDistribution(JobConfig(
+        {"feature.schema.file.path": str(schema_path)})).run(
+        str(tmp / "train"), str(tmp / "model"))
+    return {"dir": tmp, "schema": str(schema_path),
+            "model": str(tmp / "model"),
+            "rows": [",".join(r) for r in rows[320:]]}
+
+
+def _config(art, **overrides):
+    props = {
+        "serve.models": "churn",
+        "serve.model.churn.kind": "naiveBayes",
+        "serve.model.churn.feature.schema.file.path": art["schema"],
+        "serve.model.churn.bayesian.model.file.path": art["model"],
+        "serve.port": "0",
+        "serve.warmup": "false",
+        "telemetry.interval.sec": "0",
+        "serve.batch.max.delay.ms": "2",
+    }
+    props.update({k: str(v) for k, v in overrides.items()})
+    return JobConfig(props)
+
+
+# ---------------------------------------------------------------------------
+# trace context: generation + span mechanics
+# ---------------------------------------------------------------------------
+
+def test_trace_context_generation_hammer_unique_ids():
+    """No duplicate trace ids or span ids under concurrent generation
+    (the multi-threaded generation half of the propagation hammer)."""
+    obs.configure(enabled=True)
+    N_THREADS, PER = 16, 250
+    out = [[] for _ in range(N_THREADS)]
+
+    def mint(slot):
+        out[slot] = [obs.new_trace_context() for _ in range(PER)]
+
+    threads = [threading.Thread(target=mint, args=(i,))
+               for i in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    ctxs = [c for lane in out for c in lane]
+    assert len(ctxs) == N_THREADS * PER
+    assert len({c.trace_id for c in ctxs}) == len(ctxs)
+    assert len({c.span_id for c in ctxs}) == len(ctxs)
+    assert all(re.fullmatch(r"[0-9a-f]{16}", c.trace_id) for c in ctxs)
+
+
+def test_sampling_rate_and_client_propagation():
+    obs.configure(enabled=True, sample_rate=0.0)
+    # rate 0: generated contexts unsampled; client-supplied force-sample
+    assert not obs.new_trace_context().sampled
+    assert obs.new_trace_context(trace_id="deadbeefdeadbeef").sampled
+    obs.configure(sample_rate=1.0)
+    assert obs.new_trace_context().sampled
+    # disabled tracer: nothing samples
+    obs.configure(enabled=False)
+    assert not obs.new_trace_context().sampled
+    assert not obs.new_trace_context(trace_id="deadbeefdeadbeef").sampled
+
+
+def test_span_ctx_root_child_and_adopt_by_context():
+    """Root span under its pre-allocated id; children (same thread and
+    adopt-by-context worker thread) stamp the trace attr and parent
+    correctly."""
+    tr = obs.configure(enabled=True)
+    tr.clear()
+    ctx = obs.new_trace_context(sampled=True)
+    worker_done = threading.Event()
+
+    def worker():
+        tr.adopt(ctx)
+        with tr.span("w.child"):
+            pass
+        worker_done.set()
+
+    with tr.span("req.root", ctx=ctx, span_id=ctx.span_id):
+        assert tr.current_trace_id() == ctx.trace_id
+        with tr.span("req.child"):
+            pass
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert worker_done.is_set()
+    # the root stamped the context's own span id, and the thread-local
+    # trace restored after exit
+    assert tr.current_trace_id() is None
+    root = tr.spans("req.root")[0]
+    child = tr.spans("req.child")[0]
+    wchild = tr.spans("w.child")[0]
+    assert root.span_id == ctx.span_id
+    assert root.attrs["trace"] == ctx.trace_id
+    assert child.parent_id == root.span_id
+    assert child.attrs["trace"] == ctx.trace_id
+    # adopt-by-context: the worker's top-level span parents to the
+    # context root and joins the trace
+    assert wchild.parent_id == ctx.span_id
+    assert wchild.attrs["trace"] == ctx.trace_id
+
+
+def test_record_span_with_ctx_and_explicit_span_id():
+    tr = obs.configure(enabled=True)
+    tr.clear()
+    ctx = obs.new_trace_context(sampled=True)
+    t0 = time.perf_counter_ns()
+    tr.record_span("leaf", t0, 1000, ctx=ctx)
+    tr.record_span("root", t0, 5000, span_id=ctx.span_id, ctx=ctx)
+    leaf = tr.spans("leaf")[0]
+    root = tr.spans("root")[0]
+    assert leaf.parent_id == ctx.span_id
+    assert leaf.attrs["trace"] == ctx.trace_id
+    assert root.span_id == ctx.span_id and root.parent_id is None
+
+
+def test_prefetch_worker_spans_join_the_trace():
+    """The streaming-fold prefetch worker adopts (parent, trace): its
+    H2D spans carry the workflow trace id — the cross-thread half the
+    DAG/multiscan engines rely on."""
+    import numpy as np
+    from avenir_tpu.core import pipeline
+
+    tr = obs.configure(enabled=True)
+    tr.clear()
+
+    def local_fn(x, mask, n_bins):
+        import jax.numpy as jnp
+        return jnp.zeros((n_bins,), jnp.int32).at[
+            jnp.where(mask, x[:, 0], n_bins)].add(1, mode="drop")
+
+    chunks = [(np.full((4, 1), i, np.int32),) for i in range(4)]
+    ctx = obs.new_trace_context(sampled=True)
+    with tr.span("wf.root", ctx=ctx, span_id=ctx.span_id):
+        pipeline.streaming_fold(iter(chunks), local_fn, static_args=(8,),
+                                prefetch_depth=1)
+    h2d = tr.spans("ingest.h2d")
+    fold = tr.spans("ingest.fold")
+    assert h2d and fold
+    assert all(s.attrs.get("trace") == ctx.trace_id for s in h2d)
+    assert all(s.attrs.get("trace") == ctx.trace_id for s in fold)
+    # the worker really is another thread
+    root = tr.spans("wf.root")[0]
+    assert any(s.tid != root.tid for s in h2d)
+
+
+# ---------------------------------------------------------------------------
+# histogram exemplars
+# ---------------------------------------------------------------------------
+
+def test_histogram_exemplar_retention_and_merge():
+    h = LatencyHistogram()
+    h.record(0.001)                       # unsampled: no exemplar
+    h.record(0.0012, trace_id="aaaa")     # same bucket, sampled
+    h.record(0.5, trace_id="slow1")
+    assert len(h.exemplars) == 2
+    st = h.state_dict()
+    assert {e["trace_id"] for e in st["exemplars"].values()} == \
+        {"aaaa", "slow1"}
+    # roundtrip
+    h2 = LatencyHistogram.from_state(st)
+    assert h2.state_dict()["exemplars"] == st["exemplars"]
+    # merge: latest timestamp wins per bucket (identical values pin the
+    # two exemplars to one bucket)
+    other = LatencyHistogram()
+    other.record(0.0012, trace_id="bbbb")
+    time.sleep(0.002)
+    h.record(0.0012, trace_id="cccc")       # newer than "bbbb"
+    h.merge(other)
+    merged_traces = {e[0] for e in h.exemplars.values()}
+    assert "cccc" in merged_traces and "bbbb" not in merged_traces
+    # reset clears
+    h.reset()
+    assert h.exemplars == {} and "exemplars" not in h.state_dict()
+
+
+def test_histogram_p99_exemplar_links_tail_trace():
+    h = LatencyHistogram()
+    for _ in range(200):
+        h.record(0.001)
+    h.record(2.0, trace_id="tail-trace")
+    ex = h.exemplar_near(0.99)
+    assert ex is not None and ex["trace_id"] == "tail-trace"
+    snap = h.snapshot()
+    assert snap["p99_exemplar"]["trace_id"] == "tail-trace"
+
+
+def test_merged_hist_state_carries_exemplars():
+    from avenir_tpu.serve.pool import merged_hist_state
+
+    a, b = LatencyHistogram(), LatencyHistogram()
+    a.record(0.001, trace_id="ta")
+    time.sleep(0.002)
+    b.record(0.0011, trace_id="tb")       # same bucket, newer
+    b.record(1.0, trace_id="tslow")
+    st = merged_hist_state([a, b])
+    traces = {e["trace_id"] for e in st["exemplars"].values()}
+    assert traces == {"tb", "tslow"}
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: ring + dumps
+# ---------------------------------------------------------------------------
+
+def test_flight_ring_bounded_and_stats():
+    r = flight.FlightRecorder(ring_records=8, snapshot_interval_sec=0)
+    for i in range(50):
+        r.record("wire.error", i=i)
+    recs = r.records()
+    assert len(recs) == 8
+    assert recs[-1]["i"] == 49
+    assert r.stats()["ring_capacity"] == 8
+
+
+def test_flight_trigger_dump_rate_limit_and_force(tmp_path):
+    d = str(tmp_path / "dumps")
+    r = flight.FlightRecorder(dump_dir=d, min_interval_sec=600,
+                              snapshot_interval_sec=0)
+    r.record("wire.error", trace_id="t1", error="boom")
+    p1 = r.trigger("breaker_trip", trace_id="t1")
+    assert p1 and os.path.exists(p1)
+    # rate-limited: a second trigger inside the window writes nothing
+    assert r.trigger("breaker_trip", trace_id="t2") is None
+    assert r.stats()["suppressed"] == 1
+    # forced triggers (exit/fatal) bypass the limit
+    p2 = r.trigger("exit", force=True)
+    assert p2 and os.path.exists(p2)
+    assert len(os.listdir(d)) == 2
+    # dump content: header + metrics snapshot + ring records
+    lines = [json.loads(l) for l in open(p1)]
+    assert lines[0]["kind"] == "flight.header"
+    assert lines[0]["reason"] == "breaker_trip"
+    assert lines[0]["trace_id"] == "t1"
+    kinds = {l["kind"] for l in lines}
+    assert "metrics.snapshot" in kinds
+    assert any(l.get("kind") == "wire.error" and l.get("trace_id") == "t1"
+               for l in lines)
+    assert any(l.get("kind") == "anomaly" for l in lines)
+
+
+def test_flight_no_dump_dir_records_quietly(tmp_path):
+    r = flight.FlightRecorder(snapshot_interval_sec=0)
+    assert r.trigger("breaker_trip", trace_id="x") is None
+    assert r.stats()["triggers"] == 1
+    assert not list(tmp_path.iterdir())
+
+
+def test_torn_artifact_error_marks_flight_ring():
+    from avenir_tpu.core.io import TornArtifactError
+
+    rec = flight.set_recorder(flight.FlightRecorder(
+        snapshot_interval_sec=0))
+    TornArtifactError("torn: /some/path")
+    marks = [r for r in rec.records() if r["kind"] == "anomaly"
+             and r["reason"] == "torn_artifact"]
+    assert marks and "/some/path" in marks[0]["detail"]
+
+
+# ---------------------------------------------------------------------------
+# the acceptance e2e: connected trace across a 2-replica pool
+# ---------------------------------------------------------------------------
+
+def test_connected_trace_across_two_replica_pool(artifacts, tmp_path):
+    """Concurrent wire requests through a 2-replica pool yield connected
+    traces: the shared ``serve.batch`` span links >= 2 member requests
+    (fan-in across thread boundaries), each member's ``serve.score``
+    span names the batch span, every span of a request shares its
+    trace_id, and the export loads as a Chrome/Perfetto trace."""
+    tr = obs.configure(enabled=True, sample_rate=1.0)
+    tr.clear()
+    srv = PredictionServer(_config(artifacts, **{
+        "serve.pool.replicas": "2",
+        "serve.batch.max.size": "8",
+        "serve.batch.max.delay.ms": "400"}))
+    port = srv.start()
+    supplied = {f"{i:016x}": f"r{i}" for i in range(3)}
+    responses = {}
+
+    def one(tid, rid, row):
+        with socket.create_connection(("127.0.0.1", port),
+                                      timeout=30) as s:
+            s.sendall(json.dumps(
+                {"model": "churn", "row": row, "request_id": rid,
+                 "trace_id": tid}).encode() + b"\n")
+            responses[tid] = json.loads(s.makefile("rb").readline())
+
+    try:
+        threads = [threading.Thread(target=one,
+                                    args=(tid, rid, artifacts["rows"][i]))
+                   for i, (tid, rid) in enumerate(supplied.items())]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # captured before stop() tears the pool down; the batcher object
+        # (and its histogram) outlives close
+        hist = srv.pool.primary_batcher("churn").e2e_hist
+    finally:
+        srv.stop()
+
+    # every response echoes its identity (no cross-request bleed)
+    for tid, rid in supplied.items():
+        resp = responses[tid]
+        assert "output" in resp, resp
+        assert resp["request_id"] == rid
+        assert resp["trace_id"] == tid
+
+    spans = tr.spans()
+    roots = {s.attrs["trace"]: s for s in spans
+             if s.name == "serve.request" and "trace" in s.attrs}
+    assert set(roots) == set(supplied)
+    # fan-in: some shared batch span links >= 2 member requests, and the
+    # members really came from different submitting threads
+    batches = [s for s in spans if s.name == "serve.batch"
+               and len(s.attrs.get("members", [])) >= 2]
+    assert batches, "no micro-batch coalesced >= 2 concurrent requests"
+    linked = batches[0]
+    root_by_span_id = {s.span_id: s for s in roots.values()}
+    member_roots = [root_by_span_id[m] for m in linked.attrs["members"]]
+    assert len(member_roots) >= 2
+    # each member's per-request chain: route + queue-wait + score parent
+    # to ITS root; the score span names the batch span (the member ->
+    # batch half of the link)
+    for root in member_roots:
+        tid = root.attrs["trace"]
+        kids = {s.name: s for s in spans if s.parent_id == root.span_id}
+        assert "serve.route" in kids and "serve.queue.wait" in kids \
+            and "serve.score" in kids, sorted(kids)
+        assert all(s.attrs.get("trace") == tid for s in kids.values())
+        assert kids["serve.score"].attrs["batch_span"] == linked.span_id
+    # genuinely cross-thread: routing happened on an I/O shard thread,
+    # the shared batch on the replica's worker thread (the root span's
+    # own tid is whatever thread resolved the response, so the route
+    # span is the dispatch-side witness)
+    for root in member_roots:
+        route = next(s for s in spans if s.parent_id == root.span_id
+                     and s.name == "serve.route")
+        assert route.tid != linked.tid
+
+    # loadable as a Chrome/Perfetto trace carrying the linkage
+    out = str(tmp_path / "trace.json")
+    n = tr.export_chrome_trace(out)
+    doc = json.load(open(out))
+    assert n == len(doc["traceEvents"])
+    ev = [e for e in doc["traceEvents"]
+          if e.get("name") == "serve.batch"
+          and len(e.get("args", {}).get("members", [])) >= 2]
+    assert ev, "batch fan-in linkage missing from the exported trace"
+
+    # the e2e histogram retained exemplars linking to the traces, and
+    # the Prometheus exposition carries them in OpenMetrics syntax
+    ex_traces = {e[0] for e in hist.exemplars.values()}
+    assert ex_traces & set(supplied)
+    text = telemetry.prometheus_text(
+        {"hists": {'serve.e2e.latency{model="churn"}': hist.state_dict()},
+         "counters": {}, "gauges": {}})
+    ex_lines = [l for l in text.splitlines() if " # {trace_id=" in l]
+    assert ex_lines, text
+
+
+def test_pipelined_connection_identity_no_bleed(artifacts):
+    """Pipelined requests on ONE connection: responses come back in
+    order, each echoing ITS request_id/trace_id — no cross-request
+    context bleed."""
+    tr = obs.configure(enabled=True, sample_rate=1.0)
+    tr.clear()
+    srv = PredictionServer(_config(artifacts))
+    port = srv.start()
+    n = 12
+    try:
+        with socket.create_connection(("127.0.0.1", port),
+                                      timeout=30) as s:
+            s.sendall(b"".join(
+                json.dumps({"model": "churn",
+                            "row": artifacts["rows"][i % len(
+                                artifacts["rows"])],
+                            "request_id": f"req-{i}",
+                            "trace_id": f"{i:016x}"}).encode() + b"\n"
+                for i in range(n)))
+            f = s.makefile("rb")
+            for i in range(n):
+                resp = json.loads(f.readline())
+                assert resp["request_id"] == f"req-{i}", (i, resp)
+                assert resp["trace_id"] == f"{i:016x}", (i, resp)
+    finally:
+        srv.stop()
+
+
+def test_identity_echo_on_error_and_shed_paths(artifacts):
+    """request_id comes back on structured errors and shed responses;
+    errors force trace_id echo even when head sampling skipped them."""
+    obs.configure(enabled=True, sample_rate=0.0)   # nothing head-sampled
+    srv = PredictionServer(_config(artifacts, **{
+        "serve.queue.max.depth": "1",
+        "serve.batch.max.delay.ms": "1"}))
+    b = srv.batcher("churn")
+    release = threading.Event()
+    real = b.predict_fn
+    b.predict_fn = lambda lines: (release.wait(30), real(lines))[1]
+    got = []
+    try:
+        # structured error (unknown model): request_id + trace_id echoed
+        resp = srv.handle_line(json.dumps(
+            {"model": "nope", "row": "x", "request_id": "e1"}))
+        assert "error" in resp and resp["request_id"] == "e1"
+        assert "trace_id" in resp          # errors are always sampled
+        # wedge the scorer: A drains into the stuck batch, B fills the
+        # depth-1 queue, C sheds immediately with its identity echoed
+        srv.dispatch_line(json.dumps(
+            {"model": "churn", "row": artifacts["rows"][0],
+             "request_id": "a"}), got.append)
+        time.sleep(0.1)                    # worker drained A, now stuck
+        srv.dispatch_line(json.dumps(
+            {"model": "churn", "row": artifacts["rows"][0],
+             "request_id": "b"}), got.append)
+        shed = srv.handle_line(json.dumps(
+            {"model": "churn", "row": artifacts["rows"][0],
+             "request_id": "c"}))
+        assert shed.get("shed") is True
+        assert shed["request_id"] == "c"
+        assert "trace_id" in shed
+    finally:
+        release.set()
+        srv.stop()
+
+
+def test_drain_timeout_filler_echoes_request_id(artifacts):
+    """The frontend's drain-timeout filler — a response synthesized for
+    a slot whose callback never fired — still echoes the request_id
+    captured at dispatch time."""
+    srv = PredictionServer(_config(artifacts, **{
+        "serve.drain.timeout.sec": "0.2",
+        "serve.batch.max.delay.ms": "1"}))
+    port = srv.start()
+    b = srv.batcher("churn")
+    release = threading.Event()
+    real = b.predict_fn
+    b.predict_fn = lambda lines: (release.wait(30), real(lines))[1]
+    try:
+        with socket.create_connection(("127.0.0.1", port),
+                                      timeout=30) as s:
+            s.sendall(json.dumps(
+                {"model": "churn", "row": artifacts["rows"][1],
+                 "request_id": "drained-1"}).encode() + b"\n")
+            time.sleep(0.1)
+            stopper = threading.Thread(target=srv.stop)
+            stopper.start()
+            resp = json.loads(s.makefile("rb").readline())
+            assert resp.get("timeout") is True
+            assert resp.get("request_id") == "drained-1", resp
+            release.set()
+            stopper.join(timeout=30)
+    finally:
+        release.set()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# breaker trip -> exactly one flight dump with the offending trace
+# ---------------------------------------------------------------------------
+
+def test_breaker_trip_dumps_flight_recorder_once(artifacts, tmp_path):
+    dumps = str(tmp_path / "dumps")
+    faultinject.set_injector(FaultInjector(parse_plan("scorer@*")))
+    tr = obs.configure(enabled=True, sample_rate=1.0)
+    tr.clear()
+    srv = PredictionServer(_config(artifacts, **{
+        "serve.breaker.failures": "1",
+        "flight.dump.dir": dumps,
+        "flight.dump.min.interval.sec": "600",
+        "telemetry.interval.sec": "0.05"}))
+    offending = "feedfacefeedface"
+    try:
+        time.sleep(0.12)        # a pre-trip telemetry tick lands a
+        #                         metrics snapshot in the flight ring
+        resp = srv.handle_line(json.dumps(
+            {"model": "churn", "row": artifacts["rows"][0],
+             "request_id": "bad-1", "trace_id": offending}))
+        assert "error" in resp and resp["trace_id"] == offending
+        # more traffic while the breaker is open: fail-fast, NO new dump
+        for i in range(3):
+            srv.handle_line(json.dumps(
+                {"model": "churn", "row": artifacts["rows"][0]}))
+    finally:
+        srv.stop()
+    files = os.listdir(dumps)
+    assert len(files) == 1, files
+    assert "breaker_trip" in files[0] and offending in files[0]
+    lines = [json.loads(l) for l in open(os.path.join(dumps, files[0]))]
+    assert lines[0]["reason"] == "breaker_trip"
+    assert lines[0]["trace_id"] == offending
+    kinds = [l["kind"] for l in lines]
+    assert "metrics.snapshot" in kinds       # the pre-trip system state
+    assert any(l.get("reason") == "breaker_trip" for l in lines
+               if l["kind"] == "anomaly")
+
+
+def test_sigterm_serve_leaves_black_box_behind(artifacts, tmp_path):
+    """Kill a serve under an injected scorer fault: the process still
+    leaves its flight dumps (trip + exit flush) and exits cleanly
+    through the drain path."""
+    dumps = tmp_path / "dumps"
+    props = tmp_path / "serve.properties"
+    props.write_text("".join(f"{k}={v}\n" for k, v in {
+        "serve.models": "churn",
+        "serve.model.churn.kind": "naiveBayes",
+        "serve.model.churn.feature.schema.file.path": artifacts["schema"],
+        "serve.model.churn.bayesian.model.file.path": artifacts["model"],
+        "serve.port": "0",
+        "serve.warmup": "false",
+        "serve.breaker.failures": "1",
+        "serve.batch.max.delay.ms": "1",
+        "fault.inject.plan": "scorer@*",
+        "flight.dump.dir": str(dumps),
+        "flight.dump.min.interval.sec": "600",
+    }.items()))
+    env = dict(os.environ)
+    env["AVENIR_PLATFORM"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    log = open(tmp_path / "server.log", "wb")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "avenir_tpu", "serve",
+         f"-Dconf.path={props}"],
+        stdout=log, stderr=log, env=env)
+    try:
+        port = None
+        deadline = time.time() + 120
+        pat = re.compile(rb"serving .* on [\w.]+:(\d+)")
+        while time.time() < deadline and port is None:
+            m = pat.search(open(tmp_path / "server.log", "rb").read())
+            if m:
+                port = int(m.group(1))
+            else:
+                assert proc.poll() is None, \
+                    open(tmp_path / "server.log").read()[-2000:]
+                time.sleep(0.2)
+        assert port is not None, "server never came up"
+        with socket.create_connection(("127.0.0.1", port),
+                                      timeout=30) as s:
+            s.sendall(json.dumps(
+                {"model": "churn", "row": artifacts["rows"][0],
+                 "request_id": "kill-1"}).encode() + b"\n")
+            resp = json.loads(s.makefile("rb").readline())
+            assert "error" in resp and resp["request_id"] == "kill-1"
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+        log.close()
+    names = sorted(os.listdir(dumps))
+    assert any("breaker_trip" in n for n in names), names
+    assert any(n.startswith("flight-exit-") for n in names), names
+
+
+# ---------------------------------------------------------------------------
+# workflow traces: dag/multiscan root contexts
+# ---------------------------------------------------------------------------
+
+def test_multiscan_scan_roots_a_workflow_trace(tmp_path):
+    """A standalone ``multi`` run roots its own trace context: the scan
+    span and the per-job fold/encode spans (prefetch-worker threads
+    included) all stamp one trace id."""
+    from avenir_tpu.cli import _job_resolver
+    from avenir_tpu.core.multiscan import run_multi
+
+    schema = tmp_path / "schema.json"
+    schema.write_text(json.dumps(CHURN_SCHEMA))
+    rows = gen_telecom_churn(300, seed=5)
+    write_output(str(tmp_path / "in"), [",".join(r) for r in rows])
+    cfg = JobConfig({
+        "multi.jobs": "nb",
+        "multi.job.nb.class": "BayesianDistribution",
+        "multi.job.nb.output.path": str(tmp_path / "nb"),
+        "feature.schema.file.path": str(schema),
+        "pipeline.chunk.rows": "128",
+    })
+    tr = obs.configure(enabled=True, sample_rate=1.0)
+    tr.clear()
+    run_multi(cfg, str(tmp_path / "in"), None, _job_resolver)
+    scan = tr.spans("multiscan.scan")
+    assert scan and "trace" in scan[0].attrs
+    tid = scan[0].attrs["trace"]
+    encodes = tr.spans("multiscan.encode")
+    folds = tr.spans("multiscan.fold")
+    assert encodes and folds
+    assert all(s.attrs.get("trace") == tid for s in encodes)
+    assert all(s.attrs.get("trace") == tid for s in folds)
