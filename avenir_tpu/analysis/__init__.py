@@ -1,0 +1,48 @@
+"""avenir-analyze: the unified static-analysis engine.
+
+The repo's worst historical bugs were exactly the class static analysis
+catches — the unlocked ``Counters.incr`` RMW (PR 3), the unlocked
+``utils/caches.py`` (PR 2), the prefetch worker-death deadlock (PR 5) —
+and four tier-2 coverage modules had each grown an ad-hoc AST walker to
+keep one cross-cutting rule checked.  This package promotes that pattern
+to a first-class subsystem, in the spirit of Engler et al., *"Bugs as
+Deviant Behavior"* (SOSP 2001: infer the codebase's own invariants and
+flag deviations) and Savage et al., *"Eraser"* (TOCS 1997: lockset
+discipline — here checked statically, with a runtime lock-order
+sanitizer twin in :mod:`avenir_tpu.core.sanitizer`).
+
+Shape:
+
+- **one parse per source file** — :class:`~.engine.Corpus` parses every
+  package module once and shares the trees across all rules;
+- **a rule registry** — every check registers under a stable rule id and
+  returns structured :class:`~.engine.Finding` s (rule id, ``file:line``,
+  message, fix hint);
+- **exclusion registries that require a written reason and fail on
+  stale entries** — the ``NON_RETRYABLE`` / ``NON_ATOMIC_WRITES`` /
+  ``NON_FUSABLE`` / ``NON_DAG_STAGES`` pattern, generalized by
+  :class:`~.registries.ExclusionRegistry` and extended with
+  ``SHARED_UNLOCKED`` (lock discipline), ``HOST_SYNC_ALLOWED`` (JAX
+  hot-path hygiene) and ``UNMANAGED_THREADS`` (thread lifecycle);
+- **a CLI** — ``python -m avenir_tpu analyze [--strict] [--json p]``
+  (see :mod:`~.cli`), run as one tier-1 test so the whole rule catalog
+  gates every PR.
+
+The four legacy coverage modules (``tests/test_*_coverage.py``) are thin
+shims over this engine: same test names, same violations caught.
+"""
+
+from .engine import (Corpus, Finding, Rule, RULES, all_rule_ids,
+                     load_package_corpus, run_rules)
+from .registries import ExclusionRegistry
+
+# importing the rule modules registers every rule with the engine
+from . import rules_io          # noqa: F401  (io-retry, io-atomic-write)
+from . import rules_config      # noqa: F401  (config-keys)
+from . import rules_drivers     # noqa: F401  (driver-* / foldspec-*)
+from . import rules_serve       # noqa: F401  (flight-anomaly, wire-identity)
+from . import rules_concurrency  # noqa: F401  (lock-discipline, thread-*)
+from . import rules_jax         # noqa: F401  (jax-hot-path, jax-bare-jit)
+
+__all__ = ["Corpus", "Finding", "Rule", "RULES", "ExclusionRegistry",
+           "all_rule_ids", "load_package_corpus", "run_rules"]

@@ -1,0 +1,92 @@
+"""``python -m avenir_tpu analyze``: run the rule catalog over the
+package.
+
+Usage::
+
+    python -m avenir_tpu analyze [--strict] [--json report.json]
+                                 [--rules id1,id2] [--list]
+
+- default: print findings as text lines (``rule  file:line  message``)
+  plus a one-line summary; exit 0 regardless of findings.
+- ``--strict``: exit 1 when any unexcluded finding (including stale
+  exclusions / empty reasons) survives — the CI gate.
+- ``--json <path>``: also write the machine-readable findings report
+  (atomic publish, the CI artifact).
+- ``--rules a,b``: run a subset of the catalog.
+- ``--list``: print the rule catalog (id, scope, doc) and exit.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+from .engine import (RULES, all_rule_ids, load_package_corpus, run_rules,
+                     write_json_report)
+
+
+def analyze_main(argv: List[str]) -> int:
+    strict = False
+    json_out: Optional[str] = None
+    rule_ids = None
+    list_rules = False
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--strict":
+            strict = True
+        elif a == "--list":
+            list_rules = True
+        elif a == "--json" or a.startswith("--json="):
+            if "=" in a:
+                json_out = a.partition("=")[2]
+            else:
+                i += 1
+                if i >= len(argv):
+                    print("--json requires a path", file=sys.stderr)
+                    return 2
+                json_out = argv[i]
+            if not json_out:
+                print("--json requires a path", file=sys.stderr)
+                return 2
+        elif a == "--rules" or a.startswith("--rules="):
+            if "=" in a:
+                spec = a.partition("=")[2]
+            else:
+                i += 1
+                if i >= len(argv):
+                    print("--rules requires a comma-separated list",
+                          file=sys.stderr)
+                    return 2
+                spec = argv[i]
+            rule_ids = [r.strip() for r in spec.split(",") if r.strip()]
+        else:
+            print(f"unknown analyze option: {a}", file=sys.stderr)
+            return 2
+        i += 1
+
+    if list_rules:
+        for rid in all_rule_ids():
+            r = RULES[rid]
+            print(f"{rid:18s} [{r.scope}] {r.doc}")
+        return 0
+
+    corpus = load_package_corpus()
+    try:
+        findings, report = run_rules(corpus, rule_ids=rule_ids)
+    except KeyError as exc:
+        print(f"analyze: {exc.args[0]}", file=sys.stderr)
+        return 2
+    for f in findings:
+        print(f.format())
+    ran = len(report["rules"])
+    print(f"analyze: {len(findings)} finding(s) from {ran} rule(s) over "
+          f"{report['files']} file(s) in {report['duration_ms']:.0f} ms",
+          file=sys.stderr)
+    if json_out:
+        write_json_report(json_out, report)
+        print(f"analyze: wrote JSON report to {json_out}",
+              file=sys.stderr)
+    if strict and findings:
+        return 1
+    return 0

@@ -1,0 +1,252 @@
+"""The analysis engine: shared-parse corpus, rule registry, findings.
+
+Every rule used to re-walk the package with its own ``os.walk`` +
+``ast.parse`` loop (four coverage test modules, ~900 lines); here the
+package is parsed ONCE into a :class:`Corpus` and every registered rule
+checks the shared trees.  Rules return structured :class:`Finding` s so
+one CLI (``python -m avenir_tpu analyze``) and one tier-1 test can run
+the whole catalog with text or JSON output.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+
+class SourceFile:
+    """One parsed package module (parse happens once, in Corpus)."""
+
+    __slots__ = ("rel", "path", "text", "tree")
+
+    def __init__(self, rel: str, path: str, text: str, tree: ast.AST):
+        self.rel = rel          # package-relative, e.g. "core/io.py"
+        self.path = path
+        self.text = text
+        self.tree = tree
+
+
+class Corpus:
+    """Every ``.py`` under one root, parsed once and shared by all
+    rules.  ``readme`` is the documentation surface the config-key rule
+    checks (None = no README check)."""
+
+    def __init__(self, root: str, readme_path: Optional[str] = None):
+        self.root = root
+        self.readme_path = readme_path
+        self.files: Dict[str, SourceFile] = {}
+        self._readme: Optional[str] = None
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__")
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, root).replace(os.sep, "/")
+                with open(path) as fh:
+                    text = fh.read()
+                self.files[rel] = SourceFile(
+                    rel, path, text, ast.parse(text, filename=path))
+
+    @property
+    def readme(self) -> str:
+        if self._readme is None:
+            if self.readme_path and os.path.exists(self.readme_path):
+                with open(self.readme_path) as fh:
+                    self._readme = fh.read()
+            else:
+                self._readme = ""
+        return self._readme
+
+    def get(self, rel: str) -> Optional[SourceFile]:
+        return self.files.get(rel)
+
+    def items(self):
+        return sorted(self.files.items())
+
+
+class Finding:
+    """One structured rule violation.
+
+    ``tag`` subdivides a rule's findings: ``violation`` (the rule's own
+    check), ``stale-exclusion`` (a registry entry whose site no longer
+    exists or no longer violates), ``empty-reason`` (a registry entry
+    without a written reason).  All three fail ``--strict``."""
+
+    __slots__ = ("rule", "file", "line", "message", "hint", "tag")
+
+    def __init__(self, rule: str, file: str, line: int, message: str,
+                 hint: str = "", tag: str = "violation"):
+        self.rule = rule
+        self.file = file
+        self.line = int(line)
+        self.message = message
+        self.hint = hint
+        self.tag = tag
+
+    def format(self) -> str:
+        loc = f"{self.file}:{self.line}" if self.line else self.file
+        s = f"{self.rule}  {loc}  {self.message}"
+        if self.hint:
+            s += f"  [fix: {self.hint}]"
+        return s
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "file": self.file, "line": self.line,
+                "message": self.message, "hint": self.hint,
+                "tag": self.tag}
+
+    def __repr__(self):
+        return f"Finding({self.format()!r})"
+
+
+class Rule:
+    """One registered check: ``fn(corpus) -> [Finding]``.
+
+    ``scope`` is ``"source"`` for pure-AST rules (they run on any
+    corpus, including test fixtures) or ``"project"`` for rules that
+    import the real package (driver registry introspection) and only
+    make sense against the installed ``avenir_tpu``."""
+
+    __slots__ = ("id", "doc", "fn", "scope")
+
+    def __init__(self, rule_id: str, doc: str,
+                 fn: Callable[[Corpus], List[Finding]],
+                 scope: str = "source"):
+        if scope not in ("source", "project"):
+            raise ValueError(f"bad rule scope: {scope!r}")
+        self.id = rule_id
+        self.doc = doc
+        self.fn = fn
+        self.scope = scope
+
+    def check(self, corpus: Corpus) -> List[Finding]:
+        return self.fn(corpus)
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def rule(rule_id: str, doc: str, scope: str = "source"):
+    """Decorator registering ``fn(corpus) -> [Finding]`` under a stable
+    rule id (the id findings, exclusions, and ``--rules`` name)."""
+    def deco(fn):
+        if rule_id in RULES:
+            raise ValueError(f"duplicate rule id: {rule_id}")
+        RULES[rule_id] = Rule(rule_id, doc, fn, scope)
+        return fn
+    return deco
+
+
+def all_rule_ids() -> List[str]:
+    return sorted(RULES)
+
+
+_PACKAGE_CORPUS: Optional[Corpus] = None
+
+
+def load_package_corpus(fresh: bool = False) -> Corpus:
+    """The corpus every default run analyzes: the installed
+    ``avenir_tpu`` package, with the repo README as the doc surface.
+    Cached per process (one parse feeds the CLI, the tier-1 wrapper,
+    and every coverage shim); ``fresh=True`` re-parses."""
+    global _PACKAGE_CORPUS
+    if _PACKAGE_CORPUS is None or fresh:
+        import avenir_tpu
+        pkg = os.path.dirname(os.path.abspath(avenir_tpu.__file__))
+        _PACKAGE_CORPUS = Corpus(pkg, readme_path=os.path.join(
+            os.path.dirname(pkg), "README.md"))
+    return _PACKAGE_CORPUS
+
+
+def run_rules(corpus: Corpus,
+              rule_ids: Optional[Sequence[str]] = None,
+              scopes: Sequence[str] = ("source", "project")):
+    """Run the selected rules over one shared corpus.
+
+    Returns ``(findings, report)`` where ``report`` is the JSON-ready
+    run summary (per-rule finding counts and durations)."""
+    if rule_ids is None:
+        selected = [RULES[r] for r in all_rule_ids()
+                    if RULES[r].scope in scopes]
+    else:
+        unknown = sorted(set(rule_ids) - set(RULES))
+        if unknown:
+            raise KeyError(
+                f"unknown rule id(s): {unknown}; known: {all_rule_ids()}")
+        selected = [RULES[r] for r in rule_ids]
+    findings: List[Finding] = []
+    per_rule = []
+    t0 = time.monotonic()
+    for r in selected:
+        rt0 = time.monotonic()
+        got = r.check(corpus)
+        findings.extend(got)
+        per_rule.append({"rule": r.id, "findings": len(got),
+                         "ms": round((time.monotonic() - rt0) * 1e3, 2)})
+    findings.sort(key=lambda f: (f.rule, f.file, f.line))
+    report = {"root": corpus.root,
+              "files": len(corpus.files),
+              "rules": per_rule,
+              "findings": [f.to_dict() for f in findings],
+              "total_findings": len(findings),
+              "duration_ms": round((time.monotonic() - t0) * 1e3, 2)}
+    return findings, report
+
+
+def write_json_report(path: str, report: dict) -> None:
+    """Atomic JSON findings report (the CI artifact)."""
+    from ..core.io import atomic_write_text
+    atomic_write_text(path, json.dumps(report, indent=2) + "\n")
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers (used by several rule modules)
+# ---------------------------------------------------------------------------
+
+class ScopedVisitor(ast.NodeVisitor):
+    """NodeVisitor tracking the enclosing class/function qualname stack
+    (the ``Class.method`` / ``func.<locals>`` naming the legacy walkers
+    used)."""
+
+    def __init__(self):
+        self.stack: List[str] = []
+
+    def qual(self) -> str:
+        return ".".join(self.stack) if self.stack else "<module>"
+
+    def visit_ClassDef(self, node):
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def enclosing_scope_source(text: str, lineno: int, tree=None) -> str:
+    """Source of the innermost function/class whose body spans
+    ``lineno`` (1-based) — the scope a required call must live in.
+    Pass the SourceFile's already-parsed ``tree`` to honor the
+    one-parse-per-file contract; the re-parse is a fallback for raw
+    text."""
+    if tree is None:
+        tree = ast.parse(text)
+    best = None
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            if node.lineno <= lineno <= (node.end_lineno or node.lineno):
+                if best is None or node.lineno > best.lineno:
+                    best = node
+    if best is None:
+        return text
+    return "\n".join(text.splitlines()[best.lineno - 1:best.end_lineno])

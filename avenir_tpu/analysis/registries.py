@@ -1,0 +1,115 @@
+"""Exclusion registries: deliberate rule opt-outs with written reasons.
+
+Generalizes the ``NON_RETRYABLE`` / ``NON_ATOMIC_WRITES`` /
+``NON_FUSABLE`` / ``NON_DAG_STAGES`` convention the repo already trusts:
+an exclusion is a dict entry ``site-key -> reason``, and the engine
+turns registry hygiene into findings —
+
+- an entry with an empty reason is an ``empty-reason`` finding;
+- an entry whose key no longer names a live candidate violation is a
+  ``stale-exclusion`` finding (the site was removed or fixed: drop the
+  entry so the registry never rots into a list of historical lies).
+
+The concurrency/JAX registries live here; the four legacy registries
+stay in their owning core modules (their import paths are load-bearing
+for the tier-2 shims) and are wrapped by the same class at rule-run
+time.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List
+
+from .engine import Finding
+
+
+class ExclusionRegistry:
+    """One rule's exclusion dict plus the hygiene checks.
+
+    ``entries`` maps a site key (rule-defined grammar, typically
+    ``module.py:Qual.name``) to a non-empty written reason."""
+
+    def __init__(self, rule_id: str, name: str, entries: Dict[str, str]):
+        self.rule_id = rule_id
+        self.name = name
+        self.entries = entries
+
+    def excuses(self, key: str) -> bool:
+        return key in self.entries
+
+    def hygiene_findings(self, candidates: Iterable[str],
+                         file_of=None) -> List[Finding]:
+        """Findings for empty reasons and stale entries.  ``candidates``
+        is every site key that WOULD violate the rule absent exclusions;
+        an entry not among them is stale.  ``file_of`` optionally maps a
+        key to a file for the finding location (defaults to the key's
+        ``module:`` prefix when it has one)."""
+        cand = set(candidates)
+        out: List[Finding] = []
+        for key, reason in sorted(self.entries.items()):
+            where = (file_of(key) if file_of
+                     else (key.split(":", 1)[0] if ":" in key else ""))
+            if not (reason and str(reason).strip()):
+                out.append(Finding(
+                    self.rule_id, where or self.name, 0,
+                    f"{self.name} entry {key!r} has no written reason",
+                    hint="every exclusion documents WHY it is safe",
+                    tag="empty-reason"))
+                continue
+            if key not in cand:
+                out.append(Finding(
+                    self.rule_id, where or self.name, 0,
+                    f"stale {self.name} entry {key!r}: no such violating "
+                    f"site exists anymore",
+                    hint="the site was removed or fixed — drop the entry",
+                    tag="stale-exclusion"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# the concurrency / JAX registries (new with avenir-analyze)
+# ---------------------------------------------------------------------------
+
+#: lock-discipline opt-outs: ``module.py:Class.attr`` (or
+#: ``module.py:<module>.global``) -> why the unlocked mutation is safe.
+SHARED_UNLOCKED: Dict[str, str] = {
+    "serve/frontend.py:_Shard._posted":
+        "single-consumer work queue: producers only append, the shard "
+        "loop thread only popleft()s, and collections.deque append/"
+        "popleft are atomic under the GIL; the wake pipe provides the "
+        "ordering edge — an intentional lock-free handoff",
+    "serve/frontend.py:_Shard._conns":
+        "every mutation runs on the shard's own loop thread: adopt() "
+        "is called directly only from shard 0's acceptor loop (same "
+        "thread) and otherwise marshaled via post(); _close runs "
+        "inside run() — single-threaded by construction, asserted by "
+        "the frontend hammer tests",
+}
+
+#: JAX hot-path host-sync opt-outs: ``module.py:Qual:callname`` -> why
+#: this deliberate host sync belongs on the hot path.
+HOST_SYNC_ALLOWED: Dict[str, str] = {
+    "core/pipeline.py:HostStager._buffer:block_until_ready":
+        "the copy-proof reuse gate: a staging buffer may only be "
+        "reused after the device array that aliased it retires — the "
+        "sync IS the correctness mechanism, and it fires only when a "
+        "slot is re-requested while its put is still in flight",
+    "core/pipeline.py:ChunkTransfer.__call__:np.asarray":
+        "host-side dtype/layout normalization of the encoder's output "
+        "BEFORE the H2D put — the operands are host arrays already, so "
+        "no device sync occurs",
+    "core/pipeline.py:ChunkFold.__init__:np.asarray":
+        "one-time broadcast-argument upload at scan construction "
+        "(host constants -> device); not in the per-chunk loop",
+    "core/pipeline.py:ChunkFold.seed:np.asarray":
+        "one-time carry seeding at scan start / checkpoint resume "
+        "(host snapshot -> device); not in the per-chunk loop",
+    "core/pipeline.py:ChunkFold.block:block_until_ready":
+        "the explicit end-of-scan / checkpoint barrier: callers invoke "
+        "block() exactly when the design WANTS a device sync (async "
+        "checkpoint materialization one chunk later — PR 5)",
+}
+
+#: thread-lifecycle opt-outs: ``module.py:Qual`` (the scope creating the
+#: Thread) -> why the thread needs neither a daemon flag nor a join.
+UNMANAGED_THREADS: Dict[str, str] = {}

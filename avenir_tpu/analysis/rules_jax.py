@@ -1,0 +1,161 @@
+"""JAX hot-path hygiene rules.
+
+**jax-hot-path** — no host syncs inside the registered fold/score hot
+paths.  The streaming-fold engine's whole design is that the per-chunk
+loop never blocks on the device (prefetch overlap, donated carries); a
+``.block_until_ready()`` / ``np.asarray(...)`` / ``.item()`` / device
+``float(...)`` dropped into one of the :data:`HOT_PATHS` scopes
+serializes host and device and silently erases the 1.58× overlap.
+Deliberate syncs (the copy-proof staging check, the carry
+materialization at checkpoint boundaries) sit on
+``registries.HOST_SYNC_ALLOWED`` with a written reason, so every hot
+host sync in the tree is documented.
+
+**jax-bare-jit** — no bare ``jax.jit`` on serving/pipeline paths.
+Every compile on those paths must ride ``telemetry.profiled_jit`` so
+XLA compile time is billed to the ``Telemetry/xla.compile.ms`` counter
+and warmup regressions stay visible; a bare ``jax.jit`` bypasses
+compile billing.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from .engine import Corpus, Finding, ScopedVisitor, rule
+from . import registries
+from .registries import ExclusionRegistry
+
+#: the registered fold/score hot paths: ``module.py`` -> qualname
+#: prefixes whose scopes form the per-chunk / per-batch loop.  A scope
+#: matches when its qualname equals a prefix or extends it
+#: (``prefix.<nested>``).
+HOT_PATHS: Dict[str, Tuple[str, ...]] = {
+    "core/pipeline.py": ("ChunkTransfer", "ChunkFold", "HostStager",
+                         "drive_prefetched", "_prefetch_worker"),
+    "core/multiscan.py": ("MultiScanEngine._run_scan", "ChunkContext"),
+    "serve/engine.py": ("NaiveBayesAdapter.score_batch",
+                        "MarkovAdapter.score_batch"),
+    "serve/batcher.py": ("MicroBatcher._run_loop",
+                         "MicroBatcher._score_lines",
+                         "MicroBatcher._isolate"),
+}
+
+#: host-sync call shapes flagged inside hot paths
+_SYNC_ATTRS = {"block_until_ready", "item"}
+
+#: modules where a bare ``jax.jit`` bypasses profiled_jit compile
+#: billing (the serving + pipeline compile surfaces)
+BARE_JIT_MODULES = ("serve/", "core/pipeline.py", "core/multiscan.py")
+
+
+def _in_hot_path(rel: str, qual: str,
+                 hot_paths: Dict[str, Tuple[str, ...]]) -> bool:
+    prefixes = hot_paths.get(rel)
+    if not prefixes:
+        return False
+    return any(qual == p or qual.startswith(p + ".") for p in prefixes)
+
+
+class _SyncScan(ScopedVisitor):
+    def __init__(self):
+        super().__init__()
+        self.sites: List[Tuple[str, int, str]] = []   # (qual, line, call)
+
+    def visit_Call(self, node):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _SYNC_ATTRS:
+            self.sites.append((self.qual(), node.lineno, fn.attr))
+        elif (isinstance(fn, ast.Attribute)
+              and fn.attr in ("asarray", "array")
+              and isinstance(fn.value, ast.Name)
+              and fn.value.id == "np"):
+            self.sites.append((self.qual(), node.lineno,
+                               f"np.{fn.attr}"))
+        elif isinstance(fn, ast.Name) and fn.id == "float":
+            # float(<device value>) — conservatively flagged on calls
+            # whose argument is an attribute/subscript (not a literal)
+            if node.args and not isinstance(node.args[0], ast.Constant):
+                self.sites.append((self.qual(), node.lineno, "float"))
+        self.generic_visit(node)
+
+
+def jax_hot_path_findings(corpus: Corpus, hot_paths=None,
+                          exclusions=None) -> List[Finding]:
+    hp = HOT_PATHS if hot_paths is None else hot_paths
+    reg = ExclusionRegistry(
+        "jax-hot-path", "HOST_SYNC_ALLOWED",
+        registries.HOST_SYNC_ALLOWED if exclusions is None
+        else exclusions)
+    out: List[Finding] = []
+    candidates: List[str] = []
+    for rel, sf in corpus.items():
+        if rel not in hp:
+            continue
+        scan = _SyncScan()
+        scan.visit(sf.tree)
+        for qual, line, call in scan.sites:
+            if not _in_hot_path(rel, qual, hp):
+                continue
+            key = f"{rel}:{qual}:{call}"
+            candidates.append(key)
+            if reg.excuses(key):
+                continue
+            out.append(Finding(
+                "jax-hot-path", rel, line,
+                f"host sync {call}() inside registered hot path {qual}",
+                hint="keep the per-chunk/per-batch loop async (device "
+                     "syncs serialize the prefetch overlap), or add "
+                     f"{key!r} to analysis.registries.HOST_SYNC_ALLOWED "
+                     "with a reason"))
+    out.extend(reg.hygiene_findings(candidates))
+    return out
+
+
+@rule("jax-hot-path",
+      "no undocumented host syncs (block_until_ready/np.asarray/.item()/"
+      "float) inside registered fold/score hot paths")
+def _jax_hot_path(corpus: Corpus) -> List[Finding]:
+    return jax_hot_path_findings(corpus)
+
+
+class _JitScan(ScopedVisitor):
+    def __init__(self):
+        super().__init__()
+        self.sites: List[Tuple[str, int]] = []
+
+    def visit_Call(self, node):
+        fn = node.func
+        if (isinstance(fn, ast.Attribute) and fn.attr == "jit"
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "jax"):
+            self.sites.append((self.qual(), node.lineno))
+        self.generic_visit(node)
+
+
+def jax_bare_jit_findings(corpus: Corpus,
+                          modules=BARE_JIT_MODULES) -> List[Finding]:
+    out: List[Finding] = []
+    for rel, sf in corpus.items():
+        if not (rel.startswith(tuple(m for m in modules
+                                     if m.endswith("/")))
+                or rel in modules):
+            continue
+        scan = _JitScan()
+        scan.visit(sf.tree)
+        for qual, line in scan.sites:
+            out.append(Finding(
+                "jax-bare-jit", rel, line,
+                f"bare jax.jit in {qual} on a serving/pipeline path "
+                f"bypasses profiled_jit compile billing",
+                hint="wrap with core.telemetry.profiled_jit so XLA "
+                     "compiles bill to Telemetry/xla.compile.ms"))
+    return out
+
+
+@rule("jax-bare-jit",
+      "no bare jax.jit on serving/pipeline paths (profiled_jit bills "
+      "every compile)")
+def _jax_bare_jit(corpus: Corpus) -> List[Finding]:
+    return jax_bare_jit_findings(corpus)

@@ -151,9 +151,14 @@ def extract_resume_flag(argv):
 def configure_resilience(config) -> None:
     """Apply the resilience-layer config surfaces (retry policy + fault
     injection plan + the io durability strict mode + the flight
-    recorder's dump surface) — called by every CLI entry point next to
-    the obs configure."""
-    from .core import faultinject, flight, io, resilience
+    recorder's dump surface + the lock sanitizer) — called by every CLI
+    entry point next to the obs configure, BEFORE any engine/server
+    construction so ``sanitize.locks=true`` catches every lock."""
+    from .core import faultinject, flight, io, resilience, sanitizer
+    # sanitizer FIRST: the other configure calls construct lock-bearing
+    # singletons (RetryPolicy, FaultInjector), and locks built before
+    # enablement stay plain/untracked
+    sanitizer.configure_from_config(config)
     resilience.configure_from_config(config)
     faultinject.configure_from_config(config)
     io.configure_from_config(config)
@@ -300,10 +305,17 @@ def main(argv=None) -> int:
               file=sys.stderr)
         print("       python -m avenir_tpu serve -Dconf.path=<serve.properties>",
               file=sys.stderr)
+        print("       python -m avenir_tpu analyze [--strict] [--json report.json] [--rules a,b] [--list]",
+              file=sys.stderr)
         print("known jobs:\n  " + "\n  ".join(sorted(JOBS)), file=sys.stderr)
         return 2
 
     job_name, rest = argv[0], argv[1:]
+    if job_name == "analyze":
+        # static-analysis engine (avenir-analyze): the rule catalog over
+        # the whole package, text or JSON findings, --strict CI gate
+        from .analysis.cli import analyze_main
+        return analyze_main(rest)
     if job_name == "multi":
         # shared-scan job fusion (core.multiscan): one streamed ingest
         # pass feeding every job named by the multi.* manifest

@@ -88,6 +88,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from . import sanitizer
+
 DEFAULT_SEED = 2026
 
 KEY_PLAN = "fault.inject.plan"
@@ -206,7 +208,7 @@ class FaultInjector:
     def __init__(self, plan: List[_Entry], seed: int = DEFAULT_SEED):
         self.plan = plan
         self.seed = int(seed)
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("core.faultinject")
         self._auto: Dict[str, int] = {}
         self._fired: Dict[Tuple[int, int], int] = {}
         self.fired_log: List[Tuple[str, int]] = []

@@ -49,7 +49,7 @@ import time
 from collections import deque
 from typing import Optional
 
-from . import obs
+from . import obs, sanitizer
 
 KEY_DUMP_DIR = "flight.dump.dir"
 KEY_MIN_INTERVAL = "flight.dump.min.interval.sec"
@@ -76,7 +76,7 @@ class FlightRecorder:
                  min_interval_sec: float = DEFAULT_MIN_INTERVAL_SEC,
                  snapshot_interval_sec: float = DEFAULT_SNAPSHOT_INTERVAL_SEC):
         self._ring: deque = deque(maxlen=max(int(ring_records), 1))
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("core.flight")
         self.dump_dir = dump_dir
         self.min_interval = float(min_interval_sec)
         self.snapshot_interval = float(snapshot_interval_sec)
@@ -235,10 +235,23 @@ def set_recorder(recorder: FlightRecorder) -> FlightRecorder:
     return recorder
 
 
+def sanitize_lock() -> None:
+    """Re-wrap the global recorder's lock through the sanitizer.  The
+    recorder is a module-import-time singleton, so its lock predates
+    any ``sanitize.locks=true`` enablement; called at configure time
+    (before worker threads exist) it brings the anomaly paths — which
+    run while other tracked locks are held — into the order graph."""
+    r = _GLOBAL_RECORDER
+    if sanitizer.enabled() and not isinstance(r._lock,
+                                              sanitizer.TrackedLock):
+        r._lock = sanitizer.make_lock("core.flight")
+
+
 def configure_from_config(config) -> FlightRecorder:
     """Apply the ``flight.*`` properties surface to the global recorder
     (called by every CLI entry point next to the obs configure)."""
     r = _GLOBAL_RECORDER
+    sanitize_lock()
     r.dump_dir = config.get(KEY_DUMP_DIR) or None
     r.min_interval = config.get_float(KEY_MIN_INTERVAL,
                                       DEFAULT_MIN_INTERVAL_SEC)

@@ -18,6 +18,8 @@ import threading
 from collections import defaultdict
 from typing import Dict, Iterator, Tuple
 
+from . import sanitizer
+
 
 class Counters:
     """Grouped named counters; the metrics dict every job returns.
@@ -31,7 +33,7 @@ class Counters:
 
     def __init__(self):
         self._groups: Dict[str, Dict[str, int]] = defaultdict(lambda: defaultdict(int))
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("core.counters")
 
     def incr(self, group: str, name: str, amount: int = 1) -> None:
         with self._lock:

@@ -45,6 +45,7 @@ import threading
 import time
 from typing import Callable, Dict, Optional, Tuple, Type
 
+from . import sanitizer
 from .faultinject import InjectedFault
 from .metrics import Counters
 from .obs import get_tracer
@@ -89,7 +90,7 @@ class RetryPolicy:
         self.jitter = float(jitter)
         self.retryable = tuple(retryable)
         self._rng = random.Random(seed)
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("core.retry")
 
     @classmethod
     def from_config(cls, config) -> "RetryPolicy":
@@ -290,7 +291,7 @@ class RowQuarantine:
         self.budget = val
         self.seen = 0
         self.quarantined = 0
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("core.rowquarantine")
         self._opened = False
 
     @classmethod

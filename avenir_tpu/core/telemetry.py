@@ -69,7 +69,7 @@ import threading
 import time
 from typing import Callable, Dict, Iterable, Mapping, Optional
 
-from . import obs
+from . import obs, sanitizer
 from .obs import Metrics
 
 KEY_INTERVAL = "telemetry.interval.sec"
@@ -515,7 +515,7 @@ class TelemetryExporter:
         self.providers = list(providers)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("telemetry.exporter")
         self.ticks = 0
 
     # -- snapshotting ------------------------------------------------------
@@ -548,7 +548,12 @@ class TelemetryExporter:
             with self._lock:
                 with open(self.jsonl_path, "a") as fh:
                     fh.write(line)
-        self.ticks += 1
+        # under the same lock as the file append: tick() is called by
+        # the exporter thread AND by stop()/manual callers, and an
+        # unlocked += is exactly the RMW race the lock-discipline rule
+        # (avenir-analyze) flags
+        with self._lock:
+            self.ticks += 1
         return snap
 
     # -- lifecycle ---------------------------------------------------------
@@ -613,6 +618,10 @@ class TraceFlusher:
         self.dropped = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # one flush at a time: the flusher thread and a manual caller
+        # (or the exit path racing a slow tick) would otherwise
+        # interleave _since/dropped updates and duplicate records
+        self._lock = sanitizer.make_lock("telemetry.flusher")
 
     def _rotate(self) -> None:
         for i in range(self.keep - 1, 0, -1):
@@ -624,17 +633,20 @@ class TraceFlusher:
 
     def flush(self) -> int:
         """Append records not yet flushed; returns how many were written."""
-        recs, self._since, dropped = self.tracer.records_since(self._since)
-        self.dropped += dropped
-        if not recs:
-            return 0
-        if (os.path.exists(self.path)
-                and os.path.getsize(self.path) >= self.max_bytes):
-            self._rotate()
-        with open(self.path, "a") as fh:
-            for r in recs:
-                fh.write(json.dumps(self.tracer.record_dict(r)) + "\n")
-        return len(recs)
+        with self._lock:
+            recs, self._since, dropped = self.tracer.records_since(
+                self._since)
+            self.dropped += dropped
+            if not recs:
+                return 0
+            if (os.path.exists(self.path)
+                    and os.path.getsize(self.path) >= self.max_bytes):
+                self._rotate()
+            with open(self.path, "a") as fh:
+                for r in recs:
+                    fh.write(json.dumps(self.tracer.record_dict(r))
+                             + "\n")
+            return len(recs)
 
     def start(self) -> "TraceFlusher":
         if self.interval <= 0 or self._thread is not None:

@@ -75,7 +75,7 @@ from collections import OrderedDict, deque
 from concurrent.futures import Future
 from typing import Callable, List, Optional
 
-from ..core import faultinject, flight, telemetry
+from ..core import faultinject, flight, sanitizer, telemetry
 from ..core.metrics import Counters
 from ..core.obs import LatencyHistogram, TraceContext, get_tracer
 from .breaker import CircuitBreaker, CircuitOpenError
@@ -118,7 +118,7 @@ class PoisonQuarantine:
         self.threshold = max(1, int(threshold))
         self.cap = max(1, int(cap))
         self._counts: "OrderedDict[str, int]" = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("serve.poison.quarantine")
 
     @classmethod
     def from_config(cls, config) -> Optional["PoisonQuarantine"]:
@@ -222,7 +222,7 @@ class MicroBatcher:
         self.deadline_s = max(0.0, float(deadline_ms)) / 1000.0
         self.breaker = breaker
         self._q: deque = deque()
-        self._cv = threading.Condition()
+        self._cv = sanitizer.make_condition("serve.batcher.cv")
         self._closed = False
         # did the previous batch fail in its entirety?  Breaks the
         # poison-vs-systemic tie for failed SINGLETON batches: one

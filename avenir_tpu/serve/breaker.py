@@ -29,7 +29,7 @@ import threading
 import time
 from typing import Callable, Optional
 
-from ..core import flight
+from ..core import flight, sanitizer
 
 CLOSED = "closed"
 OPEN = "open"
@@ -63,7 +63,7 @@ class CircuitBreaker:
         self.reset_sec = float(reset_sec)
         self.probe_requests = max(int(probe_requests), 1)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("serve.breaker")
         self._state = CLOSED
         self._consecutive = 0
         self._opened_at: Optional[float] = None

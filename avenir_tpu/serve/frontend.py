@@ -55,6 +55,8 @@ import threading
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
+from ..core import sanitizer
+
 KEY_IO_THREADS = "serve.frontend.threads"
 KEY_BACKLOG = "serve.frontend.backlog"
 KEY_PIPELINE_MAX = "serve.frontend.pipeline.max"
@@ -127,7 +129,7 @@ class _Shard(threading.Thread):
         self.sel = selectors.DefaultSelector()
         self._wake_r, self._wake_w = socket.socketpair()
         self._wake_r.setblocking(False)
-        self._wake_lock = threading.Lock()
+        self._wake_lock = sanitizer.make_lock("serve.frontend.wake")
         self._woken = False
         self.sel.register(self._wake_r, selectors.EVENT_READ, "wake")
         self._posted: deque = deque()

@@ -39,6 +39,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, List, Optional
 
+from ..core import sanitizer
 from ..core.metrics import Counters
 from .batcher import (KEY_POISON_ISOLATE, MicroBatcher, PoisonQuarantine,
                       ShedError)
@@ -218,7 +219,7 @@ class VariantGroup:
         self.slo_key = slo_key if slo_key is not None else model
         self.latency_class = replicas[0].entry.latency_class
         self.accuracy_class = replicas[0].entry.accuracy_class
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("serve.pool.group")
         self._slo_degraded = False
         self._slo_reason: Optional[str] = None
         self.stats_facade = _GroupStats(self)
@@ -353,7 +354,7 @@ class ScorerPool:
         self.registry = registry
         self.batch_kw = dict(batch_kw)
         self.warmup = warmup
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("serve.pool")
         # model -> variant (declared cost order) -> group
         self.groups: Dict[str, Dict[str, VariantGroup]] = {}
         # poison-batch isolation (serve.poison.*; batcher.py): one
@@ -380,7 +381,11 @@ class ScorerPool:
             entry.name, predict_fn, entry.counters,
             breaker=CircuitBreaker.from_config(self.config, entry.name),
             fault_tag=tag, poison_isolate=self.poison_isolate,
-            quarantine=self.quarantines.get(entry.name), **self.batch_kw)
+            # through the locked helper, not an unlocked map read: a
+            # dynamic-registration caller racing a reload still hands
+            # every replica the model's ONE shared quarantine
+            quarantine=self._ensure_quarantine(entry.name),
+            **self.batch_kw)
 
     def _build_replica(self, name: str, variant: str, index: int, device,
                        counters: Optional[Counters] = None) -> Replica:
@@ -397,10 +402,23 @@ class ScorerPool:
             entry, index, _pin(entry.adapter.predict_lines, device))
         return Replica(name, variant, index, device, entry, batcher)
 
+    def _ensure_quarantine(self, name: str) -> Optional[PoisonQuarantine]:
+        """The model's shared poison quarantine, created at most once.
+        Today _load_model only runs from single-threaded construction,
+        but the quarantine map is read from reload/command threads —
+        mutate it under the pool lock so a future dynamic-registration
+        caller (ROADMAP item 3) cannot introduce the race silently."""
+        if not self.poison_isolate:
+            return None
+        with self._lock:
+            q = self.quarantines.get(name)
+            if q is None:
+                q = self.quarantines[name] = PoisonQuarantine.from_config(
+                    self.config)
+            return q
+
     def _load_model(self, name: str) -> None:
-        if self.poison_isolate and name not in self.quarantines:
-            self.quarantines[name] = PoisonQuarantine.from_config(
-                self.config)
+        self._ensure_quarantine(name)
         variants = self.registry.variant_names(name)
         n = _resolve_replicas(self.config, name)
         devices = _devices_for(n)

@@ -41,6 +41,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from ..core import sanitizer
 from ..core.config import JobConfig, parse_properties
 from ..core.io import TornArtifactError
 from ..core.metrics import Counters
@@ -81,7 +82,7 @@ class ModelRegistry:
         self.warmup_buckets = (
             sorted({pow2_bucket(int(v)) for v in buckets.split(",")})
             if buckets else pow2_buckets(self.max_batch))
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("serve.registry")
         self._entries: Dict[Tuple[str, str], ModelEntry] = {}
         self._latest: Dict[str, str] = {}
 

@@ -42,6 +42,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from ..core import sanitizer
 from .pool import ScorerPool, VariantGroup
 
 KEY_DEFAULT_SLO_MS = "serve.router.default.slo.ms"
@@ -63,7 +64,7 @@ class VariantRouter:
         self.slo = slo_board
         self.default_slo_ms = config.get_float(KEY_DEFAULT_SLO_MS, 0.0)
         self.strict = config.get_boolean(KEY_STRICT, False)
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("serve.router")
         # model -> counts (the stats/telemetry surface)
         self._routed: Dict[Tuple[str, str], int] = {}
         self._demotions: Dict[str, int] = {}

@@ -58,7 +58,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional
 
-from ..core import flight, obs, telemetry
+from ..core import flight, obs, sanitizer, telemetry
 from ..core.config import JobConfig, load_job_config, parse_cli_args
 from .batcher import MicroBatcher, PoisonRowError, ShedError
 from .breaker import CircuitOpenError
@@ -150,14 +150,14 @@ class PredictionServer:
             max_queue_depth=config.get_int("serve.queue.max.depth", 256),
             hist_buckets=obs.histogram_buckets_from_config(config),
             deadline_ms=config.get_float("serve.request.deadline.ms", 0.0))
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("serve.server")
         self._frontend: Optional[EventLoopFrontend] = None
         self._stopped = False
         self._stop_watchdog = threading.Event()
         # in-flight async collectors, reaped past their deadline by the
         # serve-timeout thread (started with the TCP frontend)
         self._inflight: set = set()
-        self._inflight_lock = threading.Lock()
+        self._inflight_lock = sanitizer.make_lock("serve.server.inflight")
         self._reaper_thread: Optional[threading.Thread] = None
         # the replica pool builds every (model, variant) group — one
         # adapter + batcher + breaker per replica — and adopts each
@@ -839,7 +839,7 @@ class _AsyncCollector:
         self.sub = sub
         self.cb = cb
         self.deadline = deadline
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("serve.collector")
         self._left = sum(1 for f in sub.futures if f is not None)
         self._outputs: List[Optional[str]] = [None] * len(sub.futures)
         self._errors = 0

@@ -45,7 +45,7 @@ import time
 from collections import deque
 from typing import Dict, Optional
 
-from ..core import flight
+from ..core import flight, sanitizer
 from ..core.obs import quantile_from_counts
 
 KEY_P99_MS = "serve.slo.p99.ms"
@@ -100,7 +100,7 @@ class ModelSLO:
         self._streak_advanced_at: Optional[float] = None
         self._hist_id: Optional[int] = None
         self._samples: deque = deque()
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("serve.slo.monitor")
         self.consecutive = 0
         self.last: Dict[str, object] = self._empty()
 
@@ -212,7 +212,7 @@ class SLOBoard:
         self._default_p99 = config.get_float(KEY_P99_MS, 0.0)
         self._default_err = config.get_float(KEY_ERROR_PCT, 0.0)
         self._monitors: Dict[str, ModelSLO] = {}
-        self._lock = threading.Lock()
+        self._lock = sanitizer.make_lock("serve.slo.board")
 
     def monitor(self, name: str,
                 config_name: Optional[str] = None) -> ModelSLO:

@@ -32,3 +32,27 @@ def mesh8():
 def mesh1():
     from avenir_tpu.parallel import make_mesh
     return make_mesh(devices=jax.devices()[:1])
+
+
+@pytest.fixture
+def lock_sanitizer():
+    """Run the test under the runtime lock-order sanitizer
+    (core/sanitizer.py): locks constructed inside the test are tracked,
+    and teardown FAILS on any lock-order cycle (potential deadlock) the
+    test's thread interleavings recorded — the acceptance gate for the
+    concurrency-sanitizer half of avenir-analyze."""
+    from avenir_tpu.core import flight, sanitizer
+    sanitizer.enable()
+    # the flight recorder is an import-time singleton whose lock
+    # predates enablement: re-wrap it so anomaly paths (which run while
+    # other tracked locks are held) join the order graph
+    prev_flight_lock = flight.get_recorder()._lock
+    flight.sanitize_lock()
+    try:
+        yield sanitizer
+        stats = sanitizer.assert_no_cycles()
+        assert stats.get("acquisitions", 0) > 0, \
+            "sanitizer tracked no lock traffic (factories bypassed?)"
+    finally:
+        flight.get_recorder()._lock = prev_flight_lock
+        sanitizer.disable()

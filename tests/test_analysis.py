@@ -1,0 +1,609 @@
+"""The static-analysis engine's own test coverage (avenir-analyze).
+
+Table-driven fixtures per source rule — one minimal trigger, one
+registered-exclusion pass, one stale-exclusion failure — plus the
+tier-1 wrapper: ``analyze --strict`` runs CLEAN on this repo, in under
+10 seconds, with a JSON findings report.  Also the hammer regression
+tests for the three genuine lock-discipline findings the rule surfaced
+and this PR fixed (TelemetryExporter.ticks, TraceFlusher.flush,
+ScorerPool quarantine map)."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from avenir_tpu.analysis import (Corpus, Finding, RULES,
+                                 load_package_corpus, run_rules)
+from avenir_tpu.analysis.rules_concurrency import (
+    lock_discipline_findings, thread_lifecycle_findings)
+from avenir_tpu.analysis.rules_config import (collect_config_keys,
+                                              config_key_findings)
+from avenir_tpu.analysis.rules_io import (io_atomic_findings,
+                                          io_retry_findings)
+from avenir_tpu.analysis.rules_jax import (jax_bare_jit_findings,
+                                           jax_hot_path_findings)
+from avenir_tpu.analysis.rules_serve import flight_anomaly_findings
+
+
+_CORPUS_SEQ = [0]
+
+
+def make_corpus(tmp_path, files, readme=None):
+    _CORPUS_SEQ[0] += 1
+    root = tmp_path / f"pkg{_CORPUS_SEQ[0]}"
+    for rel, text in files.items():
+        path = root / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(text)
+    readme_path = None
+    if readme is not None:
+        readme_path = tmp_path / f"README{_CORPUS_SEQ[0]}.md"
+        readme_path.write_text(readme)
+    return Corpus(str(root),
+                  readme_path=str(readme_path) if readme_path else None)
+
+
+def tags(findings):
+    return sorted(f.tag for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# io-retry
+# ---------------------------------------------------------------------------
+
+_RAW_IO = "def read_cfg():\n    return open('f').read()\n"
+_WRAPPED_IO = ("def read_cfg():\n    return with_retries(_read)\n\n"
+               "def _read():\n    return open('f').read()\n")
+
+
+def test_io_retry_trigger_excluded_stale(tmp_path):
+    c = make_corpus(tmp_path, {"mod.py": _RAW_IO})
+    got = io_retry_findings(c, exclusions={}, modules=["mod.py"])
+    assert [f.tag for f in got] == ["violation"]
+    assert got[0].rule == "io-retry" and got[0].file == "mod.py"
+    assert "mod.py:read_cfg" in got[0].message
+
+    ok = io_retry_findings(
+        c, exclusions={"mod.py:read_cfg": "config read at startup"},
+        modules=["mod.py"])
+    assert ok == []
+
+    stale = io_retry_findings(
+        c, exclusions={"mod.py:read_cfg": "startup",
+                       "mod.py:gone": "was removed"},
+        modules=["mod.py"])
+    assert tags(stale) == ["stale-exclusion"]
+    assert "mod.py:gone" in stale[0].message
+
+    empty = io_retry_findings(c, exclusions={"mod.py:read_cfg": "  "},
+                              modules=["mod.py"])
+    assert tags(empty) == ["empty-reason"]
+
+
+def test_io_retry_with_retries_wrapping_passes(tmp_path):
+    c = make_corpus(tmp_path, {"mod.py": _WRAPPED_IO})
+    assert io_retry_findings(c, exclusions={}, modules=["mod.py"]) == []
+
+
+# ---------------------------------------------------------------------------
+# io-atomic-write
+# ---------------------------------------------------------------------------
+
+_TRUNC = "def save():\n    open('f', 'w').write('x')\n"
+
+
+def test_io_atomic_trigger_excluded_stale(tmp_path):
+    c = make_corpus(tmp_path, {"mod.py": _TRUNC})
+    got = io_atomic_findings(c, exclusions={})
+    assert [f.tag for f in got] == ["violation"]
+    assert "truncate-mode write" in got[0].message
+
+    assert io_atomic_findings(
+        c, exclusions={"mod.py:save": "scratch file, never published"}
+    ) == []
+
+    stale = io_atomic_findings(
+        c, exclusions={"mod.py:save": "scratch",
+                       "mod.py:other": "removed"})
+    assert tags(stale) == ["stale-exclusion"]
+
+    # append-mode and read-mode writes pass without exclusions
+    c2 = make_corpus(tmp_path, {
+        "ok.py": "def log():\n    open('f', 'a').write('x')\n"
+                 "def load():\n    return open('f').read()\n"})
+    assert io_atomic_findings(c2, exclusions={}) == []
+
+
+# ---------------------------------------------------------------------------
+# config-keys
+# ---------------------------------------------------------------------------
+
+def test_config_keys_trigger_and_pass(tmp_path):
+    ns = r"(?:telemetry)"
+    # bare literal read: no KEY_ constant
+    c = make_corpus(tmp_path, {
+        "mod.py": 'def f(config):\n'
+                  '    return config.get("telemetry.bad.key")\n'},
+        readme="telemetry.bad.key documented")
+    got = config_key_findings(c, ns)
+    assert any("no KEY_ constant" in f.message for f in got)
+
+    # KEY_-bound + accessor-read + documented: clean
+    good = ('KEY_GOOD = "telemetry.good.key"\n'
+            'def f(config):\n'
+            '    return config.get_float(KEY_GOOD, 1.0)\n')
+    c2 = make_corpus(tmp_path, {"mod2.py": good},
+                     readme="`telemetry.good.key` documented here")
+    assert config_key_findings(c2, ns) == []
+
+    # KEY_-bound but never accessor-read
+    c3 = make_corpus(tmp_path, {
+        "mod3.py": 'KEY_DEAD = "telemetry.dead.key"\n'},
+        readme="telemetry.dead.key")
+    got3 = config_key_findings(c3, ns)
+    assert any("never read via a JobConfig accessor" in f.message
+               for f in got3)
+
+    # undocumented
+    c4 = make_corpus(tmp_path, {"mod4.py": good}, readme="nothing here")
+    got4 = config_key_findings(c4, ns)
+    assert any("missing from README" in f.message for f in got4)
+
+    assert collect_config_keys(c2, ns) == {"telemetry.good.key":
+                                           "KEY_GOOD"}
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+_UNLOCKED_RMW = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def bump(self):
+        self.n += 1
+"""
+
+_LOCKED_RMW = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def bump(self):
+        with self._lock:
+            self.n += 1
+"""
+
+_WORKER_ONLY = """\
+import threading
+
+class C:
+    def __init__(self):
+        self.n = 0
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+
+    def _run(self):
+        while True:
+            self.n += 1
+"""
+
+_HELPER_CREDIT = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def bump(self):
+        with self._lock:
+            self._bump()
+
+    def _bump(self):
+        self.n += 1
+"""
+
+_INCONSISTENT_ASSIGN = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = "a"
+
+    def set_locked(self, v):
+        with self._lock:
+            self.state = v
+
+    def set_unlocked(self, v):
+        self.state = v
+"""
+
+_MODULE_GLOBAL = """\
+import threading
+
+_LOCK = threading.Lock()
+CACHE = {}
+
+def put(k, v):
+    CACHE[k] = v
+
+def get(k):
+    with _LOCK:
+        return CACHE.get(k)
+"""
+
+_CONDITION_LOCKED = """\
+import threading
+
+class Q:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self.items = []
+
+    def push(self, x):
+        with self._cv:
+            self.items.append(x)
+            self._cv.notify()
+"""
+
+
+def test_lock_discipline_trigger(tmp_path):
+    c = make_corpus(tmp_path, {"mod.py": _UNLOCKED_RMW})
+    got = lock_discipline_findings(c, exclusions={})
+    assert [f.tag for f in got] == ["violation"]
+    assert "C.n" in got[0].message and got[0].rule == "lock-discipline"
+
+
+def test_lock_discipline_locked_sites_pass(tmp_path):
+    for src in (_LOCKED_RMW, _HELPER_CREDIT, _CONDITION_LOCKED):
+        c = make_corpus(tmp_path, {"mod.py": src})
+        assert lock_discipline_findings(c, exclusions={}) == [], src
+
+
+def test_lock_discipline_worker_only_state_passes(tmp_path):
+    """Per-worker state mutated only from the thread-target chain needs
+    no lock (single mutator thread — the batcher's _last_all_failed
+    pattern)."""
+    c = make_corpus(tmp_path, {"mod.py": _WORKER_ONLY})
+    assert lock_discipline_findings(c, exclusions={}) == []
+
+
+def test_lock_discipline_inconsistent_rebind_flagged(tmp_path):
+    c = make_corpus(tmp_path, {"mod.py": _INCONSISTENT_ASSIGN})
+    got = lock_discipline_findings(c, exclusions={})
+    assert [f.tag for f in got] == ["violation"]
+    assert "inconsistent lockset" in got[0].message
+
+
+def test_lock_discipline_module_global(tmp_path):
+    c = make_corpus(tmp_path, {"mod.py": _MODULE_GLOBAL})
+    got = lock_discipline_findings(c, exclusions={})
+    assert [f.tag for f in got] == ["violation"]
+    assert "module global 'CACHE'" in got[0].message
+
+    ok = lock_discipline_findings(
+        c, exclusions={"mod.py:<module>.CACHE":
+                       "single-writer startup population"})
+    assert ok == []
+
+    stale = lock_discipline_findings(
+        c, exclusions={"mod.py:<module>.CACHE": "startup",
+                       "mod.py:C.gone": "class was deleted"})
+    assert tags(stale) == ["stale-exclusion"]
+
+
+def test_lock_discipline_sanitizer_factories_count_as_locks(tmp_path):
+    src = _LOCKED_RMW.replace("threading.Lock()",
+                              'sanitizer.make_lock("x")')
+    c = make_corpus(tmp_path, {"mod.py": src})
+    assert lock_discipline_findings(c, exclusions={}) == []
+
+
+# ---------------------------------------------------------------------------
+# thread-lifecycle
+# ---------------------------------------------------------------------------
+
+def test_thread_lifecycle_trigger_excluded_stale(tmp_path):
+    bad = ("import threading\n"
+           "def start():\n"
+           "    t = threading.Thread(target=print)\n"
+           "    t.start()\n")
+    c = make_corpus(tmp_path, {"mod.py": bad})
+    got = thread_lifecycle_findings(c, exclusions={})
+    assert [f.tag for f in got] == ["violation"]
+    assert "no daemon flag" in got[0].message
+
+    ok = thread_lifecycle_findings(
+        c, exclusions={"mod.py:start": "process-lifetime worker"})
+    assert ok == []
+
+    stale = thread_lifecycle_findings(
+        c, exclusions={"mod.py:start": "worker",
+                       "mod.py:gone": "removed"})
+    assert tags(stale) == ["stale-exclusion"]
+
+    daemon = make_corpus(tmp_path, {
+        "d.py": "import threading\n"
+                "def start():\n"
+                "    threading.Thread(target=print, daemon=True).start()\n"})
+    assert thread_lifecycle_findings(daemon, exclusions={}) == []
+
+    joined = make_corpus(tmp_path, {
+        "j.py": "import threading\n"
+                "class W:\n"
+                "    def start(self):\n"
+                "        self._t = threading.Thread(target=print)\n"
+                "        self._t.start()\n"
+                "    def stop(self):\n"
+                "        self._t.join()\n"})
+    assert thread_lifecycle_findings(joined, exclusions={}) == []
+
+    # anchored matching: an unrelated `out.join(` must NOT satisfy a
+    # thread variable named `t`
+    sneaky = make_corpus(tmp_path, {
+        "s.py": "import threading\n"
+                "def start(out):\n"
+                "    t = threading.Thread(target=print)\n"
+                "    t.start()\n"
+                "    return out.join(',')\n"})
+    got = thread_lifecycle_findings(sneaky, exclusions={})
+    assert [f.tag for f in got] == ["violation"]
+
+
+# ---------------------------------------------------------------------------
+# jax rules
+# ---------------------------------------------------------------------------
+
+def test_jax_hot_path_trigger_excluded_stale(tmp_path):
+    src = ("class F:\n"
+           "    def run(self, x):\n"
+           "        x.block_until_ready()\n"
+           "        return x\n"
+           "    def cold(self, x):\n"
+           "        x.block_until_ready()\n")
+    hp = {"mod.py": ("F.run",)}
+    c = make_corpus(tmp_path, {"mod.py": src})
+    got = jax_hot_path_findings(c, hot_paths=hp, exclusions={})
+    # only the registered hot scope fires; F.cold is out of scope
+    assert [f.tag for f in got] == ["violation"]
+    assert "F.run" in got[0].message
+
+    key = "mod.py:F.run:block_until_ready"
+    assert jax_hot_path_findings(
+        c, hot_paths=hp, exclusions={key: "end-of-scan barrier"}) == []
+
+    stale = jax_hot_path_findings(
+        c, hot_paths=hp,
+        exclusions={key: "barrier", "mod.py:F.gone:item": "removed"})
+    assert tags(stale) == ["stale-exclusion"]
+
+
+def test_jax_bare_jit_trigger(tmp_path):
+    c = make_corpus(tmp_path, {
+        "mod.py": "import jax\n"
+                  "def build(f):\n"
+                  "    return jax.jit(f)\n"})
+    got = jax_bare_jit_findings(c, modules=("mod.py",))
+    assert len(got) == 1 and "bare jax.jit" in got[0].message
+    # profiled_jit call sites do not match
+    c2 = make_corpus(tmp_path, {
+        "ok.py": "from . import telemetry\n"
+                 "def build(f):\n"
+                 "    return telemetry.profiled_jit(f, 'x')\n"})
+    assert jax_bare_jit_findings(c2, modules=("ok.py",)) == []
+
+
+# ---------------------------------------------------------------------------
+# flight-anomaly (fixture corpus re-using the real site table)
+# ---------------------------------------------------------------------------
+
+def test_flight_anomaly_fixture_trigger_and_pass(tmp_path):
+    bad = ("class CircuitBreaker:\n"
+           "    def record_failure(self):\n"
+           "        self.trips += 1\n")
+    # the fixture corpus only carries breaker.py: every other site in
+    # the table reports stale (pattern missing), the breaker site
+    # reports the missing hook — filter to the breaker entries
+    c = make_corpus(tmp_path, {"serve/breaker.py": bad})
+    got = [f for f in flight_anomaly_findings(c)
+           if f.file == "serve/breaker.py"]
+    assert len(got) == 1 and "flight.trigger" in got[0].message
+
+    good = ("class CircuitBreaker:\n"
+            "    def record_failure(self):\n"
+            "        self.trips += 1\n"
+            "        flight.trigger('breaker_trip')\n")
+    c2 = make_corpus(tmp_path, {"serve/breaker.py": good})
+    assert [f for f in flight_anomaly_findings(c2)
+            if f.file == "serve/breaker.py"] == []
+
+
+# ---------------------------------------------------------------------------
+# engine mechanics
+# ---------------------------------------------------------------------------
+
+def test_finding_format_and_json_roundtrip():
+    f = Finding("rule-x", "a/b.py", 12, "the message", hint="do this")
+    assert f.format() == "rule-x  a/b.py:12  the message  [fix: do this]"
+    assert f.to_dict() == {"rule": "rule-x", "file": "a/b.py",
+                           "line": 12, "message": "the message",
+                           "hint": "do this", "tag": "violation"}
+
+
+def test_run_rules_unknown_rule_raises(tmp_path):
+    c = make_corpus(tmp_path, {"m.py": "x = 1\n"})
+    with pytest.raises(KeyError, match="no-such-rule"):
+        run_rules(c, rule_ids=["no-such-rule"])
+
+
+def test_rule_registry_covers_catalog():
+    expected = {"io-retry", "io-atomic-write", "config-keys",
+                "driver-traced", "driver-counters", "foldspec-fusable",
+                "foldspec-dag", "dag-builtins", "flight-anomaly",
+                "wire-identity", "lock-discipline", "thread-lifecycle",
+                "jax-hot-path", "jax-bare-jit"}
+    assert expected <= set(RULES)
+    for rid in expected:
+        assert RULES[rid].doc
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 wrapper: the repo is strict-clean, fast, with a JSON report
+# ---------------------------------------------------------------------------
+
+def test_analyze_strict_runs_clean_fast_with_json_report(tmp_path):
+    """The acceptance gate: ``python -m avenir_tpu analyze --strict``
+    exits 0 on this repo (every exclusion carries a reason, no stale
+    entries), writes a JSON findings report, and the full-catalog run
+    completes in under 10 s."""
+    from avenir_tpu.analysis.cli import analyze_main
+
+    t0 = time.monotonic()
+    corpus = load_package_corpus()
+    findings, report = run_rules(corpus)
+    elapsed = time.monotonic() - t0
+    assert findings == [], [f.format() for f in findings]
+    assert elapsed < 10.0, f"analyze took {elapsed:.1f}s (>= 10s budget)"
+    assert report["files"] > 50
+    assert {r["rule"] for r in report["rules"]} == set(RULES)
+
+    json_path = str(tmp_path / "findings.json")
+    rc = analyze_main(["--strict", "--json", json_path])
+    assert rc == 0
+    data = json.loads(open(json_path).read())
+    assert data["total_findings"] == 0
+    assert data["findings"] == []
+
+
+def test_analyze_cli_strict_fails_on_findings(tmp_path, monkeypatch):
+    """--strict exits nonzero when a rule fires (a synthetic unlocked
+    RMW planted through a corpus override)."""
+    from avenir_tpu.analysis import cli as analysis_cli
+
+    c = make_corpus(tmp_path, {"mod.py": _UNLOCKED_RMW})
+    monkeypatch.setattr(analysis_cli, "load_package_corpus", lambda: c)
+    assert analysis_cli.analyze_main(
+        ["--strict", "--rules", "lock-discipline"]) == 1
+    # non-strict: findings print but exit 0
+    assert analysis_cli.analyze_main(
+        ["--rules", "lock-discipline"]) == 0
+    # unknown rule: usage error
+    assert analysis_cli.analyze_main(["--rules", "nope"]) == 2
+    assert analysis_cli.analyze_main(["--bogus"]) == 2
+
+
+def test_analyze_cli_list_prints_catalog(capsys):
+    from avenir_tpu.analysis.cli import analyze_main
+    assert analyze_main(["--list"]) == 0
+    out = capsys.readouterr().out
+    assert "lock-discipline" in out and "io-retry" in out
+
+
+# ---------------------------------------------------------------------------
+# hammer regressions for the genuine lock-discipline findings this PR
+# fixed (each fix = the rule's finding audited as a real race)
+# ---------------------------------------------------------------------------
+
+def test_exporter_tick_counter_hammer():
+    """TelemetryExporter.ticks was an unlocked += shared between the
+    exporter thread and manual tick() callers; hammered, the count must
+    be exact."""
+    from avenir_tpu.core.telemetry import TelemetryExporter
+
+    exp = TelemetryExporter(0.0, jsonl_path=None)
+    n_threads, per = 8, 200
+
+    def spin():
+        for _ in range(per):
+            exp.tick()
+
+    threads = [threading.Thread(target=spin) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert exp.ticks == n_threads * per
+
+
+def test_trace_flusher_concurrent_flush_no_duplicates(tmp_path):
+    """TraceFlusher.flush mutated _since/dropped and appended to the
+    file without a lock; concurrent flushes must neither duplicate nor
+    drop records."""
+    from avenir_tpu.core import obs
+    from avenir_tpu.core.telemetry import TraceFlusher
+
+    tr = obs.Tracer(enabled=True)
+    n_records = 400
+    for i in range(n_records):
+        with tr.span(f"s{i % 7}"):
+            pass
+    path = str(tmp_path / "trace.jsonl")
+    fl = TraceFlusher(tr, path, interval_sec=0)
+
+    errs = []
+
+    def flush():
+        try:
+            fl.flush()
+        except Exception as e:                  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=flush) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    lines = [json.loads(l) for l in open(path)]
+    assert len(lines) == n_records, (
+        f"{len(lines)} flushed lines for {n_records} records "
+        f"(duplicate or dropped flushes)")
+
+
+def test_pool_quarantine_map_hammer():
+    """ScorerPool's quarantine map was mutated outside the pool lock;
+    concurrent _ensure_quarantine calls must produce exactly one
+    quarantine instance per model."""
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.serve.pool import ScorerPool
+
+    pool = ScorerPool.__new__(ScorerPool)
+    pool.config = JobConfig({"serve.poison.isolate": "true"})
+    pool.poison_isolate = True
+    pool._lock = threading.Lock()
+    pool.quarantines = {}
+
+    names = [f"m{i}" for i in range(8)]
+    seen = {n: set() for n in names}
+    barrier = threading.Barrier(8)
+
+    def spin(tid):
+        barrier.wait()
+        for _ in range(200):
+            for n in names:
+                q = pool._ensure_quarantine(n)
+                seen[n].add(id(q))
+
+    threads = [threading.Thread(target=spin, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for n in names:
+        assert len(seen[n]) == 1, (
+            f"{n}: {len(seen[n])} distinct quarantine instances "
+            f"(creation raced)")

@@ -145,7 +145,7 @@ def _sidecars(base):
 
 @pytest.mark.parametrize("seed", SEEDS)
 def test_chaos_workflow_kill_corrupt_resume_byte_parity(
-        wf_data, wf_ref, tmp_path, mesh8, seed):
+        wf_data, wf_ref, tmp_path, mesh8, seed, lock_sanitizer):
     """Kill the workflow at a seeded random chunk, corrupt the NEWEST
     generation of every sidecar the crash left behind (and, on some
     seeds, ALSO truncate the workflow sidecar the way a dying disk
@@ -211,7 +211,7 @@ def test_chaos_workflow_kill_corrupt_resume_byte_parity(
 # deterministic breaker contract: poison never feeds the breaker
 # ---------------------------------------------------------------------------
 
-def test_poison_isolation_never_feeds_breaker():
+def test_poison_isolation_never_feeds_breaker(lock_sanitizer):
     """A hair-trigger breaker (threshold 1) stays CLOSED through an
     isolated poison batch — the strongest form of "poison failures do
     not count": a single counted failure would trip it."""
@@ -374,7 +374,8 @@ def _pipelined(port, items, out, errs):
 
 
 @pytest.mark.parametrize("seed", SEEDS)
-def test_chaos_serving_poison_storm_and_torn_reload(serve_art, seed):
+def test_chaos_serving_poison_storm_and_torn_reload(serve_art, seed,
+                                                    lock_sanitizer):
     """The serving half of the soak, one seed per schedule: a poison
     client's rows fail ALONE while cohabiting clients' requests all
     succeed with byte-exact outputs, nothing drops or hangs, the

@@ -1,73 +1,39 @@
-"""Tier-2 workflow-DAG lint (pattern of test_obs_coverage /
-test_multiscan_coverage): every ``workflow.*``/``dag.*`` config key read
-anywhere in the package must be bound to a KEY_ constant, read through a
-JobConfig accessor via that constant, and documented in README; and
-every driver exporting a shared-scan FoldSpec must be DAG-registrable
-(in the CLI job registry with the standard ``run(in, out, mesh)`` driver
-surface) or sit on the explicit ``NON_DAG_STAGES`` exclusion list with a
-written reason — so new fusable drivers cannot silently fall out of the
-workflow engine's reach."""
+"""Tier-2 workflow-DAG lint — now a thin shim over the unified
+static-analysis engine (``avenir_tpu.analysis``): the config-key and
+driver-surface walkers that used to live here are the engine's
+``config-keys`` / ``foldspec-dag`` / ``dag-builtins`` rules, with the
+same violations asserted byte-equivalently by the rule fixtures in
+``tests/test_analysis.py``."""
 
-import importlib
-import inspect
-import os
-import re
+from avenir_tpu.analysis import load_package_corpus
+from avenir_tpu.analysis.rules_config import (NAMESPACE_GROUPS,
+                                              collect_config_keys,
+                                              config_key_findings)
+from avenir_tpu.analysis.rules_drivers import (dag_builtin_findings,
+                                               foldspec_dag_findings)
 
-from avenir_tpu.cli import JOBS
-from avenir_tpu.core.dag import BUILTIN_STAGES, NON_DAG_STAGES
-
-_PKG_ROOT = os.path.join(os.path.dirname(__file__), "..", "avenir_tpu")
-
-# a workflow./dag. key literal read directly through a JobConfig accessor
-_ACCESSOR_LITERAL_RE = re.compile(
-    r'\.(?:get|get_int|get_float|get_boolean|get_list|must|must_int|'
-    r'must_float|must_list)\(\s*"((?:workflow|dag)\.[a-z0-9.]+)"')
+# one parse per process: load_package_corpus caches the parsed package
+corpus = load_package_corpus
 
 
-def _package_sources():
-    for root, _dirs, files in os.walk(_PKG_ROOT):
-        for fn in files:
-            if fn.endswith(".py"):
-                path = os.path.join(root, fn)
-                with open(path) as fh:
-                    yield path, fh.read()
+def _fmt(findings):
+    return [f.format() for f in findings]
 
 
-def _collect_config_keys():
-    """Every workflow.*/dag.* config key in the package: bound to a KEY_
-    constant, or (a lint violation) read as a bare literal."""
-    keys = {}
-    const_re = re.compile(
-        r'^(KEY_[A-Z0-9_]+)\s*=\s*"((?:workflow|dag)\.[a-z0-9.]+)"',
-        re.MULTILINE)
-    for path, text in _package_sources():
-        for m in const_re.finditer(text):
-            keys.setdefault(m.group(2), m.group(1))
-        for m in _ACCESSOR_LITERAL_RE.finditer(text):
-            keys.setdefault(m.group(1), None)
-    return keys
+_WF_PREFIX = NAMESPACE_GROUPS["workflow"]
 
 
 def test_workflow_keys_are_constants_read_through_jobconfig():
-    keys = _collect_config_keys()
+    keys = collect_config_keys(corpus(), _WF_PREFIX)
     assert keys, "no workflow config keys found (lint broken?)"
-    sources = list(_package_sources())
-    bad = []
-    for key, const in sorted(keys.items()):
-        if const is None:
-            bad.append((key, "no KEY_ constant binds this literal"))
-            continue
-        accessor = re.compile(
-            r"\.(?:get|get_int|get_float|get_boolean|get_list|must|"
-            r"must_int|must_float|must_list)\(\s*(?:\w+\.)?" + const + r"\b")
-        if not any(accessor.search(text) for _p, text in sources):
-            bad.append((key, f"{const} never read via a JobConfig accessor"))
-    assert not bad, f"workflow config keys failing the lint: {bad}"
+    bad = config_key_findings(corpus(), _WF_PREFIX, check_readme=False)
+    assert not bad, _fmt(bad)
 
 
 def test_workflow_keys_documented_in_readme():
-    readme = open(os.path.join(_PKG_ROOT, "..", "README.md")).read()
-    missing = [k for k in sorted(_collect_config_keys())
+    readme = corpus().readme
+    missing = [k for k in sorted(collect_config_keys(corpus(),
+                                                     _WF_PREFIX))
                if k not in readme]
     assert not missing, (
         f"workflow/dag config keys missing from README: {missing}")
@@ -76,75 +42,30 @@ def test_workflow_keys_documented_in_readme():
 def test_stage_template_keys_documented_in_readme():
     """The per-stage manifest template keys (composed per stage id, so
     the literal lint above cannot see them) must appear in README's
-    manifest documentation."""
-    readme = open(os.path.join(_PKG_ROOT, "..", "README.md")).read()
-    from avenir_tpu.core.dag import STAGE_RESERVED
-    missing = [k for k in ("workflow.stage.<id>.class",) + tuple(
-        f"workflow.stage.<id>.{k}" for k in STAGE_RESERVED
-        if k != "class") if k not in readme]
-    assert not missing, (
-        f"per-stage manifest keys missing from README: {missing}")
-
-
-# ---------------------------------------------------------------------------
-# every FoldSpec exporter is DAG-registrable (or excluded with a reason)
-# ---------------------------------------------------------------------------
-
-def _driver_classes():
-    for fqcn, (modname, clsname, _) in sorted(JOBS.items()):
-        mod = importlib.import_module(f"avenir_tpu.models.{modname}")
-        yield fqcn, getattr(mod, clsname)
-
-
-def _dag_registrable(cls) -> bool:
-    """A class the workflow engine can run as a stage: the standard
-    driver surface run(self, in_path, out_path, mesh=...)."""
-    run = getattr(cls, "run", None)
-    if run is None:
-        return False
-    params = list(inspect.signature(run).parameters)
-    return params[:3] == ["self", "in_path", "out_path"] and "mesh" in params
+    manifest documentation — checked by the dag-builtins rule."""
+    bad = [f for f in dag_builtin_findings(corpus())
+           if "manifest key" in f.message]
+    assert not bad, _fmt(bad)
 
 
 def test_every_foldspec_exporter_is_dag_registrable_or_excluded():
-    bad = []
-    for fqcn, cls in _driver_classes():
-        if not callable(getattr(cls, "fold_spec", None)):
-            continue
-        if cls.__name__ in NON_DAG_STAGES:
-            continue
-        if not _dag_registrable(cls):
-            bad.append(fqcn)
-    assert not bad, (
-        f"FoldSpec exporters that cannot run as DAG stages (fix the run() "
-        f"surface or add to core.dag.NON_DAG_STAGES with a reason): {bad}")
+    bad = [f for f in foldspec_dag_findings() if f.tag == "violation"]
+    assert not bad, _fmt(bad)
 
 
 def test_dag_exclusions_are_real_and_reasoned():
     """Every NON_DAG_STAGES entry names a registered FoldSpec exporter
     that truly is not registrable, with a non-empty reason — stale or
     vacuous exclusions fail."""
-    exporters = {cls.__name__: cls for _, cls in _driver_classes()
-                 if callable(getattr(cls, "fold_spec", None))}
-    for name, reason in NON_DAG_STAGES.items():
-        assert reason and reason.strip(), f"empty exclusion reason: {name}"
-        assert name in exporters, (
-            f"NON_DAG_STAGES entry {name!r} is not a registered FoldSpec "
-            f"exporter (stale exclusion?)")
-        assert not _dag_registrable(exporters[name]), (
-            f"{name} is DAG-registrable AND excluded — drop the stale "
-            f"exclusion")
+    bad = [f for f in foldspec_dag_findings()
+           if f.tag in ("stale-exclusion", "empty-reason")]
+    assert not bad, _fmt(bad)
 
 
 def test_builtin_stages_have_driver_surface():
     """The workflow-only built-ins honor the same driver contract the
     scheduler assumes of every stage (run(in, out, mesh) -> Counters,
-    traced)."""
-    for name, cls in BUILTIN_STAGES.items():
-        assert _dag_registrable(cls), name
-        assert getattr(cls.run, "__obs_traced__", False), (
-            f"{name}.run lacks @traced_run")
-        ann = inspect.signature(cls.run).return_annotation
-        label = ann if isinstance(ann, str) else getattr(ann, "__name__",
-                                                         ann)
-        assert label == "Counters", name
+    traced) — checked by the dag-builtins rule."""
+    bad = [f for f in dag_builtin_findings(corpus())
+           if "manifest key" not in f.message]
+    assert not bad, _fmt(bad)

@@ -1,65 +1,36 @@
-"""Tier-2 shared-scan lint: every registered batch driver that consumes
-the streaming fold (``core.pipeline.streaming_fold``) must either export
-a shared-scan ``fold_spec`` (core.multiscan) or appear on the explicit
-``NON_FUSABLE`` exclusion list with a written reason — so new streaming
-consumers cannot silently opt out of workflow fusion, and stale
-exclusions cannot linger after a driver becomes fusable."""
+"""Tier-2 shared-scan lint — now a thin shim over the unified
+static-analysis engine (``avenir_tpu.analysis``): the
+streaming-fold-consumer walker that used to live here is the engine's
+``foldspec-fusable`` rule, with the same violations asserted
+byte-equivalently by the rule fixtures in ``tests/test_analysis.py``.
+The FoldSpec construction smoke check stays a runtime test."""
 
-import importlib
-import inspect
-
-from avenir_tpu.cli import JOBS
-from avenir_tpu.core.multiscan import NON_FUSABLE
+from avenir_tpu.analysis.rules_drivers import foldspec_fusable_findings
 
 
-def _driver_classes():
-    for fqcn, (modname, clsname, _) in sorted(JOBS.items()):
-        mod = importlib.import_module(f"avenir_tpu.models.{modname}")
-        yield fqcn, getattr(mod, clsname)
-
-
-def _consumes_streaming_fold(cls) -> bool:
-    try:
-        src = inspect.getsource(cls)
-    except (OSError, TypeError):  # pragma: no cover - C/builtin classes
-        return False
-    return "streaming_fold" in src
+def _fmt(findings):
+    return [f.format() for f in findings]
 
 
 def test_every_streaming_fold_consumer_exports_foldspec_or_is_excluded():
-    bad = []
-    for fqcn, cls in _driver_classes():
-        if not _consumes_streaming_fold(cls):
-            continue
-        if cls.__name__ in NON_FUSABLE:
-            continue
-        if not callable(getattr(cls, "fold_spec", None)):
-            bad.append(fqcn)
-    assert not bad, (
-        f"streaming-fold consumers without a fold_spec export (add one or "
-        f"put the class on core.multiscan.NON_FUSABLE with a reason): {bad}")
+    bad = [f for f in foldspec_fusable_findings()
+           if f.tag == "violation"]
+    assert not bad, _fmt(bad)
 
 
 def test_exclusions_are_real_consumers_with_reasons():
     """Every NON_FUSABLE entry names an actual streaming-fold consumer
     that does NOT export a fold_spec, and carries a non-empty reason —
     a stale or vacuous exclusion fails."""
-    consumers = {cls.__name__: cls for _, cls in _driver_classes()
-                 if _consumes_streaming_fold(cls)}
-    for name, reason in NON_FUSABLE.items():
-        assert reason and reason.strip(), f"empty exclusion reason: {name}"
-        assert name in consumers, (
-            f"NON_FUSABLE entry {name!r} is not a registered "
-            f"streaming-fold consumer (stale exclusion?)")
-        assert not callable(getattr(consumers[name], "fold_spec", None)), (
-            f"{name} exports fold_spec AND sits on the exclusion list — "
-            f"drop the stale exclusion")
+    bad = [f for f in foldspec_fusable_findings()
+           if f.tag in ("stale-exclusion", "empty-reason")]
+    assert not bad, _fmt(bad)
 
 
 def test_fusable_drivers_fold_specs_construct():
-    """The five ported drivers' fold_spec exports actually build a
-    FoldSpec against a minimal config (a smoke check that the export is
-    not a dead attribute)."""
+    """The ported drivers' fold_spec exports actually build a FoldSpec
+    against a minimal config (a smoke check that the export is not a
+    dead attribute)."""
     import json
 
     from avenir_tpu.core import JobConfig

@@ -1,128 +1,79 @@
-"""Tier-2 observability lint: every registered batch driver must emit a
-top-level span from ``run()`` (the ``core.obs.traced_run`` decorator) and
-return a Counters metrics snapshot — so new drivers cannot silently opt
-out of the unified tracing + metrics surface.  The telemetry layer rides
-the same lint: every ``telemetry.*``/``serve.slo.*`` — and, since the
-serving-at-scale PR, ``serve.pool.*``/``serve.router.*``/
-``serve.frontend.*``/``serve.drain.*`` — config key must be bound to a
-KEY_ constant, read through a JobConfig accessor, and documented in
-README, and the telemetry exporter thread must be verifiably stopped on
-shutdown (the serve-side half — pool replica batchers, I/O shards, the
-command executor — is hammered in tests/test_pool.py)."""
+"""Tier-2 observability lint — now a thin shim over the unified
+static-analysis engine (``avenir_tpu.analysis``): the driver-surface,
+config-key, anomaly-site, and response-identity walkers that used to
+live here are the engine's ``driver-traced`` / ``driver-counters`` /
+``config-keys`` / ``flight-anomaly`` / ``wire-identity`` rules, with
+the same violations asserted byte-equivalently by the rule fixtures in
+``tests/test_analysis.py``.  The two RUNTIME checks (thread-shutdown
+hammer, traced-run canary) stay here: they execute code, which is
+exactly what static analysis cannot."""
 
-import importlib
-import inspect
-import os
-import re
+from avenir_tpu.analysis import load_package_corpus
+from avenir_tpu.analysis.rules_config import (NAMESPACE_GROUPS,
+                                              collect_config_keys,
+                                              config_key_findings)
+from avenir_tpu.analysis.rules_drivers import (driver_counters_findings,
+                                               driver_traced_findings)
+from avenir_tpu.analysis.rules_serve import (flight_anomaly_findings,
+                                             wire_identity_findings)
 
-from avenir_tpu.cli import JOBS
-
-_PKG_ROOT = os.path.join(os.path.dirname(__file__), "..", "avenir_tpu")
-
-# run() returns something other than Counters by DESIGN for these:
-# - LogisticRegressionJob.run returns the reference's convergence status
-#   int (the outer do-while protocol; its Counters live on self.counters)
-# - ReinforcementLearnerTopology.run is the streaming event loop (its
-#   return is unannotated but IS a Counters; signature differs too)
-RETURN_ALLOWED = {
-    "org.avenir.regress.LogisticRegressionJob",
-    "org.avenir.reinforce.ReinforcementLearnerTopology",
-}
+# one parse per process: load_package_corpus caches the parsed package
+corpus = load_package_corpus
 
 
-def _driver_classes():
-    for fqcn, (modname, clsname, _) in sorted(JOBS.items()):
-        mod = importlib.import_module(f"avenir_tpu.models.{modname}")
-        yield fqcn, getattr(mod, clsname)
+def _fmt(findings):
+    return [f.format() for f in findings]
 
 
 def test_every_registered_driver_run_is_traced():
-    missing = [fqcn for fqcn, cls in _driver_classes()
-               if not getattr(cls.run, "__obs_traced__", False)]
-    assert not missing, (
-        f"drivers whose run() lacks @traced_run (core.obs): {missing}")
+    assert not _fmt(driver_traced_findings(corpus()))
 
 
 def test_every_registered_driver_run_returns_counters():
-    bad = []
-    for fqcn, cls in _driver_classes():
-        if fqcn in RETURN_ALLOWED:
-            continue
-        ann = inspect.signature(cls.run).return_annotation
-        name = ann if isinstance(ann, str) else getattr(ann, "__name__", ann)
-        if name != "Counters":
-            bad.append((fqcn, name))
-    assert not bad, f"drivers whose run() does not return Counters: {bad}"
+    assert not _fmt(driver_counters_findings(corpus()))
 
 
-# ---------------------------------------------------------------------------
-# telemetry config-key lint
-# ---------------------------------------------------------------------------
-
-# the config-key namespaces the lint owns (serve.model.<name>.* per-model
-# override keys are derived at runtime from these and stay out)
-_LINT_PREFIXES = (r'(?:telemetry|serve\.slo|serve\.pool|serve\.router|'
-                  r'serve\.frontend|serve\.drain|obs\.sample|flight)')
-
-# a key literal READ directly through a JobConfig accessor (gauge/metric
-# NAMES reuse the dotted vocabulary but never flow through an accessor,
-# so they stay out of the config-key lint)
-_ACCESSOR_LITERAL_RE = re.compile(
-    r'\.(?:get|get_int|get_float|get_boolean|get_list|must|must_int|'
-    r'must_float|must_list)\(\s*"(' + _LINT_PREFIXES + r'\.[a-z0-9.]+)"')
-
-
-def _package_sources():
-    for root, _dirs, files in os.walk(_PKG_ROOT):
-        for fn in files:
-            if fn.endswith(".py"):
-                path = os.path.join(root, fn)
-                with open(path) as fh:
-                    yield path, fh.read()
-
-
-def _collect_config_keys():
-    """Every telemetry.*/serve.slo.* config key in the package: bound to
-    a KEY_ constant, or (a lint violation) read as a bare literal."""
-    keys = {}
-    const_re = re.compile(
-        r'^(KEY_[A-Z0-9_]+)\s*=\s*"(' + _LINT_PREFIXES + r'\.[a-z0-9.]+)"',
-        re.MULTILINE)
-    for path, text in _package_sources():
-        for m in const_re.finditer(text):
-            keys.setdefault(m.group(2), m.group(1))
-        for m in _ACCESSOR_LITERAL_RE.finditer(text):
-            keys.setdefault(m.group(1), None)
-    return keys
+# the config-key namespace this module historically owned — the
+# ENGINE'S group, so shim and rule cannot drift
+_LINT_PREFIXES = NAMESPACE_GROUPS["telemetry"]
 
 
 def test_telemetry_keys_are_constants_read_through_jobconfig():
-    """Every telemetry.*/serve.slo.* key must be declared as a KEY_
-    constant AND read somewhere through a JobConfig accessor referencing
-    that constant — no ad-hoc string reads that drift from the docs."""
-    keys = _collect_config_keys()
+    keys = collect_config_keys(corpus(), _LINT_PREFIXES)
     assert keys, "no telemetry config keys found (lint broken?)"
-    sources = list(_package_sources())
-    bad = []
-    for key, const in sorted(keys.items()):
-        if const is None:
-            bad.append((key, "no KEY_ constant binds this literal"))
-            continue
-        accessor = re.compile(
-            r"\.(?:get|get_int|get_float|get_boolean|get_list|must|"
-            r"must_int|must_float|must_list)\(\s*(?:\w+\.)?" + const + r"\b")
-        if not any(accessor.search(text) for _p, text in sources):
-            bad.append((key, f"{const} never read via a JobConfig accessor"))
-    assert not bad, f"telemetry config keys failing the lint: {bad}"
+    bad = config_key_findings(corpus(), _LINT_PREFIXES,
+                              check_readme=False)
+    assert not bad, _fmt(bad)
 
 
 def test_telemetry_keys_documented_in_readme():
-    readme = open(os.path.join(_PKG_ROOT, "..", "README.md")).read()
-    missing = [k for k in sorted(_collect_config_keys())
+    readme = corpus().readme
+    missing = [k for k in sorted(collect_config_keys(corpus(),
+                                                     _LINT_PREFIXES))
                if k not in readme]
     assert not missing, (
         f"telemetry/serve.slo config keys missing from README: {missing}")
 
+
+def test_every_anomaly_site_calls_flight_dump_hook():
+    """Breaker trips, SLO soft-degrades, poison quarantines, torn
+    artifacts, and systemic scorer failures must all dump the black box
+    (call ``flight.trigger``) or be excluded with a reason."""
+    assert not _fmt(flight_anomaly_findings(corpus()))
+
+
+def test_every_response_construction_site_echoes_identity():
+    """Every wire response path must carry the client's request_id (and
+    trace_id when sampled): each response-constructing function in
+    serve/server.py must be on the _finish_response funnel (or excluded
+    with a reason), and the frontend's out-of-funnel paths are pinned
+    explicitly."""
+    assert not _fmt(wire_identity_findings(corpus()))
+
+
+# ---------------------------------------------------------------------------
+# runtime checks (not migratable to static analysis by design)
+# ---------------------------------------------------------------------------
 
 def test_telemetry_exporter_threads_stop_on_shutdown():
     """Hammer: exporters and trace flushers started and stopped
@@ -146,181 +97,6 @@ def test_telemetry_exporter_threads_stop_on_shutdown():
         exp.stop(final_tick=False)
         fl.stop()
         assert leaked() == []
-
-
-# ---------------------------------------------------------------------------
-# flight-recorder anomaly-site lint
-# ---------------------------------------------------------------------------
-
-#: every anomaly trigger site in the package: (module path, a regex that
-#: locates the site) -> the enclosing function/class scope must call the
-#: flight-dump hook (``flight.trigger``) — or sit on the exclusion dict
-#: below with a reason.  Grows with new anomaly classes.
-ANOMALY_SITES = {
-    "breaker trip (closed/half-open -> open)":
-        ("serve/breaker.py", r"self\.trips \+= 1"),
-    "SLO sustained violation -> soft-degrade":
-        ("serve/slo.py", r"set_soft_degraded\(\s*True"),
-    "systemic scorer failure (whole-batch exception)":
-        ("serve/batcher.py", r"record_failure\("),
-    "poison row crosses into quarantine":
-        ("serve/batcher.py", r"quarantine\.record\("),
-    "torn artifact detected":
-        ("core/io.py", r"class TornArtifactError"),
-}
-
-#: sites deliberately NOT wired to the flight hook, with reasons
-ANOMALY_EXCLUDED: dict = {}
-
-
-def _enclosing_scope_source(text: str, lineno: int) -> str:
-    """Source of the innermost function/class whose body spans
-    ``lineno`` (1-based) — the scope the flight call must live in."""
-    import ast
-
-    tree = ast.parse(text)
-    best = None
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.ClassDef)):
-            if node.lineno <= lineno <= (node.end_lineno or node.lineno):
-                if best is None or node.lineno > best.lineno:
-                    best = node
-    if best is None:
-        return text
-    return "\n".join(text.splitlines()[best.lineno - 1:best.end_lineno])
-
-
-def test_every_anomaly_site_calls_flight_dump_hook():
-    """Breaker trips, SLO soft-degrades, poison quarantines, torn
-    artifacts, and systemic scorer failures must all dump the black box
-    (call ``flight.trigger``) or be excluded with a reason."""
-    bad = []
-    for what, (rel, pattern) in sorted(ANOMALY_SITES.items()):
-        if what in ANOMALY_EXCLUDED:
-            continue
-        path = os.path.join(_PKG_ROOT, rel)
-        text = open(path).read()
-        matches = list(re.finditer(pattern, text))
-        if not matches:
-            bad.append((what, f"site pattern no longer matches {rel} "
-                              f"(stale lint entry?)"))
-            continue
-        for m in matches:
-            lineno = text[:m.start()].count("\n") + 1
-            scope = _enclosing_scope_source(text, lineno)
-            if "flight.trigger" not in scope:
-                bad.append((what, f"{rel}:{lineno} scope has no "
-                                  f"flight.trigger call"))
-    assert not bad, f"anomaly sites missing the flight-dump hook: {bad}"
-
-
-# ---------------------------------------------------------------------------
-# wire-response identity lint (request_id/trace_id echo)
-# ---------------------------------------------------------------------------
-
-#: serve/server.py functions allowed to BUILD response dicts: each is
-#: either on the _finish_response funnel (every handle_line return and
-#: every dispatch_line callback passes through the chokepoint that
-#: echoes request_id/trace_id) or excluded with a reason
-RESPONSE_SITES_OK = {
-    "_finish_response": "the chokepoint itself",
-    "handle_line": "pre-parse JSON errors only: request_id unreadable "
-                   "by definition; parsed requests funnel through "
-                   "_finish_response",
-    "dispatch_line": "pre-parse errors before the cb wrapper installs; "
-                     "all post-parse cb calls ride the funnel",
-    "_handle_obj": "returns into handle_line/dispatch_line funnels",
-    "_command": "returns into the funnels via _handle_obj",
-    "_submit": "returns into _predict -> funnels",
-    "_assemble": "returns into _predict/_AsyncCollector -> funnels",
-    "_finish": "_AsyncCollector: fires the wrapped (funnel) callback",
-}
-
-#: frontend.py response-producing functions (they render bytes directly,
-#: outside the server funnel) and why each is identity-correct
-FRONTEND_SITES_OK = {
-    "_dispatch_error": "oversized/skimmed line: the request was never "
-                       "parsed, so no request_id exists to echo",
-    "fail_pending": "drain-timeout filler: echoes request_id from "
-                    "conn.meta (captured at dispatch) — asserted below",
-}
-
-
-def _response_building_functions(path: str) -> dict:
-    """{enclosing function name: [line numbers]} for every dict literal
-    carrying an ``"error"``/``"output"``/``"outputs"`` key — the
-    response-construction sites."""
-    import ast
-
-    text = open(path).read()
-    tree = ast.parse(text)
-    sites: dict = {}
-    funcs = [n for n in ast.walk(tree)
-             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
-    wire_keys = {"error", "output", "outputs"}
-
-    def hit(node) -> bool:
-        if isinstance(node, ast.Dict):
-            keys = {k.value for k in node.keys
-                    if isinstance(k, ast.Constant)
-                    and isinstance(k.value, str)}
-            return bool(keys & wire_keys)
-        if isinstance(node, ast.Assign):
-            # resp["error"] = ... — assembled responses, not literals
-            for t in node.targets:
-                if (isinstance(t, ast.Subscript)
-                        and isinstance(t.slice, ast.Constant)
-                        and t.slice.value in wire_keys):
-                    return True
-        return False
-
-    for node in ast.walk(tree):
-        if not hit(node):
-            continue
-        owner = None
-        for f in funcs:
-            if f.lineno <= node.lineno <= (f.end_lineno or f.lineno):
-                if owner is None or f.lineno > owner.lineno:
-                    owner = f
-        sites.setdefault(owner.name if owner else "<module>",
-                         []).append(node.lineno)
-    return sites
-
-
-def test_every_response_construction_site_echoes_identity():
-    """Every wire response path must carry the client's request_id (and
-    trace_id when sampled): each response-constructing function in
-    serve/server.py must be on the _finish_response funnel (or excluded
-    with a reason), and the frontend's out-of-funnel paths are pinned
-    explicitly."""
-    srv_sites = _response_building_functions(
-        os.path.join(_PKG_ROOT, "serve", "server.py"))
-    unknown = sorted(set(srv_sites) - set(RESPONSE_SITES_OK))
-    assert not unknown, (
-        f"new response-construction sites in serve/server.py not "
-        f"classified for identity echo: "
-        f"{[(f, srv_sites[f]) for f in unknown]} — route them through "
-        f"_finish_response or add them to RESPONSE_SITES_OK with a "
-        f"reason")
-    stale = sorted(set(RESPONSE_SITES_OK) - set(srv_sites))
-    assert not stale, f"stale RESPONSE_SITES_OK entries: {stale}"
-    # the funnel really exists and echoes both identities
-    funnel = open(os.path.join(_PKG_ROOT, "serve", "server.py")).read()
-    assert 'setdefault("request_id"' in funnel
-    assert 'setdefault("trace_id"' in funnel
-    # frontend: out-of-funnel renderers are exactly the pinned two, and
-    # the drain filler echoes the captured request_id
-    fe_path = os.path.join(_PKG_ROOT, "serve", "frontend.py")
-    fe_sites = _response_building_functions(fe_path)
-    unknown_fe = sorted(set(fe_sites) - set(FRONTEND_SITES_OK))
-    assert not unknown_fe, (
-        f"new response-construction sites in serve/frontend.py: "
-        f"{[(f, fe_sites[f]) for f in unknown_fe]}")
-    fe_text = open(fe_path).read()
-    fail_src = _enclosing_scope_source(
-        fe_text, fe_sites["fail_pending"][0])
-    assert "request_id" in fail_src and "conn.meta" in fail_src
 
 
 def test_traced_run_emits_top_level_span():

@@ -545,7 +545,7 @@ def test_frontend_pipelined_responses_in_request_order(artifacts):
         srv.stop()
 
 
-def test_frontend_many_concurrent_sockets(artifacts):
+def test_frontend_many_concurrent_sockets(artifacts, lock_sanitizer):
     """Dozens of concurrently OPEN pipelined connections multiplex over
     a fixed number of I/O shard threads (connections cost fds, not
     threads) and every response lands on the right connection in
@@ -791,7 +791,8 @@ def test_request_truncated_on_connection_close():
 # shutdown hygiene: pool/frontend/cmd threads all stop (hammer)
 # ---------------------------------------------------------------------------
 
-def test_no_leaked_pool_or_frontend_threads_after_stop(artifacts):
+def test_no_leaked_pool_or_frontend_threads_after_stop(
+        artifacts, lock_sanitizer):
     """Hammer: multi-replica multi-variant servers with the event-loop
     frontend started and stopped repeatedly leave NO serve-io-*,
     serve-batcher-*, serve-cmd*, or serve-watchdog threads behind."""

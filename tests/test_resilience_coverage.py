@@ -1,323 +1,104 @@
-"""Tier-2 resilience lint: every raw I/O call site (``open``,
-``subprocess.*``, ``os.fdopen``/``tempfile.mkstemp``) in the ingest-path
-modules must either run under ``core.resilience.with_retries`` (directly,
-or as a helper invoked through it) or appear on the explicit
-``NON_RETRYABLE`` exclusion registry with a written reason — so new I/O
-on the ingest path cannot silently skip the retry layer, and stale
-exclusions cannot linger after a call site is removed or wrapped.
+"""Tier-2 resilience lint — now a thin shim over the unified
+static-analysis engine (``avenir_tpu.analysis``, README "Static
+analysis & sanitizers"); the walkers that used to live here are the
+engine's ``io-retry`` / ``io-atomic-write`` / ``config-keys`` rules,
+and the same violations are asserted byte-equivalently by the rule
+fixtures in ``tests/test_analysis.py``.
 
-Durability lint (the self-healing layer, README "Fault tolerance"):
-every truncate-mode write (``open``/``os.fdopen`` with a ``w*`` mode)
-anywhere in the package must live inside the atomic publish primitives
-(:class:`core.io.OutputWriter` / :func:`core.io.atomic_write_text`) or
-sit on ``core.io.NON_ATOMIC_WRITES`` with a written reason — so a new
-artifact writer cannot silently reintroduce the torn-on-crash in-place
-``open(path, "w")`` this layer exists to kill.  And every
-``checkpoint.*`` / ``io.*`` / ``serve.poison.*`` config key must be
-KEY_-bound, read through a JobConfig accessor, and README-documented
-(pattern of test_dag_coverage)."""
+Contract (unchanged): every raw I/O call site (``open``,
+``subprocess.*``, ``os.fdopen``/``tempfile.mkstemp``) in the
+ingest-path modules must run under ``core.resilience.with_retries`` or
+appear on ``NON_RETRYABLE`` with a written reason; every truncate-mode
+write anywhere in the package must live inside the atomic publish
+primitives or sit on ``core.io.NON_ATOMIC_WRITES``; stale exclusions
+fail; every ``checkpoint.*``/``io.*``/``serve.poison.*`` config key is
+KEY_-bound, JobConfig-read, and README-documented."""
 
-import ast
-import os
-import re
+from avenir_tpu.analysis import load_package_corpus
+from avenir_tpu.analysis.rules_config import (NAMESPACE_GROUPS,
+                                              collect_config_keys,
+                                              config_key_findings)
+from avenir_tpu.analysis.rules_io import (io_atomic_findings,
+                                          io_retry_findings,
+                                          is_atomic_site, scan_ingest_io,
+                                          scan_truncate_writes)
 
-import avenir_tpu
-from avenir_tpu.core.io import NON_ATOMIC_WRITES
-from avenir_tpu.core.resilience import NON_RETRYABLE
-
-PKG_DIR = os.path.dirname(avenir_tpu.__file__)
-
-#: the ingest-path modules the lint patrols (relative to the package)
-INGEST_MODULES = [
-    "core/io.py",
-    "core/config.py",
-    "core/pipeline.py",
-    "core/binning.py",
-    "core/multiscan.py",
-    "core/checkpoint.py",
-    "core/resilience.py",
-    "native/__init__.py",
-]
-
-#: call spellings that count as raw I/O
-RAW_NAME_CALLS = {"open"}
-RAW_ATTR_CALLS = {
-    ("subprocess", "run"), ("subprocess", "Popen"),
-    ("subprocess", "check_output"), ("subprocess", "check_call"),
-    ("os", "fdopen"), ("tempfile", "mkstemp"),
-}
+# one parse per process: load_package_corpus caches the parsed package
+corpus = load_package_corpus
 
 
-class _Scan(ast.NodeVisitor):
-    def __init__(self):
-        self.stack = []
-        self.raw_sites = {}          # qualname -> [lineno...]
-        self.wrapper_funcs = set()   # funcs whose body calls with_retries
-        self.retry_invoked = set()   # helper names passed to with_retries
-
-    def _qual(self):
-        return ".".join(self.stack) if self.stack else "<module>"
-
-    def visit_ClassDef(self, node):
-        self.stack.append(node.name)
-        self.generic_visit(node)
-        self.stack.pop()
-
-    def visit_FunctionDef(self, node):
-        self.stack.append(node.name)
-        self.generic_visit(node)
-        self.stack.pop()
-
-    visit_AsyncFunctionDef = visit_FunctionDef
-
-    def visit_Call(self, node):
-        fn = node.func
-        if isinstance(fn, ast.Name):
-            if fn.id == "open":
-                self.raw_sites.setdefault(self._qual(), []).append(
-                    node.lineno)
-            elif fn.id == "with_retries":
-                self.wrapper_funcs.add(self._qual())
-                if node.args and isinstance(node.args[0], ast.Name):
-                    self.retry_invoked.add(node.args[0].id)
-        elif isinstance(fn, ast.Attribute):
-            base = fn.value
-            if (isinstance(base, ast.Name)
-                    and (base.id, fn.attr) in RAW_ATTR_CALLS):
-                self.raw_sites.setdefault(self._qual(), []).append(
-                    node.lineno)
-            if fn.attr == "with_retries":
-                self.wrapper_funcs.add(self._qual())
-                if node.args and isinstance(node.args[0], ast.Name):
-                    self.retry_invoked.add(node.args[0].id)
-        self.generic_visit(node)
-
-
-def _scan_all():
-    sites = {}            # "module:qualname" -> [lineno...]
-    wrapped = set()       # "module:qualname" keys considered retry-covered
-    retry_invoked = set()
-    per_module = {}
-    for rel in INGEST_MODULES:
-        path = os.path.join(PKG_DIR, rel)
-        scan = _Scan()
-        scan.visit(ast.parse(open(path).read(), filename=path))
-        per_module[rel] = scan
-        retry_invoked |= scan.retry_invoked
-    for rel, scan in per_module.items():
-        for qual, lines in scan.raw_sites.items():
-            key = f"{rel}:{qual}"
-            sites[key] = lines
-            leaf = qual.rsplit(".", 1)[-1]
-            if qual in scan.wrapper_funcs or leaf in retry_invoked:
-                wrapped.add(key)
-    return sites, wrapped
+def _fmt(findings):
+    return [f.format() for f in findings]
 
 
 def test_ingest_raw_io_is_retried_or_excluded():
-    sites, wrapped = _scan_all()
-    bad = [f"{k} (lines {v})" for k, v in sorted(sites.items())
-           if k not in wrapped and k not in NON_RETRYABLE]
-    assert not bad, (
-        "raw I/O call sites on the ingest path that neither run under "
-        "with_retries nor sit on core.resilience.NON_RETRYABLE with a "
-        f"reason: {bad}")
+    bad = [f for f in io_retry_findings(corpus())
+           if f.tag == "violation"]
+    assert not bad, _fmt(bad)
 
 
 def test_exclusions_are_live_and_reasoned():
-    """A NON_RETRYABLE entry must (a) carry a non-empty reason and
-    (b) still name a real, UN-wrapped raw call site — an entry whose
-    call site was removed or wrapped is stale and must be dropped."""
-    sites, wrapped = _scan_all()
-    for key, reason in NON_RETRYABLE.items():
-        assert reason and reason.strip(), f"empty exclusion reason: {key}"
-        assert key in sites, (
-            f"stale NON_RETRYABLE entry {key!r}: no such raw I/O call "
-            f"site exists anymore — drop it")
-        assert key not in wrapped, (
-            f"stale NON_RETRYABLE entry {key!r}: the call site now runs "
-            f"under with_retries — drop the exclusion")
+    """A NON_RETRYABLE entry must carry a non-empty reason and still
+    name a real, UN-wrapped raw call site — the engine reports stale or
+    reasonless entries as findings."""
+    bad = [f for f in io_retry_findings(corpus())
+           if f.tag in ("stale-exclusion", "empty-reason")]
+    assert not bad, _fmt(bad)
 
 
 def test_retry_wrappers_exist():
     """The load-bearing ingest reads really are wrapped (guards the lint
     itself against a refactor that silently stops invoking
     with_retries anywhere)."""
-    sites, wrapped = _scan_all()
+    _sites, wrapped = scan_ingest_io(corpus())
     assert "native/__init__.py:_read_part" in wrapped
     assert "native/__init__.py:_cc_run" in wrapped
     assert "core/pipeline.py:_open_text" in wrapped
 
 
-# ---------------------------------------------------------------------------
-# durability: truncate-mode writes are atomic or excluded with a reason
-# ---------------------------------------------------------------------------
-
-#: quals that ARE the atomic publish layer (writes inside them stage to
-#: a temp path and land via fsync + os.replace)
-ATOMIC_PRIMITIVES = ("core/io.py:atomic_write_text",
-                     "core/io.py:OutputWriter.")
-
-
-class _WriteScan(ast.NodeVisitor):
-    """Collects ``open``/``os.fdopen`` calls whose mode argument is a
-    ``w*`` constant (truncate-rewrite: the torn-on-crash shape) or a
-    non-constant expression (flagged conservatively).  Read-mode and
-    append-mode calls pass."""
-
-    def __init__(self):
-        self.stack = []
-        self.sites = {}              # qualname -> [lineno...]
-
-    def _qual(self):
-        return ".".join(self.stack) if self.stack else "<module>"
-
-    def visit_ClassDef(self, node):
-        self.stack.append(node.name)
-        self.generic_visit(node)
-        self.stack.pop()
-
-    def visit_FunctionDef(self, node):
-        self.stack.append(node.name)
-        self.generic_visit(node)
-        self.stack.pop()
-
-    visit_AsyncFunctionDef = visit_FunctionDef
-
-    @staticmethod
-    def _truncating(node) -> bool:
-        mode = node.args[1] if len(node.args) >= 2 else None
-        for kw in node.keywords:
-            if kw.arg == "mode":
-                mode = kw.value
-        if mode is None:
-            return False                      # default: read
-        if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
-            return mode.value.startswith("w")
-        return True                           # dynamic mode: flag it
-
-    def visit_Call(self, node):
-        fn = node.func
-        is_write = False
-        if isinstance(fn, ast.Name) and fn.id == "open":
-            is_write = self._truncating(node)
-        elif (isinstance(fn, ast.Attribute) and fn.attr == "fdopen"
-              and isinstance(fn.value, ast.Name)
-              and fn.value.id == "os"):
-            is_write = self._truncating(node)
-        if is_write:
-            self.sites.setdefault(self._qual(), []).append(node.lineno)
-        self.generic_visit(node)
-
-
-def _scan_writes():
-    sites = {}
-    for root, _dirs, files in os.walk(PKG_DIR):
-        for fn in sorted(files):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(root, fn)
-            rel = os.path.relpath(path, PKG_DIR)
-            scan = _WriteScan()
-            scan.visit(ast.parse(open(path).read(), filename=path))
-            for qual, lines in scan.sites.items():
-                sites[f"{rel}:{qual}"] = lines
-    return sites
-
-
-def _is_atomic(key: str) -> bool:
-    return key.startswith(ATOMIC_PRIMITIVES)
-
-
 def test_truncate_writes_are_atomic_or_excluded():
-    sites = _scan_writes()
-    bad = [f"{k} (lines {v})" for k, v in sorted(sites.items())
-           if not _is_atomic(k) and k not in NON_ATOMIC_WRITES]
-    assert not bad, (
-        "truncate-mode writes outside the atomic publish layer "
-        "(OutputWriter / atomic_write_text): route them through "
-        "core.io.atomic_write_text, or add to core.io.NON_ATOMIC_WRITES "
-        f"with a written reason: {bad}")
+    bad = [f for f in io_atomic_findings(corpus())
+           if f.tag == "violation"]
+    assert not bad, _fmt(bad)
 
 
 def test_non_atomic_exclusions_are_live_and_reasoned():
-    sites = _scan_writes()
-    for key, reason in NON_ATOMIC_WRITES.items():
-        assert reason and reason.strip(), f"empty exclusion reason: {key}"
-        assert key in sites, (
-            f"stale NON_ATOMIC_WRITES entry {key!r}: no such write site "
-            f"exists anymore — drop it")
-        assert not _is_atomic(key), (
-            f"NON_ATOMIC_WRITES entry {key!r} is inside the atomic "
-            f"publish layer — drop the redundant exclusion")
+    bad = [f for f in io_atomic_findings(corpus())
+           if f.tag in ("stale-exclusion", "empty-reason")]
+    assert not bad, _fmt(bad)
 
 
 def test_atomic_publish_layer_really_writes():
     """Guards the whitelist itself: the atomic primitives contain the
     package's staged write sites (a refactor that renames them must
     update ATOMIC_PRIMITIVES, not silently stop linting)."""
-    sites = _scan_writes()
+    sites = scan_truncate_writes(corpus())
     assert any(k.startswith("core/io.py:OutputWriter.") for k in sites)
     assert any(k.startswith("core/io.py:atomic_write_text")
                for k in sites)
+    assert any(is_atomic_site(k) for k in sites)
 
 
-# ---------------------------------------------------------------------------
-# durability config keys: KEY_-bound, JobConfig-read, README-documented
-# ---------------------------------------------------------------------------
-
-_DUR_PREFIX = r"(?:checkpoint|io|serve\.poison)\."
-
-_DUR_CONST_RE = re.compile(
-    r'^(KEY_[A-Z0-9_]+)\s*=\s*"(' + _DUR_PREFIX + r'[a-z0-9.]+)"',
-    re.MULTILINE)
-_DUR_LITERAL_RE = re.compile(
-    r'\.(?:get|get_int|get_float|get_boolean|get_list|must|must_int|'
-    r'must_float|must_list)\(\s*"(' + _DUR_PREFIX + r'[a-z0-9.]+)"')
-
-
-def _package_sources():
-    for root, _dirs, files in os.walk(PKG_DIR):
-        for fn in sorted(files):
-            if fn.endswith(".py"):
-                path = os.path.join(root, fn)
-                yield path, open(path).read()
-
-
-def _durability_keys():
-    keys = {}
-    for _path, text in _package_sources():
-        for m in _DUR_CONST_RE.finditer(text):
-            keys.setdefault(m.group(2), m.group(1))
-        for m in _DUR_LITERAL_RE.finditer(text):
-            keys.setdefault(m.group(1), None)
-    return keys
+_DUR_PREFIX = NAMESPACE_GROUPS["durability"]
 
 
 def test_durability_keys_are_constants_read_through_jobconfig():
-    keys = _durability_keys()
-    # the surface this PR wired must be visible to the lint at all
+    keys = collect_config_keys(corpus(), _DUR_PREFIX)
+    # the surface the durability PR wired must be visible to the lint
     for expected in ("checkpoint.keep", "checkpoint.fallback",
                      "io.require.success", "serve.poison.isolate",
                      "serve.poison.quarantine.threshold",
                      "serve.poison.cache.size"):
         assert expected in keys, f"{expected} not found (lint broken?)"
-    sources = list(_package_sources())
-    bad = []
-    for key, const in sorted(keys.items()):
-        if const is None:
-            bad.append((key, "no KEY_ constant binds this literal"))
-            continue
-        accessor = re.compile(
-            r"\.(?:get|get_int|get_float|get_boolean|get_list|must|"
-            r"must_int|must_float|must_list)\(\s*(?:\w+\.)?" + const + r"\b")
-        if not any(accessor.search(text) for _p, text in sources):
-            bad.append((key, f"{const} never read via a JobConfig accessor"))
-    assert not bad, f"durability config keys failing the lint: {bad}"
+    bad = [f for f in config_key_findings(corpus(), _DUR_PREFIX,
+                                          check_readme=False)]
+    assert not bad, _fmt(bad)
 
 
 def test_durability_keys_documented_in_readme():
-    readme = open(os.path.join(PKG_DIR, "..", "README.md")).read()
-    missing = [k for k in sorted(_durability_keys()) if k not in readme]
+    readme = corpus().readme
+    missing = [k for k in sorted(collect_config_keys(corpus(),
+                                                     _DUR_PREFIX))
+               if k not in readme]
     assert not missing, (
         f"durability config keys missing from README: {missing}")

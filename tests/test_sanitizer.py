@@ -1,0 +1,236 @@
+"""Runtime concurrency sanitizer (core/sanitizer.py): tracked-lock
+semantics, the lock-order graph, cycle detection, and the telemetry
+export of held durations."""
+
+import threading
+
+import pytest
+
+from avenir_tpu.core import sanitizer, telemetry
+from avenir_tpu.core.config import JobConfig
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    sanitizer.disable()
+    yield
+    sanitizer.disable()
+
+
+def test_disabled_factories_return_plain_primitives():
+    assert not sanitizer.enabled()
+    assert type(sanitizer.make_lock("x")) is type(threading.Lock())
+    assert isinstance(sanitizer.make_condition("x"), threading.Condition)
+    # plain RLock types differ across implementations: check behavior
+    rl = sanitizer.make_rlock("x")
+    assert rl.acquire() and rl.acquire()
+    rl.release()
+    rl.release()
+    # teardown helpers are no-ops while disabled
+    assert sanitizer.cycles() == []
+    assert sanitizer.assert_no_cycles() == {}
+
+
+def test_cycle_a_b_b_a_detected_and_raises():
+    """The satellite-required unit: construct an A->B / B->A
+    acquisition order and assert the teardown check detects the cycle
+    and names it."""
+    sanitizer.enable()
+    a = sanitizer.make_lock("A")
+    b = sanitizer.make_lock("B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    cycles = sanitizer.cycles()
+    assert cycles and set(cycles[0]) == {"A", "B"}
+    with pytest.raises(sanitizer.LockOrderCycle, match="A -> B|B -> A"):
+        sanitizer.assert_no_cycles()
+    # the check leaves the sanitizer on unless asked
+    assert sanitizer.enabled()
+    with pytest.raises(sanitizer.LockOrderCycle):
+        sanitizer.assert_no_cycles(disable_after=True)
+    assert not sanitizer.enabled()
+
+
+def test_consistent_order_is_clean():
+    sanitizer.enable()
+    a = sanitizer.make_lock("A")
+    b = sanitizer.make_lock("B")
+    for _ in range(100):
+        with a:
+            with b:
+                pass
+    stats = sanitizer.assert_no_cycles(disable_after=True)
+    assert stats["edges"] == {"A -> B": stats["edges"]["A -> B"]}
+    assert stats["edges"]["A -> B"]["count"] == 100
+    assert stats["locks"] == {"A": 100, "B": 100}
+
+
+def test_same_name_distinct_instances_nested_is_a_cycle():
+    """Ordering two same-class siblings by whichever a thread grabbed
+    first is a deadlock recipe: the self-edge fails the check."""
+    sanitizer.enable()
+    a1 = sanitizer.make_lock("sibling")
+    a2 = sanitizer.make_lock("sibling")
+    with a1:
+        with a2:
+            pass
+    assert sanitizer.cycles() == [["sibling", "sibling"]]
+    with pytest.raises(sanitizer.LockOrderCycle):
+        sanitizer.assert_no_cycles(disable_after=True)
+
+
+def test_reentrant_rlock_same_instance_is_not_an_edge():
+    sanitizer.enable()
+    rl = sanitizer.make_rlock("R")
+    with rl:
+        with rl:
+            pass
+    assert sanitizer.cycles() == []
+    stats = sanitizer.assert_no_cycles(disable_after=True)
+    assert stats["edges"] == {}
+
+
+def test_condition_is_reentrant_like_the_stock_default():
+    """threading.Condition() is RLock-backed; the sanitized condition
+    must keep those semantics — a helper re-entering `with cv:` while
+    the caller holds it is legal in production and must not hang (or
+    mis-count) under the sanitizer."""
+    sanitizer.enable()
+    cv = sanitizer.make_condition("reentrant.cv")
+    with cv:
+        with cv:                  # reentrant: must not deadlock
+            pass
+        # still owned after the inner exit: notify is legal
+        cv.notify_all()
+    stats = sanitizer.assert_no_cycles(disable_after=True)
+    # outermost-hold bookkeeping: one acquisition, no self-edge
+    assert stats["locks"] == {"reentrant.cv": 1}
+    assert stats["edges"] == {}
+
+
+def test_condition_wait_notify_under_tracked_lock():
+    sanitizer.enable()
+    cv = sanitizer.make_condition("cv")
+    hits = []
+
+    def waiter():
+        with cv:
+            while not hits:
+                cv.wait(timeout=1.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    with cv:
+        hits.append(1)
+        cv.notify_all()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    sanitizer.assert_no_cycles(disable_after=True)
+
+
+def test_cross_thread_edges_merge_into_one_graph():
+    """The graph is global: thread 1 records A->B, thread 2 records
+    B->A, and the CYCLE spans both threads — exactly the interleaving
+    a lucky run never hits."""
+    sanitizer.enable()
+    a = sanitizer.make_lock("A")
+    b = sanitizer.make_lock("B")
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    def t2():
+        with b:
+            with a:
+                pass
+
+    th1 = threading.Thread(target=t1)
+    th1.start()
+    th1.join()
+    th2 = threading.Thread(target=t2)
+    th2.start()
+    th2.join()
+    assert sanitizer.cycles()
+    with pytest.raises(sanitizer.LockOrderCycle):
+        sanitizer.assert_no_cycles(disable_after=True)
+
+
+def test_held_duration_histograms_export_through_telemetry():
+    sanitizer.enable()
+    lock = sanitizer.make_lock("unit.test.lock")
+    for _ in range(5):
+        with lock:
+            pass
+    sanitizer.assert_no_cycles(disable_after=True)
+    snap = telemetry.get_metrics().snapshot()
+    name = sanitizer.HELD_HIST_PREFIX + "unit.test.lock"
+    assert name in snap["histograms"]
+    assert snap["histograms"][name]["n"] >= 5
+    # and the mergeable form ships the same distribution
+    merge = telemetry.get_metrics().mergeable_snapshot()
+    assert name in merge["hists"]
+
+
+def test_configure_from_config_round_trip():
+    sanitizer.configure_from_config(
+        JobConfig({sanitizer.KEY_SANITIZE_LOCKS: "true"}))
+    assert sanitizer.enabled()
+    lock = sanitizer.make_lock("cfg")
+    assert isinstance(lock, sanitizer.TrackedLock)
+    sanitizer.configure_from_config(JobConfig({}))
+    assert not sanitizer.enabled()
+
+
+def test_tracked_lock_api_compat():
+    sanitizer.enable()
+    lock = sanitizer.make_lock("api")
+    assert lock.acquire() is True
+    assert lock.locked()
+    assert lock.acquire(blocking=False) is False   # held: non-blocking
+    lock.release()
+    assert not lock.locked()
+    sanitizer.assert_no_cycles(disable_after=True)
+
+
+def test_enable_resets_graph_between_runs():
+    sanitizer.enable()
+    a = sanitizer.make_lock("A")
+    b = sanitizer.make_lock("B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert sanitizer.cycles()
+    sanitizer.enable()           # fresh state
+    assert sanitizer.cycles() == []
+    sanitizer.assert_no_cycles(disable_after=True)
+
+
+def test_hammer_consistent_order_across_threads_stays_clean():
+    sanitizer.enable()
+    a = sanitizer.make_lock("outer")
+    b = sanitizer.make_lock("inner")
+    n = [0]
+
+    def spin():
+        for _ in range(300):
+            with a:
+                with b:
+                    n[0] += 1
+
+    threads = [threading.Thread(target=spin) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stats = sanitizer.assert_no_cycles(disable_after=True)
+    assert n[0] == 1800
+    assert stats["edges"]["outer -> inner"]["count"] == 1800
