@@ -43,6 +43,8 @@ from . import rules_drivers     # noqa: F401  (driver-* / foldspec-*)
 from . import rules_serve       # noqa: F401  (flight-anomaly, wire-identity)
 from . import rules_concurrency  # noqa: F401  (lock-discipline, thread-*)
 from . import rules_jax         # noqa: F401  (jax-hot-path, jax-bare-jit)
+from . import rules_algebra     # noqa: F401  (fold-purity, merge-closure,
+#                                              carry-portability)
 
 __all__ = ["Corpus", "Finding", "Rule", "RULES", "ExclusionRegistry",
            "all_rule_ids", "load_package_corpus", "run_rules"]

@@ -32,13 +32,18 @@ class SourceFile:
 class Corpus:
     """Every ``.py`` under one root, parsed once and shared by all
     rules.  ``readme`` is the documentation surface the config-key rule
-    checks (None = no README check)."""
+    checks (None = no README check).  ``parse_cache`` optionally maps a
+    per-file cache key (see :mod:`.cache`) to an already-parsed tree so
+    a warm run skips re-parsing unchanged files."""
 
-    def __init__(self, root: str, readme_path: Optional[str] = None):
+    def __init__(self, root: str, readme_path: Optional[str] = None,
+                 parse_cache: Optional[dict] = None):
         self.root = root
         self.readme_path = readme_path
         self.files: Dict[str, SourceFile] = {}
         self._readme: Optional[str] = None
+        self._dataflow: Optional["Dataflow"] = None
+        self.parsed_files = 0      # files actually ast.parse'd this load
         for dirpath, dirnames, filenames in os.walk(root):
             dirnames[:] = sorted(d for d in dirnames
                                  if d != "__pycache__")
@@ -49,8 +54,23 @@ class Corpus:
                 rel = os.path.relpath(path, root).replace(os.sep, "/")
                 with open(path) as fh:
                     text = fh.read()
-                self.files[rel] = SourceFile(
-                    rel, path, text, ast.parse(text, filename=path))
+                tree = None
+                if parse_cache is not None:
+                    cached = parse_cache.get(rel)
+                    if cached is not None and cached[0] == text:
+                        tree = cached[1]
+                if tree is None:
+                    tree = ast.parse(text, filename=path)
+                    self.parsed_files += 1
+                self.files[rel] = SourceFile(rel, path, text, tree)
+
+    def dataflow(self) -> "Dataflow":
+        """The corpus's interprocedural dataflow index, built once and
+        shared by every rule that needs reachability (fold-purity,
+        carry-portability)."""
+        if self._dataflow is None:
+            self._dataflow = Dataflow(self)
+        return self._dataflow
 
     @property
     def readme(self) -> str:
@@ -188,7 +208,9 @@ def run_rules(corpus: Corpus,
         findings.extend(got)
         per_rule.append({"rule": r.id, "findings": len(got),
                          "ms": round((time.monotonic() - rt0) * 1e3, 2)})
-    findings.sort(key=lambda f: (f.rule, f.file, f.line))
+    # deterministic (file, line, rule) order: reports diff stably across
+    # runs and machines, and a file's findings read top-to-bottom
+    findings.sort(key=lambda f: (f.file, f.line, f.rule, f.message))
     report = {"root": corpus.root,
               "files": len(corpus.files),
               "rules": per_rule,
@@ -230,6 +252,385 @@ class ScopedVisitor(ast.NodeVisitor):
         self.stack.pop()
 
     visit_AsyncFunctionDef = visit_FunctionDef
+
+
+# ---------------------------------------------------------------------------
+# the light interprocedural dataflow pass
+# ---------------------------------------------------------------------------
+
+#: attribute-call names too generic to resolve by name (they would edge
+#: into unrelated same-file classes: dict/list/str verbs, context hooks)
+_ATTR_STOPLIST = frozenset({
+    "get", "put", "set", "items", "keys", "values", "append", "add",
+    "pop", "update", "extend", "remove", "clear", "join", "split",
+    "strip", "format", "read", "write", "close", "open", "copy",
+    "start", "stop", "run", "wait", "notify", "acquire", "release",
+    "setdefault", "sort", "count", "index", "startswith", "endswith",
+})
+
+#: method names that mutate their receiver (a call ``G.append(...)`` on
+#: a module global marks the global mutable)
+_MUTATOR_METHODS = frozenset({
+    "append", "add", "pop", "update", "extend", "remove", "clear",
+    "setdefault", "insert", "popleft", "appendleft", "discard",
+})
+
+
+def dotted_name(node) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+class FunctionInfo:
+    """One function/method's def-use summary: the calls it makes, the
+    ``self.*`` attributes and module globals it reads/writes, and its
+    AST node (rules walk the body for their own site patterns)."""
+
+    __slots__ = ("rel", "qual", "node", "calls", "self_reads",
+                 "self_writes", "global_reads", "global_writes")
+
+    def __init__(self, rel: str, qual: str, node):
+        self.rel = rel
+        self.qual = qual
+        self.node = node
+        #: (kind, base, name) with kind in {bare, self, mod, attr}
+        self.calls: List[tuple] = []
+        self.self_reads: set = set()
+        self.self_writes: set = set()
+        self.global_reads: set = set()
+        self.global_writes: set = set()
+
+
+class _ModuleIndex:
+    """Per-module symbol tables feeding the call graph: functions by
+    qualname, classes with their method names, module globals (and the
+    mutable subset), and import resolution back into the corpus."""
+
+    def __init__(self, corpus: "Corpus", rel: str, tree):
+        self.rel = rel
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[str, set] = {}
+        self.class_lines: Dict[str, int] = {}
+        self.class_bases: Dict[str, List[str]] = {}
+        self.module_globals: set = set()
+        self.mutated_globals: set = set()
+        self.mutable_literal_globals: set = set()
+        self.escaped_globals: set = set()
+        self.mod_imports: Dict[str, str] = {}     # alias -> corpus rel
+        self.from_imports: Dict[str, tuple] = {}  # name -> (rel, orig)
+        self._collect_toplevel(tree)
+        self._collect_imports(corpus, tree)
+        self._collect_functions(tree)
+
+    def effectively_mutable_globals(self) -> set:
+        """Module globals whose reads are nondeterministic process
+        state: mutated in-module (rebind/subscript/mutator call), or
+        bound to a mutable container that escapes into a call (the
+        pass-by-reference cache idiom) — a read-only constant dict
+        stays pure."""
+        return self.mutated_globals | (self.mutable_literal_globals
+                                       & self.escaped_globals)
+
+    # -- import resolution -------------------------------------------------
+    def _resolve_rel(self, corpus, level: int, module: Optional[str],
+                     name: Optional[str] = None):
+        """Corpus rel path of a relative/absolute import target (the
+        module itself, or ``module/name`` when ``name`` is a submodule);
+        returns ``(rel_or_None, name_is_module)``."""
+        parts = self.rel.split("/")[:-1]          # importing pkg path
+        if level > 0:
+            base = parts[:len(parts) - (level - 1)] if level > 1 else parts
+        else:
+            mod_parts = (module or "").split(".")
+            # absolute import of this package: strip the package root
+            if mod_parts and mod_parts[0] == "avenir_tpu":
+                mod_parts = mod_parts[1:]
+                base = []
+                module = ".".join(mod_parts)
+            else:
+                return None, False
+        target = base + ([p for p in module.split(".") if p]
+                         if module else [])
+
+        def file_of(p):
+            for cand in ("/".join(p) + ".py",
+                         "/".join(p) + "/__init__.py" if p else None):
+                if cand and cand in corpus.files:
+                    return cand
+            return None
+
+        if name is not None:
+            sub = file_of(target + [name])
+            if sub is not None:
+                return sub, True
+        return file_of(target), False
+
+    def _collect_imports(self, corpus, tree) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ImportFrom):
+                continue
+            for alias in node.names:
+                local = alias.asname or alias.name
+                rel, is_mod = self._resolve_rel(
+                    corpus, node.level, node.module, alias.name)
+                if rel is None:
+                    continue
+                if is_mod:
+                    self.mod_imports[local] = rel
+                else:
+                    self.from_imports[local] = (rel, alias.name)
+
+    # -- symbol tables -----------------------------------------------------
+    def _collect_toplevel(self, tree) -> None:
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                methods = set()
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        methods.add(sub.name)
+                self.classes[node.name] = methods
+                self.class_lines[node.name] = node.lineno
+                self.class_bases[node.name] = [
+                    b for b in (dotted_name(base) for base in node.bases)
+                    if b]
+            elif isinstance(node, (ast.Assign, ast.AnnAssign,
+                                   ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                value = getattr(node, "value", None)
+                mutable = isinstance(value, (ast.Dict, ast.List,
+                                             ast.Set))
+                if isinstance(value, ast.Call):
+                    ctor = dotted_name(value.func) or ""
+                    mutable = ctor.rsplit(".", 1)[-1] in (
+                        "dict", "list", "set", "deque", "defaultdict",
+                        "OrderedDict", "Counter")
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        self.module_globals.add(t.id)
+                        if mutable:
+                            self.mutable_literal_globals.add(t.id)
+
+    def _collect_functions(self, tree) -> None:
+        idx = self
+
+        class Walk(ScopedVisitor):
+            def __init__(self):
+                super().__init__()
+                self.fn_stack: List[FunctionInfo] = []
+
+            def visit_ClassDef(self, node):
+                # class BODIES execute at import time: statements like
+                # `LANES = jax.device_count()` must be visible to the
+                # reachability rules, so each class gets a synthetic
+                # `<Cls>.<class>` scope (methods stay separate nodes —
+                # defining one is not calling one)
+                self.stack.append(node.name)
+                info = FunctionInfo(idx.rel,
+                                    f"{self.qual()}.<class>", node)
+                idx.functions[info.qual] = info
+                self.fn_stack.append(info)
+                self.generic_visit(node)
+                self.fn_stack.pop()
+                self.stack.pop()
+
+            def visit_FunctionDef(self, node):
+                self.stack.append(node.name)
+                info = FunctionInfo(idx.rel, self.qual(), node)
+                idx.functions[info.qual] = info
+                if (self.fn_stack
+                        and not self.fn_stack[-1].qual.endswith(
+                            ".<class>")):
+                    # lexically nested defs run in the parent's context
+                    # (callbacks, closures): an implicit call edge keeps
+                    # them reachable whenever the parent is
+                    self.fn_stack[-1].calls.append(
+                        ("nested", None, info.qual))
+                self.fn_stack.append(info)
+                self.generic_visit(node)
+                self.fn_stack.pop()
+                self.stack.pop()
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def _info(self):
+                return self.fn_stack[-1] if self.fn_stack else None
+
+            def visit_Global(self, node):
+                info = self._info()
+                if info is not None:
+                    info.global_writes.update(node.names)
+                    idx.mutated_globals.update(node.names)
+                self.generic_visit(node)
+
+            def visit_Attribute(self, node):
+                info = self._info()
+                if (info is not None and isinstance(node.value, ast.Name)
+                        and node.value.id == "self"):
+                    if isinstance(node.ctx, ast.Load):
+                        info.self_reads.add(node.attr)
+                    else:
+                        info.self_writes.add(node.attr)
+                self.generic_visit(node)
+
+            def visit_Name(self, node):
+                info = self._info()
+                if info is not None and node.id in idx.module_globals:
+                    if isinstance(node.ctx, ast.Load):
+                        info.global_reads.add(node.id)
+                    else:
+                        info.global_writes.add(node.id)
+                        idx.mutated_globals.add(node.id)
+                self.generic_visit(node)
+
+            def visit_Subscript(self, node):
+                # G[k] = v / del G[k] on a module global mutates it
+                if (not isinstance(node.ctx, ast.Load)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id in idx.module_globals):
+                    idx.mutated_globals.add(node.value.id)
+                self.generic_visit(node)
+
+            def visit_Call(self, node):
+                info = self._info()
+                fn = node.func
+                for arg in node.args:
+                    # a module global handed to a call escapes: the
+                    # callee may mutate the container (the pass-by-
+                    # reference cache idiom)
+                    if (isinstance(arg, ast.Name)
+                            and arg.id in idx.module_globals):
+                        idx.escaped_globals.add(arg.id)
+                if info is not None:
+                    if isinstance(fn, ast.Name):
+                        info.calls.append(("bare", None, fn.id))
+                    elif isinstance(fn, ast.Attribute):
+                        base = fn.value
+                        if isinstance(base, ast.Name):
+                            if base.id == "self":
+                                info.calls.append(("self", None, fn.attr))
+                            else:
+                                info.calls.append(("mod", base.id,
+                                                   fn.attr))
+                                # G.append(...) on a module global
+                                if (base.id in idx.module_globals
+                                        and fn.attr in _MUTATOR_METHODS):
+                                    idx.mutated_globals.add(base.id)
+                        else:
+                            info.calls.append(("attr", None, fn.attr))
+                self.generic_visit(node)
+
+        Walk().visit(tree)
+
+
+class Dataflow:
+    """The corpus-wide call graph + def-use index: per-function
+    summaries (:class:`FunctionInfo`) and one-level call resolution —
+    bare names to same-module functions/classes and from-imported corpus
+    functions, ``self.m`` to the enclosing class, ``alias.f`` through
+    resolved module imports, and ``obj.m`` by unique method name within
+    the module (a deliberate over-approximation; generic verbs on
+    :data:`_ATTR_STOPLIST` never resolve).  :meth:`reachable` closes
+    over those edges — the substrate for the distributed-readiness
+    rules (fold-purity, carry-portability)."""
+
+    def __init__(self, corpus: "Corpus"):
+        self.corpus = corpus
+        self.modules: Dict[str, _ModuleIndex] = {
+            rel: _ModuleIndex(corpus, rel, sf.tree)
+            for rel, sf in corpus.items()}
+        self._callees: Dict[tuple, set] = {}
+
+    def function(self, rel: str, qual: str) -> Optional[FunctionInfo]:
+        idx = self.modules.get(rel)
+        return idx.functions.get(qual) if idx else None
+
+    def expand_prefixes(self, rel: str,
+                        prefixes: Sequence[str]) -> List[tuple]:
+        """Every (rel, qual) whose qualname equals a prefix or nests
+        under it (``prefix.<inner>``)."""
+        idx = self.modules.get(rel)
+        if idx is None:
+            return []
+        out = []
+        for qual in idx.functions:
+            for p in prefixes:
+                if qual == p or qual.startswith(p + "."):
+                    out.append((rel, qual))
+                    break
+        return out
+
+    def callees(self, key: tuple) -> set:
+        if key in self._callees:
+            return self._callees[key]
+        rel, qual = key
+        idx = self.modules.get(rel)
+        info = idx.functions.get(qual) if idx else None
+        out: set = set()
+        if info is not None:
+            cls = qual.split(".")[0] if "." in qual else None
+            for kind, base, name in info.calls:
+                if kind == "nested":
+                    out.add((rel, name))
+                elif kind == "self" and cls in idx.classes:
+                    if name in idx.classes[cls]:
+                        out.add((rel, f"{cls}.{name}"))
+                elif kind == "bare":
+                    if name in idx.functions:
+                        out.add((rel, name))
+                    elif (name in idx.classes
+                          and "__init__" in idx.classes[name]):
+                        out.add((rel, f"{name}.__init__"))
+                    elif name in idx.from_imports:
+                        trel, orig = idx.from_imports[name]
+                        tidx = self.modules.get(trel)
+                        if tidx and orig in tidx.functions:
+                            out.add((trel, orig))
+                        elif (tidx and orig in tidx.classes
+                              and "__init__" in tidx.classes[orig]):
+                            out.add((trel, f"{orig}.__init__"))
+                elif kind == "mod":
+                    if base in idx.mod_imports:
+                        trel = idx.mod_imports[base]
+                        tidx = self.modules.get(trel)
+                        if tidx and name in tidx.functions:
+                            out.add((trel, name))
+                elif kind == "attr" and name not in _ATTR_STOPLIST:
+                    owners = [c for c, ms in idx.classes.items()
+                              if name in ms]
+                    if len(owners) == 1:
+                        out.add((rel, f"{owners[0]}.{name}"))
+        self._callees[key] = out
+        return out
+
+    def reachable(self, roots: Sequence[tuple],
+                  max_depth: Optional[int] = None) -> set:
+        """BFS closure of (rel, qual) keys over resolved call edges
+        (``max_depth`` bounds the hop count from the roots; None =
+        transitive closure)."""
+        seen = set()
+        frontier = [r for r in roots
+                    if self.function(*r) is not None]
+        seen.update(frontier)
+        depth = 0
+        while frontier and (max_depth is None or depth < max_depth):
+            nxt = []
+            for key in frontier:
+                for callee in self.callees(key):
+                    if callee not in seen:
+                        seen.add(callee)
+                        nxt.append(callee)
+            frontier = nxt
+            depth += 1
+        return seen
 
 
 def enclosing_scope_source(text: str, lineno: int, tree=None) -> str:

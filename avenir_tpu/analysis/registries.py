@@ -113,3 +113,105 @@ HOST_SYNC_ALLOWED: Dict[str, str] = {
 #: thread-lifecycle opt-outs: ``module.py:Qual`` (the scope creating the
 #: Thread) -> why the thread needs neither a daemon flag nor a join.
 UNMANAGED_THREADS: Dict[str, str] = {}
+
+
+# ---------------------------------------------------------------------------
+# the distributed-readiness registries (fold-algebra rule family)
+# ---------------------------------------------------------------------------
+
+#: fold-purity opt-outs: ``module.py:Qual:token`` (token = the impure
+#: call's dotted name, or ``global:<name>`` for a mutable-global read)
+#: -> why the host-local nondeterminism cannot diverge fold OUTPUT
+#: across hosts.  Everything here is observability bookkeeping or a
+#: deterministic memo — none of it flows into a fold carry or an
+#: emitted artifact byte.
+FOLD_IMPURE_ALLOWED: Dict[str, str] = {
+    "core/pipeline.py:_fold_fns:global:_fold_cache":
+        "deterministic compile memo: the key (local_fn, mesh, static "
+        "args, shapes) fully determines the cached executables, so a "
+        "hit and a rebuild produce identical folds; eviction only costs "
+        "a recompile",
+    "core/telemetry.py:sample_device_memory:time.monotonic":
+        "rate-limit clock for the device.hbm.bytes observability gauge; "
+        "the sampled value feeds telemetry only, never a fold carry or "
+        "output line",
+    "core/telemetry.py:sample_device_memory:global:_DEVICE_SAMPLE":
+        "rate-limiter bookkeeping for the same observability gauge "
+        "(last-sample timestamp + interval); no data-path effect",
+    "core/telemetry.py:profiled_jit.wrapped:time.perf_counter_ns":
+        "XLA compile-time billing (the Telemetry/xla.compile.ms "
+        "counter): wall time measured around the jitted call is "
+        "observability, never fold data",
+    "core/telemetry.py:get_metrics:global:_GLOBAL_METRICS":
+        "the process-global metrics registry read: every write through "
+        "it is a counter/gauge/histogram sample, never fold data",
+    "core/obs.py:get_tracer:global:_GLOBAL_TRACER":
+        "the process-global tracer handle: spans/gauges recorded "
+        "through it are observability; fold outputs never read it",
+    "native/__init__.py:get_lib:global:_lib":
+        "lazily-built native CSV kernel handle: byte-parity between the "
+        "native and Python encode paths is asserted by the ingest "
+        "tests, so host-varying availability cannot change output",
+    "native/__init__.py:get_lib:global:_lib_failed":
+        "same native-kernel handle bookkeeping: a host where the build "
+        "fails falls back to the byte-identical Python encode path",
+    "core/faultinject.py:get_injector:global:_INJECTOR":
+        "seeded, config-driven fault-injection plan (test tooling): "
+        "deterministic per configuration, and empty in production",
+    "core/flight.py:trigger:global:_GLOBAL_RECORDER":
+        "flight-recorder anomaly hook: dump-on-anomaly bookkeeping, "
+        "write-only from the fold path's perspective",
+    "core/io.py:validate_artifact_dir:global:_REQUIRE_SUCCESS":
+        "io.require.success strict-mode flag, set once by the CLI "
+        "before any engine runs; identical across hosts by the shared "
+        "job config",
+    "core/io.py:validate_artifact_dir:global:_VALIDATED":
+        "manifest-validation memo keyed (dir, stat): a hit and a "
+        "re-validation return the same verdict for the same bytes",
+    "core/io.py:read_lines:global:_ARTIFACTS":
+        "the in-memory ArtifactStore overlay (DAG stage handoff): the "
+        "first memory read is asserted byte-identical to the file "
+        "round-trip, so overlay presence cannot change consumed bytes",
+    "core/io.py:write_output:global:_ARTIFACTS":
+        "same ArtifactStore overlay on the write side: registered "
+        "outputs also record in memory; bytes written are unchanged",
+    "core/resilience.py:with_retries:global:_POLICY":
+        "retry policy (backoff shape) configured once at CLI startup; "
+        "retries re-execute the same read, they never alter its result",
+    "core/sanitizer.py:make_lock:global:_STATE":
+        "lock-sanitizer enablement flag read at lock construction; "
+        "tracked vs plain locks behave identically for data",
+}
+
+#: merge-closure opt-outs: class names exporting ``state_dict`` whose
+#: state is DELIBERATELY not a mergeable snapshot type.
+MERGE_EXEMPT: Dict[str, str] = {
+    "CircuitBreaker":
+        "state_dict is a per-replica health-report surface (the serve "
+        "`health`/`stats` commands), not a cross-process snapshot: "
+        "breaker state is local by design — merging two replicas' trip "
+        "counts would manufacture a breaker no replica is actually in",
+}
+
+#: carry-portability opt-outs: ``module.py:Qual:token`` -> why this
+#: host-topology read inside carry-producing code cannot bake a
+#: host-count-dependent value into a fold carry or checkpoint.
+HOST_TOPOLOGY_ALLOWED: Dict[str, str] = {
+    "parallel/mesh.py:make_mesh:jax.devices":
+        "mesh construction IS the topology surface: the mesh shapes "
+        "how a fold executes, while carries stay replicated pytrees "
+        "whose dtype/shape derive from data caps, not device count — "
+        "asserted by the mesh1-vs-mesh8 byte-parity suite and the "
+        "split-invariance verifier (core.algebra)",
+    "parallel/mesh.py:get_mesh:jax.devices":
+        "default-mesh staleness check (device count changed under a "
+        "test fixture): same argument as make_mesh — the mesh is "
+        "execution shape, not carry content",
+    "parallel/mesh.py:_mesh_from_env:jax.devices":
+        "device count quoted in the AVENIR_MESH validation error "
+        "message only; the mesh shape itself is operator config",
+    "core/telemetry.py:sample_device_memory:jax.devices":
+        "device-memory residency sampling for the device.hbm.bytes "
+        "gauge: reads per-device stats into telemetry, writes nothing "
+        "into carries or checkpoints",
+}

@@ -47,6 +47,7 @@ RAW_ATTR_CALLS = {
 #: quals that ARE the atomic publish layer (writes inside them stage to
 #: a temp path and land via fsync + os.replace)
 ATOMIC_PRIMITIVES = ("core/io.py:atomic_write_text",
+                     "core/io.py:atomic_write_bytes",
                      "core/io.py:OutputWriter.")
 
 

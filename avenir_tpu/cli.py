@@ -307,6 +307,8 @@ def main(argv=None) -> int:
               file=sys.stderr)
         print("       python -m avenir_tpu analyze [--strict] [--json report.json] [--rules a,b] [--list]",
               file=sys.stderr)
+        print("                                    [--dynamic] [--seeds N] [--baseline findings.json] [--update-baseline] [--no-cache]",
+              file=sys.stderr)
         print("known jobs:\n  " + "\n  ".join(sorted(JOBS)), file=sys.stderr)
         return 2
 

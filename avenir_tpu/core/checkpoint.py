@@ -172,6 +172,42 @@ def input_fingerprint(path: str) -> Dict[str, Any]:
     return {"parts": parts, "head_sha1": h.hexdigest()}
 
 
+class CarryNotPortable(ValueError):
+    """A fold carry offered for checkpointing holds a non-host leaf
+    (e.g. a live ``jax.Array``): pickling it would bake device/topology
+    state into the sidecar, so a resume on a different host or pod
+    shape could not replay it.  Raised at SAVE time naming the leaf —
+    the runtime twin of the static carry-portability rule."""
+
+
+def assert_portable_carry(carry: Any, context: str = "carry") -> Any:
+    """Validate that every leaf of a carry pytree is host-portable
+    (numpy arrays / Python scalars / None): the save path's guard that
+    a checkpoint written on this host resumes on ANY host."""
+    import numpy as _np
+
+    def walk(obj, path):
+        if obj is None or isinstance(obj, (bool, int, float, str, bytes,
+                                           _np.generic, _np.ndarray)):
+            return
+        if isinstance(obj, dict):
+            for k, v in obj.items():
+                walk(v, f"{path}[{k!r}]")
+            return
+        if isinstance(obj, (list, tuple)):
+            for i, v in enumerate(obj):
+                walk(v, f"{path}[{i}]")
+            return
+        raise CarryNotPortable(
+            f"{context}: non-host leaf {type(obj).__module__}."
+            f"{type(obj).__name__} at {path} — materialize to host "
+            f"numpy before checkpointing (device arrays bake host "
+            f"topology into the sidecar)")
+
+    walk(carry, context)
+    return carry
+
+
 class CheckpointToken:
     """One checkpoint-due marker, created on the PRODUCER side: the
     chunk index/end-offset plus the host stream state pickled at capture
@@ -256,7 +292,8 @@ class StreamCheckpointer:
             "chunk_index": token.chunk_index,
             "offset": token.offset,
             "state": token.state_bytes,
-            "carry": carry,
+            "carry": assert_portable_carry(
+                carry, context=f"{self.kind} checkpoint carry"),
             "extra": dict(extra or {}),
         }
         d = os.path.dirname(os.path.abspath(self.path))

@@ -200,6 +200,19 @@ class ChunkContext:
         return dsc.x, dsc.values, dsc.y, dsc.n_rows
 
 
+def merge_carries(a, b):
+    """The fold carry's monoid merge: elementwise add over the carry
+    pytree.  This is EXACTLY the reduction the multi-host port performs
+    (per-host partial folds combined by ``psum`` over ICI — ROADMAP
+    item 1), so the split-invariance verifier (:mod:`core.algebra`)
+    asserts ``finalize(merge_carries(fold(A), fold(B))) ==
+    finalize(fold(A ++ B))`` for every registered FoldSpec before any
+    host ever trusts it."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda x, y: x + y, a, b)
+
+
 class _SpecFailure:
     __slots__ = ("spec", "reason")
 

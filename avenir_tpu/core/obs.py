@@ -568,7 +568,12 @@ class LatencyHistogram:
         self._lock = threading.Lock()
 
     # -- recording ---------------------------------------------------------
-    def record(self, seconds: float, trace_id: Optional[str] = None) -> None:
+    def record(self, seconds: float, trace_id: Optional[str] = None,
+               ts: Optional[float] = None) -> None:
+        """Record one sample; ``ts`` overrides the exemplar's epoch
+        stamp (deterministic replay — the split-invariance verifier
+        feeds explicit stamps so merge properties are exact, and a
+        cross-process replayer can preserve original times)."""
         s = float(seconds)
         i = bisect.bisect_right(self.bounds, s)
         with self._lock:
@@ -580,7 +585,16 @@ class LatencyHistogram:
             if s > self.vmax:
                 self.vmax = s
             if trace_id is not None:
-                self.exemplars[i] = (str(trace_id), s, time.time())
+                e = (str(trace_id), s,
+                     time.time() if ts is None else float(ts))
+                cur = self.exemplars.get(i)
+                # SAME retention rule as merge ((ts, trace_id, value)
+                # max): a single histogram and a sharded-then-merged
+                # one agree exactly even when a replayer stamps ts out
+                # of order — the merge==single-run property is exact
+                if cur is None or (e[2], e[0], e[1]) > (cur[2], cur[0],
+                                                        cur[1]):
+                    self.exemplars[i] = e
 
     def record_ns(self, ns: int, trace_id: Optional[str] = None) -> None:
         self.record(ns * 1e-9, trace_id=trace_id)
@@ -610,7 +624,12 @@ class LatencyHistogram:
             self.vmax = max(self.vmax, vmax)
             for i, e in ex.items():
                 cur = self.exemplars.get(i)
-                if cur is None or e[2] >= cur[2]:
+                # (ts, trace_id, value) ordering: exact-ts ties break on
+                # content, not merge side, so merge stays commutative
+                # (the split-invariance verifier's property)
+                if cur is None or (e[2], str(e[0]), e[1]) > (cur[2],
+                                                             str(cur[0]),
+                                                             cur[1]):
                     self.exemplars[i] = e
         return self
 

@@ -209,6 +209,9 @@ NON_RETRYABLE: Dict[str, str] = {
         "failed write must fail the producing job loudly; retrying a "
         "rename-landing write risks publishing a half-regenerated "
         "artifact as current",
+    "core/io.py:atomic_write_bytes":
+        "binary twin of atomic_write_text (the analysis parse-cache "
+        "sidecar): same publish contract, same fail-loud argument",
     "core/io.py:_sha1_file":
         "manifest checksum validation read: runs at artifact-load time "
         "next to the fail-fast read_lines reads of the same files; a "

@@ -137,11 +137,18 @@ def merge_exemplar_states(a: Optional[dict], b: Optional[dict]) -> dict:
     """Latest-timestamp-wins per-bucket merge of two ``state_dict``-form
     exemplar maps (``{bucket: {"trace_id", "value", "ts"}}``) — the ONE
     rule, shared by snapshot merging here and the replica-pool histogram
-    aggregation (serve.pool.merged_hist_state)."""
+    aggregation (serve.pool.merged_hist_state).
+
+    Exact-timestamp ties break on (trace_id, value), NOT insertion side:
+    the old ``b wins ties`` rule made the merge order-dependent when two
+    processes stamped the same clock value, which the split-invariance
+    verifier (core.algebra) flags as a commutativity violation."""
     out = dict(a or {})
     for i, e in (b or {}).items():
         cur = out.get(i)
-        if cur is None or e["ts"] >= cur["ts"]:
+        if cur is None or ((e["ts"], str(e["trace_id"]), e["value"])
+                           > (cur["ts"], str(cur["trace_id"]),
+                              cur["value"])):
             out[i] = e
     return out
 
@@ -172,6 +179,25 @@ def _merge_hist_state(a: dict, b: dict) -> dict:
     return out
 
 
+#: snapshot sections DELIBERATELY absent from a merged snapshot, with
+#: the reason — the merge-closure rule (avenir-analyze) fails on any
+#: section the builders write that is neither merged nor listed here,
+#: so a new snapshot field can never be silently dropped by the
+#: multi-host fold.
+SNAPSHOT_NON_MERGED: Dict[str, str] = {
+    "pid":
+        "process identity: a merged snapshot spans processes by "
+        "definition, so carrying one pid forward would be a lie — "
+        "consumers needing lineage read the per-process JSONL lines",
+}
+
+#: every top-level section merge_snapshots knows how to carry; an input
+#: section outside this set (and SNAPSHOT_NON_MERGED) raises so schema
+#: growth is loud at the merge point too, not only in static analysis
+SNAPSHOT_SECTIONS = frozenset(
+    {"v", "ts", "mono", "counters", "gauges", "hists", "spans"})
+
+
 def merge_snapshots(a: dict, b: dict) -> dict:
     """Fold two mergeable snapshots into one: counters sum, histogram
     buckets add, gauges latest-timestamp-wins (value breaks exact-ts
@@ -181,7 +207,21 @@ def merge_snapshots(a: dict, b: dict) -> dict:
     (asserted in tests/test_telemetry.py) — multi-host aggregation is
     ``functools.reduce(merge_snapshots, snaps)`` over ONE snapshot per
     process (each JSONL line is cumulative for its process, so fold
-    each process's latest line, not the whole series)."""
+    each process's latest line, not the whole series).
+
+    An unknown top-level section in either input raises ``ValueError``
+    naming the field: silently dropping a section a newer writer added
+    is exactly the corruption mode the merge-closure rule exists to
+    prevent, and the runtime guard keeps mixed-version fleets honest.
+    """
+    for snap in (a, b):
+        unknown = sorted(set(snap) - SNAPSHOT_SECTIONS
+                         - set(SNAPSHOT_NON_MERGED))
+        if unknown:
+            raise ValueError(
+                f"merge_snapshots: unknown snapshot section(s) "
+                f"{unknown} — extend the merge (and SNAPSHOT_SECTIONS) "
+                f"or document the drop in SNAPSHOT_NON_MERGED")
     counters: Dict[str, Dict[str, int]] = {}
     for snap in (a, b):
         for g, names in (snap.get("counters") or {}).items():

@@ -684,7 +684,14 @@ class _NBFoldSpec(MultiScanFoldSpec):
     same schema file), folds ``_nb_local`` count tables on device, and
     finalizes to the normal model file.  Fold arrays stay un-narrowed so
     they are identical objects to a sharing job's (the int8 transfer
-    narrowing would fork a private copy per job)."""
+    narrowing would fork a private copy per job).
+
+  Split invariance (fold(A ++ B) == merge_carries(fold(A),
+    fold(B)), any chunk boundaries/order) is property-tested at
+    mesh=1 and 8-way by the fold-algebra verifier
+    (core.algebra, tests/test_algebra.py) — the ROADMAP-1
+    multi-host psum contract this spec must keep.
+    """
 
     def __init__(self, job: "BayesianDistribution", out_path: str):
         self.job = job

@@ -228,7 +228,14 @@ class _CatCorrFoldSpec(MultiScanFoldSpec):
     indices and fold one ``count_table`` scatter; finalize reduces each
     pair's matrix with the job's statistic.  An attribute value outside
     the declared cardinality withdraws the spec (the standalone re-run
-    then raises the same KeyError a standalone workflow would)."""
+    then raises the same KeyError a standalone workflow would).
+
+  Split invariance (fold(A ++ B) == merge_carries(fold(A),
+    fold(B)), any chunk boundaries/order) is property-tested at
+    mesh=1 and 8-way by the fold-algebra verifier
+    (core.algebra, tests/test_algebra.py) — the ROADMAP-1
+    multi-host psum contract this spec must keep.
+    """
 
     def __init__(self, job: CategoricalCorrelation, out_path: str):
         self.job = job

@@ -104,7 +104,14 @@ class _StatsFoldSpec(MultiScanFoldSpec):
     columns — tiny next to the CSV the scan no longer re-reads);
     finalize concatenates and emits through the exact same
     ``_moment_rows`` math as a standalone run, so output is
-    byte-identical (same full-array summation order)."""
+    byte-identical (same full-array summation order).
+
+  Split invariance (fold(A ++ B) == merge_carries(fold(A),
+    fold(B)), any chunk boundaries/order) is property-tested at
+    mesh=1 and 8-way by the fold-algebra verifier
+    (core.algebra, tests/test_algebra.py) — the ROADMAP-1
+    multi-host psum contract this spec must keep.
+    """
 
     local_fn = None
 

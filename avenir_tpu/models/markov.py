@@ -294,7 +294,14 @@ class _MarkovFoldSpec(MultiScanFoldSpec):
     class) pair streams (variable length -> power-of-two buckets, so
     ``fixed_capacity`` is False) folded by ``_markov_pair_local``; class
     labels are discovered in input order exactly like the standalone
-    paths, with the same first-chunk class cap + fallback contract."""
+    paths, with the same first-chunk class cap + fallback contract.
+
+  Split invariance (fold(A ++ B) == merge_carries(fold(A),
+    fold(B)), any chunk boundaries/order) is property-tested at
+    mesh=1 and 8-way by the fold-algebra verifier
+    (core.algebra, tests/test_algebra.py) — the ROADMAP-1
+    multi-host psum contract this spec must keep.
+    """
 
     fixed_capacity = False
 

@@ -594,7 +594,14 @@ class _MIFoldSpec(MultiScanFoldSpec):
     """Shared-scan FoldSpec for MutualInformation: shares the schema
     encode (and H2D copy) with co-registered jobs on the same schema
     file, folds both distribution tables on device, finalizes to the
-    normal distributions/MI/scores output file."""
+    normal distributions/MI/scores output file.
+
+  Split invariance (fold(A ++ B) == merge_carries(fold(A),
+    fold(B)), any chunk boundaries/order) is property-tested at
+    mesh=1 and 8-way by the fold-algebra verifier
+    (core.algebra, tests/test_algebra.py) — the ROADMAP-1
+    multi-host psum contract this spec must keep.
+    """
 
     def __init__(self, job: "MutualInformation", out_path: str):
         self.job = job

@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# Static-analysis runbook: the incremental analyze gate, the baseline
+# RATCHET workflow (land a new rule before its cleanups finish), and
+# the dynamic fold-algebra verification (README "Static analysis &
+# sanitizers").
+set -euo pipefail
+cd "$(dirname "$0")"
+PY=${PYTHON:-python}
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+rm -rf work && mkdir -p work
+
+echo "== 1. cold strict analyze (parses everything, ~4 s) =="
+time $PY -m avenir_tpu analyze --strict --no-cache --json work/report.json
+
+echo
+echo "== 2. warm incremental analyze (sidecar replay, sub-second) =="
+time $PY -m avenir_tpu analyze --strict --json work/report-warm.json
+$PY - <<'EOF'
+import json
+rep = json.load(open("work/report-warm.json"))
+print(f"cached={rep.get('cached')}  duration_ms={rep['duration_ms']}  "
+      f"(cold was {rep.get('cold_duration_ms')} ms)")
+slowest = sorted(rep["rules"], key=lambda r: -r["ms"])[:3]
+print("slowest rules:", [(r["rule"], r["ms"]) for r in slowest])
+EOF
+
+echo
+echo "== 3. the baseline ratchet workflow =="
+# Scenario: a new rule lands and flags pre-existing sites you cannot
+# clean up in the same PR.  Commit the findings as a baseline; CI then
+# fails only on NEW findings, and cleanups shrink the baseline.
+$PY -m avenir_tpu analyze --baseline work/findings-baseline.json --update-baseline
+echo "-- baseline committed; strict gate now diffs against it:"
+$PY -m avenir_tpu analyze --strict --baseline work/findings-baseline.json
+echo "-- ratchet gate passed (no NEW findings)"
+
+echo
+echo "== 4. dynamic fold-algebra verification (split invariance) =="
+# Property-tests every registered FoldSpec: fold(A ++ B) == the fold
+# over randomized split points == merge_carries of two partial folds,
+# plus merge_snapshots/LatencyHistogram.merge monoid checks.  The
+# certificate behind the multi-host port (ROADMAP-1).
+$PY -m avenir_tpu analyze --dynamic --seeds 2 --rules fold-purity,merge-closure,carry-portability
+
+echo
+echo "analysis runbook complete"

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Runbook-sized smoke of the CI gate: strict incremental analyze plus
+# the analysis/algebra/sanitizer test modules (~1 min).  Real CI runs
+# `resource/ci/check.sh` bare — same gates, full tier-1 suite.
+set -euo pipefail
+cd "$(dirname "$0")"
+exec bash check.sh -k "analysis or algebra or sanitizer"

@@ -1,0 +1,284 @@
+"""Runtime fold-algebra verification (core/algebra): split invariance,
+carry merge (the psum claim), and chunk-permutation invariance for
+every registered FoldSpec at mesh=1 and 8-way under 3 seeds; merge
+properties (merge == single-run, commutativity, associativity) for
+``merge_snapshots`` and ``LatencyHistogram.merge``; the shrink-on-
+failure reproducer; the carry-portability runtime guard; and the
+regressions for the genuine findings this PR fixed (exemplar tie-break
+commutativity, merge_snapshots unknown-section drop)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core import algebra, telemetry
+from avenir_tpu.core import multiscan
+from avenir_tpu.core.metrics import Counters
+from avenir_tpu.core.obs import LatencyHistogram, Metrics
+
+JIDS = ["nb", "mi", "corr", "het", "mst", "stats"]
+ROWS = algebra.verification_rows()
+
+
+@pytest.fixture(scope="module")
+def work_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("algebra"))
+    algebra.verification_jobs(d)        # writes the schema files once
+    return d
+
+
+# ---------------------------------------------------------------------------
+# the acceptance matrix: every registered spec, both meshes, 3 seeds
+# ---------------------------------------------------------------------------
+
+def _assert_clean(reports, n_seeds):
+    assert len(reports) == n_seeds
+    for r in reports:
+        assert r.withdrawn is None, r.format()
+        assert not r.failed, r.format()
+        assert [c.name for c in r.checks] == [
+            "split-invariance", "carry-merge", "chunk-permutation"]
+        assert r.splits, "no split points were exercised"
+
+
+@pytest.mark.parametrize("jid", JIDS)
+def test_split_invariance_mesh8(work_dir, mesh8, jid):
+    reps = algebra.verify_fold_spec(
+        algebra.spec_factory(jid, work_dir), ROWS, mesh8,
+        seeds=algebra.DEFAULT_SEEDS, spec_name=jid)
+    _assert_clean(reps, len(algebra.DEFAULT_SEEDS))
+
+
+@pytest.mark.parametrize("jid", JIDS)
+def test_split_invariance_mesh1(work_dir, mesh1, jid):
+    reps = algebra.verify_fold_spec(
+        algebra.spec_factory(jid, work_dir), ROWS, mesh1,
+        seeds=algebra.DEFAULT_SEEDS, spec_name=jid)
+    _assert_clean(reps, len(algebra.DEFAULT_SEEDS))
+
+
+def test_every_foldspec_exporter_has_verification_workload(tmp_path):
+    """Coverage closure: a NEW FoldSpec exporter must gain a canned
+    verification workload or the dynamic gate fails loudly."""
+    jobs = algebra.verification_jobs(str(tmp_path))
+    covered = {cls for cls, _ in jobs.values()}
+    exporters = set(algebra.registered_exporters())
+    assert exporters <= covered, (
+        f"FoldSpec exporter(s) without a verification workload: "
+        f"{sorted(exporters - covered)} — add to "
+        f"core.algebra.verification_jobs")
+
+
+# ---------------------------------------------------------------------------
+# snapshot / histogram merge properties
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", algebra.DEFAULT_SEEDS)
+def test_snapshot_merge_properties(seed):
+    rep = algebra.verify_snapshot_merge(seed)
+    assert not rep.failed, rep.format()
+    assert [c.name for c in rep.checks] == [
+        "merge == single-run", "commutativity", "associativity"]
+
+
+@pytest.mark.parametrize("seed", algebra.DEFAULT_SEEDS)
+def test_histogram_merge_properties(seed):
+    rep = algebra.verify_histogram_merge(seed)
+    assert not rep.failed, rep.format()
+
+
+# ---------------------------------------------------------------------------
+# regressions for the genuine findings the verifier surfaced
+# ---------------------------------------------------------------------------
+
+def test_exemplar_state_merge_tie_break_commutative():
+    """merge_exemplar_states used `b wins ties`: two processes stamping
+    the same epoch value made the merge order-dependent.  Ties now
+    break on content."""
+    a = {"3": {"trace_id": "aaa", "value": 1.0, "ts": 100.0}}
+    b = {"3": {"trace_id": "bbb", "value": 1.0, "ts": 100.0}}
+    ab = telemetry.merge_exemplar_states(a, b)
+    ba = telemetry.merge_exemplar_states(b, a)
+    assert ab == ba
+    assert ab["3"]["trace_id"] == "bbb"     # (ts, trace_id, value) max
+
+
+def test_histogram_exemplar_merge_tie_break_commutative():
+    """Same fix on the live-histogram merge path."""
+    def make(trace):
+        h = LatencyHistogram()
+        h.record(0.5, trace_id=trace, ts=100.0)
+        return h
+
+    m1 = LatencyHistogram()
+    m1.merge(make("aaa"))
+    m1.merge(make("bbb"))
+    m2 = LatencyHistogram()
+    m2.merge(make("bbb"))
+    m2.merge(make("aaa"))
+    assert m1.state_dict() == m2.state_dict()
+
+
+def test_merge_snapshots_rejects_unknown_section():
+    """merge_snapshots silently dropped sections it did not know; now
+    an unknown section raises naming the field (the merge-closure
+    rule's runtime twin)."""
+    base = Metrics().mergeable_snapshot()
+    bad = dict(base)
+    bad["mystery"] = {"x": 1}
+    with pytest.raises(ValueError, match="mystery"):
+        telemetry.merge_snapshots(bad, base)
+    with pytest.raises(ValueError, match="mystery"):
+        telemetry.merge_snapshots(base, bad)
+    # the documented non-merged section (pid) still passes
+    full = telemetry.build_snapshot(Metrics())
+    merged = telemetry.merge_snapshots(full, full)
+    assert "pid" not in merged
+
+
+# ---------------------------------------------------------------------------
+# shrink-on-failure: the report is a reproducer
+# ---------------------------------------------------------------------------
+
+class _ChunkCountingSpec(multiscan.FoldSpec):
+    """Deliberately split-VARIANT: finalize emits how many chunks were
+    seen, so any split changes the output."""
+
+    local_fn = None
+    name = "chunk-counter"
+
+    def __init__(self, out_path):
+        self.out_path = out_path
+        self.chunks = 0
+
+    def encode(self, ctx):
+        self.chunks += 1
+        return ()
+
+    def finalize(self, carry) -> Counters:
+        from avenir_tpu.core.io import write_output
+        write_output(self.out_path, [f"chunks={self.chunks}"])
+        return Counters()
+
+
+def test_shrink_on_failure_names_spec_seed_and_splits(tmp_path, mesh1):
+    rows = [f"id{i},v{i % 3}" for i in range(120)]
+    out = str(tmp_path / "broken_out")
+    reps = algebra.verify_fold_spec(
+        lambda: _ChunkCountingSpec(out), rows, mesh1, seeds=(7,),
+        spec_name="chunk-counter")
+    rep = reps[0]
+    assert rep.failed
+    assert rep.shrunk is not None and len(rep.shrunk) == 1, (
+        "a single split point reproduces; shrink must find it")
+    txt = rep.format()
+    assert "chunk-counter" in txt
+    assert "seed=7" in txt
+    assert str(rep.shrunk) in txt
+    d = rep.to_dict()
+    assert d["failed"] and d["spec"] == "chunk-counter"
+
+
+# ---------------------------------------------------------------------------
+# carry-portability runtime guard (checkpoint save path)
+# ---------------------------------------------------------------------------
+
+def test_assert_portable_carry_passes_host_pytrees():
+    from avenir_tpu.core.checkpoint import assert_portable_carry
+    carry = {"counts": np.zeros((2, 3)), "n": 7,
+             "nested": [np.int64(3), (1.5, None, "tag")]}
+    assert assert_portable_carry(carry) is carry
+
+
+def test_assert_portable_carry_rejects_device_leaves():
+    import jax.numpy as jnp
+    from avenir_tpu.core.checkpoint import (CarryNotPortable,
+                                            assert_portable_carry)
+    with pytest.raises(CarryNotPortable, match="counts"):
+        assert_portable_carry({"counts": jnp.zeros(3)})
+
+
+def test_checkpointer_save_rejects_device_carry(tmp_path):
+    import jax.numpy as jnp
+    from avenir_tpu.core.checkpoint import (CarryNotPortable,
+                                            StreamCheckpointer)
+    src = tmp_path / "in.csv"
+    src.write_text("a,b\n" * 8)
+    ck = StreamCheckpointer(str(tmp_path / "side.ckpt"), interval=1,
+                            kind="test", in_path=str(src))
+    tok = ck.token(0, 10, {"state": 1})
+    with pytest.raises(CarryNotPortable):
+        ck.save(tok, {"c": jnp.zeros(2)})
+    ck.save(tok, {"c": np.zeros(2)})        # host carry saves fine
+
+
+# ---------------------------------------------------------------------------
+# the --dynamic CLI wiring (verification itself runs above; here the
+# gate semantics: any failed report exits 1, reports land in --json)
+# ---------------------------------------------------------------------------
+
+def test_analyze_dynamic_cli_gates_on_failures(tmp_path, monkeypatch):
+    from avenir_tpu.analysis.cli import analyze_main
+    from avenir_tpu.core import algebra as alg
+
+    def fake_ok(seeds, log=None):
+        rep = alg.AlgebraReport("nb", seeds[0], "8dev")
+        rep.add("split-invariance", True)
+        return [rep]
+
+    def fake_fail(seeds, log=None):
+        rep = alg.AlgebraReport("nb", seeds[0], "8dev")
+        rep.add("split-invariance", False, "outputs differ")
+        rep.shrunk = [42]
+        return [rep]
+
+    monkeypatch.setattr(alg, "run_dynamic", fake_ok)
+    out = str(tmp_path / "rep.json")
+    assert analyze_main(["--dynamic", "--seeds", "1", "--rules",
+                         "fold-purity", "--no-cache", "--json",
+                         out]) == 0
+    data = json.load(open(out))
+    assert data["dynamic"][0]["spec"] == "nb"
+    assert not data["dynamic"][0]["failed"]
+
+    monkeypatch.setattr(alg, "run_dynamic", fake_fail)
+    assert analyze_main(["--dynamic", "--seeds", "1", "--rules",
+                         "fold-purity", "--no-cache"]) == 1
+    # bad --seeds values are usage errors
+    assert analyze_main(["--dynamic", "--seeds", "zero"]) == 2
+    assert analyze_main(["--dynamic", "--seeds", "0"]) == 2
+
+
+def test_exemplar_retention_matches_merge_rule_out_of_order_ts():
+    """A replayer may stamp ts out of order; the single-histogram
+    retention rule must equal the merge rule ((ts, trace_id, value)
+    max) or merge==single-run breaks (review finding)."""
+    whole = LatencyHistogram()
+    whole.record(0.5, trace_id="late", ts=200.0)
+    whole.record(0.5, trace_id="early", ts=100.0)
+
+    h1 = LatencyHistogram()
+    h1.record(0.5, trace_id="late", ts=200.0)
+    h2 = LatencyHistogram()
+    h2.record(0.5, trace_id="early", ts=100.0)
+    merged = LatencyHistogram()
+    merged.merge(h1)
+    merged.merge(h2)
+    assert merged.state_dict() == whole.state_dict()
+    ex = whole.state_dict()["exemplars"]
+    assert all(e["trace_id"] == "late" for e in ex.values())
+
+
+def test_verify_fold_spec_reports_unsplittable_workload_as_withdrawn(
+        tmp_path, mesh1):
+    """Too few rows to place a split point: the report must say nothing
+    was verified, not read as a clean pass (review finding)."""
+    out = str(tmp_path / "tiny_out")
+    rows = [f"id{i},v" for i in range(30)]    # < 2*MIN_CHUNK_ROWS + 1
+    reps = algebra.verify_fold_spec(
+        lambda: _ChunkCountingSpec(out), rows, mesh1, seeds=(3,),
+        spec_name="tiny")
+    assert reps[0].withdrawn is not None
+    assert "too few rows" in reps[0].withdrawn
+    assert reps[0].checks == []

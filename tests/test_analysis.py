@@ -454,10 +454,35 @@ def test_rule_registry_covers_catalog():
                 "driver-traced", "driver-counters", "foldspec-fusable",
                 "foldspec-dag", "dag-builtins", "flight-anomaly",
                 "wire-identity", "lock-discipline", "thread-lifecycle",
-                "jax-hot-path", "jax-bare-jit"}
+                "jax-hot-path", "jax-bare-jit",
+                "fold-purity", "merge-closure", "carry-portability"}
     assert expected <= set(RULES)
     for rid in expected:
         assert RULES[rid].doc
+
+
+def test_findings_sort_deterministically_by_file_line_rule(tmp_path):
+    """--json diffs stably: (file, line, rule) order regardless of
+    which rule produced what."""
+    src = ("import jax\n"
+           "import threading\n"
+           "def build(f):\n"
+           "    return jax.jit(f)\n"
+           "def spawn():\n"
+           "    threading.Thread(target=print).start()\n")
+    # serve/ paths so both thread-lifecycle and jax-bare-jit patrol them
+    c = make_corpus(tmp_path, {"serve/b.py": src, "serve/a.py": src})
+    findings, report = run_rules(
+        c, rule_ids=["thread-lifecycle", "jax-bare-jit"])
+    keys = [(f.file, f.line, f.rule) for f in findings]
+    assert len(keys) == 4
+    assert keys == sorted(keys)
+    assert {f.file for f in findings} == {"serve/a.py", "serve/b.py"}
+    assert keys[0][0] == "serve/a.py"
+    # per-rule wall time + finding counts ride the report
+    for entry in report["rules"]:
+        assert set(entry) == {"rule", "findings", "ms"}
+        assert entry["ms"] >= 0
 
 
 # ---------------------------------------------------------------------------
@@ -496,12 +521,13 @@ def test_analyze_cli_strict_fails_on_findings(tmp_path, monkeypatch):
     c = make_corpus(tmp_path, {"mod.py": _UNLOCKED_RMW})
     monkeypatch.setattr(analysis_cli, "load_package_corpus", lambda: c)
     assert analysis_cli.analyze_main(
-        ["--strict", "--rules", "lock-discipline"]) == 1
+        ["--no-cache", "--strict", "--rules", "lock-discipline"]) == 1
     # non-strict: findings print but exit 0
     assert analysis_cli.analyze_main(
-        ["--rules", "lock-discipline"]) == 0
+        ["--no-cache", "--rules", "lock-discipline"]) == 0
     # unknown rule: usage error
-    assert analysis_cli.analyze_main(["--rules", "nope"]) == 2
+    assert analysis_cli.analyze_main(
+        ["--no-cache", "--rules", "nope"]) == 2
     assert analysis_cli.analyze_main(["--bogus"]) == 2
 
 
@@ -607,3 +633,458 @@ def test_pool_quarantine_map_hammer():
         assert len(seen[n]) == 1, (
             f"{n}: {len(seen[n])} distinct quarantine instances "
             f"(creation raced)")
+
+
+# ---------------------------------------------------------------------------
+# fold-purity (distributed-readiness rule family, rules_algebra)
+# ---------------------------------------------------------------------------
+
+from avenir_tpu.analysis.rules_algebra import (     # noqa: E402
+    carry_portability_findings, fold_purity_findings,
+    merge_closure_findings)
+
+_IMPURE_CLOCK_SPEC = """\
+import time
+
+
+class BaseFoldSpec:
+    pass
+
+
+class ClockSpec(BaseFoldSpec):
+    def encode(self, ctx):
+        return (time.time(),)
+"""
+
+_IMPURE_RNG_SPEC = """\
+import numpy as np
+
+
+class BaseFoldSpec:
+    pass
+
+
+class ShuffleSpec(BaseFoldSpec):
+    def encode(self, ctx):
+        np.random.shuffle(ctx)
+        return (ctx,)
+"""
+
+_IMPURE_ENV_SPEC = """\
+import os
+
+
+class BaseFoldSpec:
+    pass
+
+
+class EnvSpec(BaseFoldSpec):
+    def finalize(self, carry):
+        return os.environ.get("MODE")
+"""
+
+_IMPURE_GLOBAL_SPEC = """\
+CACHE = {}
+
+
+def fill(d):
+    d["x"] = 1
+
+
+def lookup(k):
+    fill(CACHE)
+    return CACHE.get(k)
+
+
+class BaseFoldSpec:
+    pass
+
+
+class GlobalSpec(BaseFoldSpec):
+    def finalize(self, carry):
+        return lookup(carry)
+"""
+
+_PURE_SPEC = """\
+import numpy as np
+
+
+class BaseFoldSpec:
+    pass
+
+
+class CleanSpec(BaseFoldSpec):
+    def encode(self, ctx):
+        rng = np.random.default_rng(7)
+        return (np.zeros(3), rng.integers(2))
+
+    def finalize(self, carry):
+        return carry
+"""
+
+
+def test_fold_purity_trigger_excluded_stale(tmp_path):
+    c = make_corpus(tmp_path, {"mod.py": _IMPURE_CLOCK_SPEC})
+    got = fold_purity_findings(c, exclusions={}, extra_roots={})
+    assert [f.tag for f in got] == ["violation"]
+    assert "ClockSpec.encode" in got[0].message
+    assert "time.time" in got[0].message
+
+    key = "mod.py:ClockSpec.encode:time.time"
+    assert fold_purity_findings(
+        c, exclusions={key: "wall time never reaches the carry"},
+        extra_roots={}) == []
+
+    stale = fold_purity_findings(
+        c, exclusions={key: "ok", "mod.py:Gone.encode:time.time":
+                       "removed"}, extra_roots={})
+    assert tags(stale) == ["stale-exclusion"]
+
+    empty = fold_purity_findings(c, exclusions={key: "  "},
+                                 extra_roots={})
+    assert tags(empty) == ["empty-reason"]
+
+
+def test_fold_purity_rng_env_and_mutable_global(tmp_path):
+    rng = make_corpus(tmp_path, {"mod.py": _IMPURE_RNG_SPEC})
+    got = fold_purity_findings(rng, exclusions={}, extra_roots={})
+    assert len(got) == 1 and "np.random.shuffle" in got[0].message
+
+    env = make_corpus(tmp_path, {"mod.py": _IMPURE_ENV_SPEC})
+    got = fold_purity_findings(env, exclusions={}, extra_roots={})
+    assert len(got) == 1 and "os.environ.get" in got[0].message
+
+    # interprocedural: finalize -> lookup() -> escaped mutable global
+    glob = make_corpus(tmp_path, {"mod.py": _IMPURE_GLOBAL_SPEC})
+    got = fold_purity_findings(glob, exclusions={}, extra_roots={})
+    assert len(got) == 1, [f.format() for f in got]
+    assert "lookup" in got[0].message and "CACHE" in got[0].message
+
+
+def test_fold_purity_clean_and_seeded_rng_pass(tmp_path):
+    c = make_corpus(tmp_path, {"mod.py": _PURE_SPEC})
+    assert fold_purity_findings(c, exclusions={}, extra_roots={}) == []
+
+
+def test_fold_purity_repo_is_clean():
+    """The acceptance claim: every fold-reachable impurity in THIS repo
+    is either fixed or documented on FOLD_IMPURE_ALLOWED."""
+    c = load_package_corpus()
+    assert fold_purity_findings(c) == [], \
+        [f.format() for f in fold_purity_findings(c)]
+
+
+# ---------------------------------------------------------------------------
+# merge-closure
+# ---------------------------------------------------------------------------
+
+_STATE_ONLY = """\
+class Window:
+    def state_dict(self):
+        return {"n": self.n}
+"""
+
+_STATE_FULL = """\
+class Window:
+    def state_dict(self):
+        return {"n": self.n}
+
+    @classmethod
+    def from_state(cls, state):
+        return cls()
+
+    def merge(self, other):
+        return self
+"""
+
+_SNAPSHOT_DROP = """\
+def build_snapshot():
+    snap = {}
+    snap["counters"] = {}
+    snap["extra"] = {}
+    return snap
+
+
+def merge_snapshots(a, b):
+    out = {"counters": {}}
+    for s in (a, b):
+        out["counters"].update(s.get("counters") or {})
+    return out
+"""
+
+
+def test_merge_closure_state_dict_trigger_excluded_stale(tmp_path):
+    c = make_corpus(tmp_path, {"mod.py": _STATE_ONLY})
+    got = merge_closure_findings(c, exclusions={}, non_merged={})
+    assert [f.tag for f in got] == ["violation"]
+    assert "Window" in got[0].message
+    assert "from_state/merge" in got[0].message
+
+    ok = merge_closure_findings(
+        c, exclusions={"Window": "report-only surface"}, non_merged={})
+    assert ok == []
+
+    stale = merge_closure_findings(
+        c, exclusions={"Window": "report-only", "Ghost": "deleted"},
+        non_merged={})
+    assert tags(stale) == ["stale-exclusion"]
+
+    full = make_corpus(tmp_path, {"mod.py": _STATE_FULL})
+    assert merge_closure_findings(full, exclusions={},
+                                  non_merged={}) == []
+
+
+def test_merge_closure_snapshot_section_drop(tmp_path):
+    c = make_corpus(tmp_path, {"core/telemetry.py": _SNAPSHOT_DROP})
+    got = merge_closure_findings(c, exclusions={}, non_merged={})
+    assert len(got) == 1 and "'extra'" in got[0].message
+    assert "silently dropped" in got[0].message
+
+    ok = merge_closure_findings(
+        c, exclusions={}, non_merged={"extra": "debug-only section"})
+    assert ok == []
+
+    stale = merge_closure_findings(
+        c, exclusions={},
+        non_merged={"extra": "debug", "ghost": "long gone"})
+    assert tags(stale) == ["stale-exclusion"]
+
+
+def test_merge_closure_repo_is_clean():
+    c = load_package_corpus()
+    assert merge_closure_findings(c) == [], \
+        [f.format() for f in merge_closure_findings(c)]
+
+
+# ---------------------------------------------------------------------------
+# carry-portability
+# ---------------------------------------------------------------------------
+
+_TOPO_SPEC = """\
+import jax
+
+
+class BaseFoldSpec:
+    pass
+
+
+class DeviceSizedSpec(BaseFoldSpec):
+    def __init__(self):
+        self.lanes = jax.device_count()
+"""
+
+
+def test_carry_portability_trigger_excluded_stale(tmp_path):
+    c = make_corpus(tmp_path, {"mod.py": _TOPO_SPEC})
+    got = carry_portability_findings(c, exclusions={}, extra_roots={})
+    assert [f.tag for f in got] == ["violation"]
+    assert "jax.device_count" in got[0].message
+
+    key = "mod.py:DeviceSizedSpec.__init__:jax.device_count"
+    assert carry_portability_findings(
+        c, exclusions={key: "display only, never in the carry"},
+        extra_roots={}) == []
+
+    stale = carry_portability_findings(
+        c, exclusions={key: "display", "mod.py:Gone.__init__:os.cpu_count":
+                       "removed"}, extra_roots={})
+    assert tags(stale) == ["stale-exclusion"]
+
+
+def test_carry_portability_repo_is_clean():
+    c = load_package_corpus()
+    assert carry_portability_findings(c) == [], \
+        [f.format() for f in carry_portability_findings(c)]
+
+
+# ---------------------------------------------------------------------------
+# incremental analyze cache (.avenir-analyze sidecar)
+# ---------------------------------------------------------------------------
+
+_BAD_THREAD = ("import threading\n"
+               "def spawn():\n"
+               "    threading.Thread(target=print).start()\n")
+_GOOD_THREAD = ("import threading\n"
+                "def spawn():\n"
+                "    threading.Thread(target=print, "
+                "daemon=True).start()\n")
+
+
+def test_analysis_cache_parse_reuse_and_invalidation(tmp_path):
+    from avenir_tpu.analysis.cache import AnalysisCache
+
+    root = tmp_path / "pkg"
+    root.mkdir()
+    (root / "mod.py").write_text(_BAD_THREAD)
+    cache_dir = str(tmp_path / "sidecar")
+
+    cold = AnalysisCache(cache_dir)
+    c1 = cold.load_corpus(str(root))
+    assert cold.stats["parsed"] == 1
+    f1, r1 = cold.run(c1, rule_ids=["thread-lifecycle"])
+    assert r1["cached"] is False
+    assert len(f1) == 1
+
+    warm = AnalysisCache(cache_dir)
+    c2 = warm.load_corpus(str(root))
+    assert warm.stats["parsed"] == 0 and warm.stats["reused"] == 1
+    f2, r2 = warm.run(c2, rule_ids=["thread-lifecycle"])
+    assert r2["cached"] is True and warm.stats["report_hit"]
+    assert [f.to_dict() for f in f2] == [f.to_dict() for f in f1]
+
+    # touch-one-file invalidation: the fix is visible immediately
+    (root / "mod.py").write_text(_GOOD_THREAD)
+    inval = AnalysisCache(cache_dir)
+    c3 = inval.load_corpus(str(root))
+    assert inval.stats["parsed"] == 1, \
+        "changed file must re-parse (full-text equality key)"
+    f3, r3 = inval.run(c3, rule_ids=["thread-lifecycle"])
+    assert r3["cached"] is False
+    assert f3 == []
+
+
+def test_warm_incremental_analyze_under_one_second():
+    """The acceptance bound: a warm `analyze --strict` (nothing
+    changed) replays the cached report in well under a second."""
+    from avenir_tpu.analysis.cache import cached_package_run
+
+    cached_package_run()                      # prime (may run cold)
+    t0 = time.monotonic()
+    findings, report = cached_package_run()
+    elapsed = time.monotonic() - t0
+    assert report["cached"] is True
+    assert report["cache_stats"]["parsed"] == 0
+    assert findings == [], [f.format() for f in findings]
+    assert elapsed < 1.0, (
+        f"warm incremental analyze took {elapsed:.2f}s (>= 1s budget)")
+
+
+# ---------------------------------------------------------------------------
+# baseline ratchet (--baseline / --update-baseline)
+# ---------------------------------------------------------------------------
+
+def test_analyze_cli_baseline_ratchet(tmp_path, monkeypatch):
+    from avenir_tpu.analysis import cli as analysis_cli
+
+    base = str(tmp_path / "baseline.json")
+    args = ["--no-cache", "--strict", "--rules", "thread-lifecycle",
+            "--baseline", base]
+
+    bad = make_corpus(tmp_path, {"mod.py": _BAD_THREAD})
+    monkeypatch.setattr(analysis_cli, "load_package_corpus",
+                        lambda: bad)
+    # no baseline yet: the finding is new -> strict fails
+    assert analysis_cli.analyze_main(args) == 1
+    # ratchet it: baseline written atomically, gate passes
+    assert analysis_cli.analyze_main(args + ["--update-baseline"]) == 0
+    stored = json.load(open(base))
+    assert len(stored["findings"]) == 1
+    assert stored["findings"][0]["rule"] == "thread-lifecycle"
+    # the known finding no longer fails strict
+    assert analysis_cli.analyze_main(args) == 0
+
+    # a NEW finding on top of the baseline still fails
+    worse = make_corpus(tmp_path, {"mod.py": _BAD_THREAD,
+                                   "other.py": _BAD_THREAD})
+    monkeypatch.setattr(analysis_cli, "load_package_corpus",
+                        lambda: worse)
+    assert analysis_cli.analyze_main(args) == 1
+
+    # cleanups resolve silently (ratchet only tightens)
+    fixed = make_corpus(tmp_path, {"mod.py": _GOOD_THREAD})
+    monkeypatch.setattr(analysis_cli, "load_package_corpus",
+                        lambda: fixed)
+    assert analysis_cli.analyze_main(args) == 0
+
+    # usage errors
+    assert analysis_cli.analyze_main(["--update-baseline"]) == 2
+    assert analysis_cli.analyze_main(["--baseline"]) == 2
+
+
+def test_analyze_cli_baseline_counts_duplicate_findings(tmp_path,
+                                                        monkeypatch):
+    """Ratchet multiset semantics: several rules emit line-independent
+    messages, so a SECOND identical violation must not hide behind one
+    baselined occurrence (review finding)."""
+    from avenir_tpu.analysis import cli as analysis_cli
+
+    base = str(tmp_path / "dupes.json")
+    args = ["--no-cache", "--strict", "--rules", "thread-lifecycle",
+            "--baseline", base]
+
+    # two leaks in ONE function -> identical (rule, file, message) keys
+    one = ("import threading\n"
+           "def spawn():\n"
+           "    threading.Thread(target=print).start()\n")
+    two = ("import threading\n"
+           "def spawn():\n"
+           "    threading.Thread(target=print).start()\n"
+           "    threading.Thread(target=max).start()\n")
+    c_one = make_corpus(tmp_path, {"mod.py": one})
+    monkeypatch.setattr(analysis_cli, "load_package_corpus",
+                        lambda: c_one)
+    assert analysis_cli.analyze_main(args + ["--update-baseline"]) == 0
+    assert analysis_cli.analyze_main(args) == 0
+
+    c_two = make_corpus(tmp_path, {"mod.py": two})
+    monkeypatch.setattr(analysis_cli, "load_package_corpus",
+                        lambda: c_two)
+    got = thread_lifecycle_findings(c_two, exclusions={})
+    if len(got) == 2 and got[0].message == got[1].message:
+        # identical keys: the multiset diff must still flag one NEW
+        assert analysis_cli.analyze_main(args) == 1
+
+
+def test_carry_portability_sees_class_body_statements(tmp_path):
+    """Class bodies execute at import: `LANES = jax.device_count()` at
+    class level must be flagged like the __init__ form (review
+    finding)."""
+    src = ("import jax\n\n\n"
+           "class BaseFoldSpec:\n"
+           "    pass\n\n\n"
+           "class ClassLevelSpec(BaseFoldSpec):\n"
+           "    LANES = jax.device_count()\n")
+    c = make_corpus(tmp_path, {"mod.py": src})
+    got = carry_portability_findings(c, exclusions={}, extra_roots={})
+    assert [f.tag for f in got] == ["violation"], \
+        [f.format() for f in got]
+    assert "jax.device_count" in got[0].message
+    assert "ClassLevelSpec.<class>" in got[0].message
+
+
+def test_fold_purity_sees_class_body_statements(tmp_path):
+    src = ("import time\n\n\n"
+           "class BaseFoldSpec:\n"
+           "    pass\n\n\n"
+           "class StampedSpec(BaseFoldSpec):\n"
+           "    T0 = time.time()\n")
+    c = make_corpus(tmp_path, {"mod.py": src})
+    got = fold_purity_findings(c, exclusions={}, extra_roots={})
+    assert [f.tag for f in got] == ["violation"]
+    assert "time.time" in got[0].message
+
+
+def test_analyze_cli_flag_values_never_swallow_flags():
+    """`--baseline --update-baseline` is a usage error, not a baseline
+    file named '--update-baseline' (review finding)."""
+    from avenir_tpu.analysis.cli import analyze_main
+    assert analyze_main(["--baseline", "--update-baseline"]) == 2
+    assert analyze_main(["--json", "--strict"]) == 2
+    assert analyze_main(["--rules", "--strict"]) == 2
+
+
+def test_cache_tree_and_corpus_digests_agree(tmp_path):
+    """Both report-cache guards hash the same way, or the CLI and the
+    corpus API would thrash each other's sidecars (review finding)."""
+    from avenir_tpu.analysis.cache import AnalysisCache
+
+    root = tmp_path / "pkg"
+    (root / "sub").mkdir(parents=True)
+    (root / "cli.py").write_text("x = 1\n")
+    (root / "sub" / "mod.py").write_text("y = 2\n")
+    readme = tmp_path / "README.md"
+    readme.write_text("docs\n")
+    cache = AnalysisCache(str(tmp_path / "sidecar"))
+    corpus = Corpus(str(root), readme_path=str(readme))
+    assert cache.tree_digest(str(root), readme_path=str(readme)) \
+        == cache.corpus_digest(corpus)
