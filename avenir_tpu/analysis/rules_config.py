@@ -31,6 +31,9 @@ NAMESPACE_GROUPS: Dict[str, str] = {
                   r"serve\.frontend|serve\.drain|obs\.sample|flight)"),
     "workflow": r"(?:workflow|dag)",
     "sanitizer": r"(?:sanitize)",
+    # the streaming decision service (avenir_tpu/stream); the literal
+    # dot keeps the legacy `streaming.max.pending.batches` key out
+    "stream": r"(?:stream)",
 }
 
 _ACCESSORS = (r"\.(?:get|get_int|get_float|get_boolean|get_list|must|"

@@ -34,6 +34,7 @@ INGEST_MODULES = [
     "core/checkpoint.py",
     "core/resilience.py",
     "native/__init__.py",
+    "models/streaming.py",
 ]
 
 #: call spellings that count as raw I/O
@@ -42,6 +43,18 @@ RAW_ATTR_CALLS = {
     ("subprocess", "run"), ("subprocess", "Popen"),
     ("subprocess", "check_output"), ("subprocess", "check_call"),
     ("os", "fdopen"), ("tempfile", "mkstemp"),
+    ("redis", "Redis"),
+}
+
+#: redis network commands: ANY ``<expr>.<cmd>(...)`` call with one of
+#: these attribute names is a network round trip (the redis-py client
+#: surface the transports use) — patrolled in the ingest modules like
+#: every other raw I/O site.  The FakeRedis double DEFINES these names
+#: but never calls them on another object, so it stays clean.
+RAW_NET_ATTR_NAMES = {
+    "rpop", "lpush", "llen", "lrange",
+    "xadd", "xread", "xreadgroup", "xack", "xrange", "xlen",
+    "xgroup_create", "xpending",
 }
 
 #: quals that ARE the atomic publish layer (writes inside them stage to
@@ -74,6 +87,10 @@ class _RetryScan(ScopedVisitor):
             base = fn.value
             if (isinstance(base, ast.Name)
                     and (base.id, fn.attr) in RAW_ATTR_CALLS):
+                self.raw_sites.setdefault(self.qual(), []).append(
+                    node.lineno)
+            elif fn.attr in RAW_NET_ATTR_NAMES:
+                # a redis network command on any client expression
                 self.raw_sites.setdefault(self.qual(), []).append(
                     node.lineno)
             if fn.attr == "with_retries":

@@ -73,6 +73,10 @@ JOBS: Dict[str, tuple] = {
     "org.avenir.association.InfrequentItemMarker": ("association", "InfrequentItemMarker", "iim"),
     "org.avenir.regress.LogisticRegressionJob": ("regress", "LogisticRegressionJob", ""),
     "org.avenir.reinforce.GreedyRandomBandit": ("bandit", "GreedyRandomBandit", ""),
+    # batch replay of a reward-event log into per-arm posterior state —
+    # the byte-equivalence reference for the streaming feedback consumer
+    # (avenir_tpu/stream); net-new surface, no reference driver class
+    "org.avenir.reinforce.BanditFeedbackAggregator": ("bandit", "BanditFeedbackAggregator", ""),
     "org.avenir.reinforce.AuerDeterministic": ("bandit", "AuerDeterministic", ""),
     "org.avenir.reinforce.SoftMaxBandit": ("bandit", "SoftMaxBandit", ""),
     "org.avenir.reinforce.RandomFirstGreedyBandit": ("bandit", "RandomFirstGreedyBandit", ""),
@@ -305,6 +309,8 @@ def main(argv=None) -> int:
               file=sys.stderr)
         print("       python -m avenir_tpu serve -Dconf.path=<serve.properties>",
               file=sys.stderr)
+        print("       python -m avenir_tpu stream -Dconf.path=<stream.properties> [--resume]",
+              file=sys.stderr)
         print("       python -m avenir_tpu analyze [--strict] [--json report.json] [--rules a,b] [--list]",
               file=sys.stderr)
         print("                                    [--dynamic] [--seeds N] [--baseline findings.json] [--update-baseline] [--no-cache]",
@@ -332,6 +338,12 @@ def main(argv=None) -> int:
         _init_runtime()
         from .serve.server import serve_main
         return serve_main(rest)
+    if job_name == "stream":
+        # streaming decision service (avenir_tpu/stream): bandit decide
+        # serving + exactly-once Redis-stream feedback folding
+        _init_runtime()
+        from .stream.service import stream_main
+        return stream_main(rest)
     # --trace <out.json>: record core.obs spans for the whole job and
     # export them as Chrome/Perfetto trace_event JSON on exit
     rest, trace_path = extract_trace_flag(rest)

@@ -529,6 +529,15 @@ def verification_jobs(work_dir: str) -> Dict[str, tuple]:
                  "skip.field.count": "5"}),
         "stats": ("NumericalAttrStats",
                   {"attr.list": "2,3", "cond.attr.ord": "4"}),
+        # the streaming-decision posterior fold (avenir_tpu/stream):
+        # the shared workload's columns map to reward events — color as
+        # tenant, label as arm, the integer score as reward
+        "bandit_fb": ("BanditFeedbackAggregator",
+                      {"stream.tenants": "red,green,blue",
+                       "stream.arms": "N,Y",
+                       "stream.tenant.ordinal": "1",
+                       "stream.arm.ordinal": "4",
+                       "stream.reward.ordinal": "3"}),
     }
 
 

@@ -74,6 +74,21 @@ Instrumented points (grep ``fire(`` / ``mangle(`` call sites):
                       just-written sidecar is truncated in place after a
                       successful save (crash mid-checkpoint-write /disk
                       corruption; the generation-fallback path)
+``feedback_dup``      feedback-consumer read batches by batch index —
+                      the delivered entries are delivered AGAIN in the
+                      same batch (at-least-once redelivery; the offset
+                      watermark must dedupe — ``armed``, enacted by the
+                      consumer)
+``feedback_reorder``  feedback-consumer read batches by batch index —
+                      the delivered entries arrive in reversed order
+                      (the consumer's id sort must restore application
+                      order — ``armed``, enacted by the consumer)
+``feedback_drop``     feedback-consumer read batches by batch index —
+                      raises ``InjectedFault`` AFTER the transport
+                      delivered the batch but BEFORE any of it was
+                      applied (consumer crash: the entries stay pending
+                      unacked and must be redelivered on resume with
+                      zero drops or double-applies)
 ====================  =====================================================
 
 Disabled-mode cost: ``get_injector()`` returns None until a plan is
@@ -98,7 +113,8 @@ KEY_SEED = "fault.inject.seed"
 #: the known instrumented points (parse-time typo guard)
 POINTS = ("read", "corrupt", "slow", "h2d", "worker_death", "scorer",
           "scorer_slow", "batcher_death", "scorer_poison", "torn_write",
-          "ckpt_corrupt")
+          "ckpt_corrupt", "feedback_dup", "feedback_reorder",
+          "feedback_drop")
 
 
 class InjectedReadError(OSError):

@@ -99,6 +99,9 @@ NON_ATOMIC_WRITES: Dict[str, str] = {
     "core/checkpoint.py:WorkflowCheckpointer.record":
         "atomic by construction, same tmp+replace shape as "
         "StreamCheckpointer.save",
+    "core/checkpoint.py:OffsetCheckpointer.save":
+        "atomic by construction, same tmp+replace shape as "
+        "StreamCheckpointer.save (the stream-offset sidecar)",
     "core/obs.py:Tracer.export_jsonl":
         "diagnostic trace export, not a job artifact: no reader "
         "validates it, a torn trace breaks no downstream job, and "

@@ -250,6 +250,19 @@ NON_RETRYABLE: Dict[str, str] = {
         "StreamCheckpointer.save: atomic via tmp+rename, and a failed "
         "record must fail the workflow loudly (resume correctness depends "
         "on the record) rather than retry-stall between stages",
+    "core/checkpoint.py:OffsetCheckpointer.save":
+        "stream-offset sidecar write, same contract as "
+        "StreamCheckpointer.save: a failed save must NOT retry-stall the "
+        "feedback consumer; the previous generation remains valid and "
+        "unacked entries redeliver (write is atomic via tmp+rename)",
+    "models/streaming.py:_redis_client":
+        "client CONSTRUCTION only: redis-py connects lazily per command, "
+        "so the transient-failure surface is the commands themselves — "
+        "each transport method wraps its command in with_retries",
+    "models/streaming.py:ReinforcementLearnerTopology.run":
+        "topology properties-file load at submit time (the reference "
+        "main()'s configFile): a missing or unreadable config is a "
+        "fail-fast user error, not a transient fault",
     "core/checkpoint.py:input_fingerprint":
         "fingerprint hash read runs at checkpoint save/load next to the "
         "retried bulk read of the same file; a transient fault surfaces "

@@ -452,6 +452,65 @@ class RandomFirstGreedyBandit(_BanditJobBase):
         return counters
 
 
+class BanditFeedbackAggregator:
+    """Batch replay of a reward-event log into per-arm posterior state —
+    the offline twin of the streaming feedback consumer
+    (``avenir_tpu/stream``), and the byte-equivalence reference its
+    exactly-once gate compares against: replaying the same event log
+    through this job and through the Redis-stream consumer must emit
+    byte-identical ``tenant,arm,pulls,rewardSum`` posterior lines.
+
+    Input rows are CSV reward events; the ``stream.tenant.ordinal`` /
+    ``stream.arm.ordinal`` / ``stream.reward.ordinal`` keys (defaults
+    0/1/2 — the consumer's ``tenant,arm,reward`` wire format) map
+    arbitrary logs.  Tenants/arms come from the declared
+    ``stream.tenants`` / ``stream.arms`` manifest; malformed events
+    (unknown tenant/arm, non-integer reward) are skipped and counted,
+    identically to the online consumer.  Exports the shared-scan
+    :class:`~avenir_tpu.stream.posterior.FeedbackFoldSpec`, so the
+    fold-algebra verifier certifies the posterior fold's split/merge
+    invariance like every other registered fold (``analyze --dynamic``
+    jid ``bandit_fb``)."""
+
+    def __init__(self, config: JobConfig):
+        self.config = config
+
+    def fold_spec(self, out_path: str):
+        """Export the shared-scan ``core.multiscan.FoldSpec``."""
+        from ..stream.posterior import FeedbackFoldSpec
+
+        return FeedbackFoldSpec(self.config, out_path)
+
+    @traced_run
+    def run(self, in_path: str, out_path: str, mesh=None) -> Counters:
+        """Drive the FoldSpec over the input exactly the way the shared
+        scan would (chunked encode -> H2D -> donated-carry fold), so the
+        standalone run IS the certified fold path."""
+        from ..core import pipeline
+        from ..parallel.mesh import get_mesh
+        from ..core.multiscan import ChunkContext
+
+        mesh = mesh or get_mesh()
+        cfg = self.config
+        spec = self.fold_spec(out_path)
+        delim = cfg.field_delim_regex()
+        chunk_rows = cfg.pipeline_chunk_rows(
+            default=pipeline.DEFAULT_CHUNK_ROWS)
+        xfer = pipeline.ChunkTransfer(mesh, capacity=None)
+        fold = None
+        for raw, _idx, _end in pipeline.iter_byte_chunks_meta(
+                in_path, chunk_rows):
+            arrs = spec.encode(ChunkContext(raw, delim))
+            if arrs is None:
+                continue
+            if fold is None:
+                fold = pipeline.ChunkFold(
+                    spec.local_fn, static_args=spec.static_args,
+                    mesh=mesh)
+            fold.fold(xfer(tuple(arrs)))
+        return spec.finalize(fold.result() if fold is not None else None)
+
+
 def aggregate_rewards(selection_reward_lines: List[str],
                       prev_state_lines: List[str],
                       delim: str = ",") -> List[str]:

@@ -33,14 +33,22 @@ from __future__ import annotations
 import re
 import time
 from collections import deque
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from .reinforce import Action, ReinforcementLearner, create_learner
+from ..core import sanitizer
 from ..core.obs import traced_run
+from ..core.resilience import with_retries
 
 _INT_RE = re.compile(r"-?\d+", re.ASCII)
+
+
+class FakeRedisError(Exception):
+    """The fakeredis-style stand-in for ``redis.exceptions.ResponseError``
+    (``BUSYGROUP`` / ``NOGROUP`` messages match the server's, so callers
+    classifying by message work against either client)."""
 
 
 class Transport:
@@ -87,66 +95,319 @@ class InMemoryTransport(Transport):
         self.actions.append(message)
 
 
+def _sid(entry_id: str) -> Tuple[int, int]:
+    """A stream entry id's sort key (``<ms>-<seq>`` -> (ms, seq))."""
+    ms, _, seq = str(entry_id).partition("-")
+    return int(ms), int(seq or 0)
+
+
 class FakeRedis:
-    """fakeredis-style in-process double of the redis-py list commands
-    :class:`RedisTransport` uses — same lpush/rpop semantics and
-    decoded-string returns, no server.  Producers/consumers standing in
-    for the reference's Redis peers (and the round-trip tests in
-    ``tests/test_reinforce.py``) drive the REAL transport against this
-    client, so the queue protocol is covered without the optional
-    ``redis`` dependency."""
+    """fakeredis-style in-process double of the redis-py commands the
+    transports use — the list commands (:class:`RedisTransport`: same
+    lpush/rpop semantics and decoded-string returns) PLUS the stream
+    commands (:class:`RedisStreamTransport`: XADD / XLEN / XRANGE /
+    XGROUP CREATE / XREADGROUP / XACK / XPENDING with consumer groups,
+    per-consumer pending-entry redelivery, and blocking reads), no
+    server.  Producers/consumers standing in for the reference's Redis
+    peers (and the round-trip tests in ``tests/test_reinforce.py`` /
+    ``tests/test_stream.py``) drive the REAL transports against this
+    client, so both wire protocols are covered without the optional
+    ``redis`` dependency.
+
+    Entry ids are deterministic (``1-0``, ``2-0``, ... per stream — the
+    server's ``<ms>-<seq>`` shape with a counter clock), so tests and
+    the byte-equivalence gates reproduce exactly.  Thread-safe: one
+    condition guards every structure, and blocking ``xreadgroup`` reads
+    wait on it."""
 
     def __init__(self):
+        self._cond = sanitizer.make_condition("models.fakeredis")
         self._lists: Dict[str, deque] = {}
+        #: stream key -> ordered [(id, fields dict)]
+        self._streams: Dict[str, List[Tuple[str, Dict[str, str]]]] = {}
+        self._next_id: Dict[str, int] = {}
+        #: (stream, group) -> {"last": id, "pending": {id: consumer}}
+        self._groups: Dict[Tuple[str, str], dict] = {}
 
+    # -- list commands (the reference queue protocol) ----------------------
     def lpush(self, key: str, *values) -> int:
-        q = self._lists.setdefault(key, deque())
-        for v in values:
-            q.appendleft(str(v))
-        return len(q)
+        with self._cond:
+            q = self._lists.setdefault(key, deque())
+            for v in values:
+                q.appendleft(str(v))
+            return len(q)
 
     def rpop(self, key: str) -> Optional[str]:
-        q = self._lists.get(key)
-        return q.pop() if q else None
+        with self._cond:
+            q = self._lists.get(key)
+            return q.pop() if q else None
 
     def llen(self, key: str) -> int:
-        return len(self._lists.get(key) or ())
+        with self._cond:
+            return len(self._lists.get(key) or ())
 
     def lrange(self, key: str, start: int, stop: int) -> List[str]:
-        items = list(self._lists.get(key) or ())
-        return items[start:None if stop == -1 else stop + 1]
+        with self._cond:
+            items = list(self._lists.get(key) or ())
+            return items[start:None if stop == -1 else stop + 1]
+
+    # -- stream commands (XADD / consumer groups) --------------------------
+    def xadd(self, key: str, fields: Dict[str, str], id: str = "*") -> str:
+        with self._cond:
+            entries = self._streams.setdefault(key, [])
+            if id == "*":
+                n = self._next_id.get(key, 0) + 1
+                self._next_id[key] = n
+                eid = f"{n}-0"
+            else:
+                eid = str(id)
+                if entries and _sid(eid) <= _sid(entries[-1][0]):
+                    raise FakeRedisError(
+                        "ERR The ID specified in XADD is equal or smaller "
+                        "than the target stream top item")
+                self._next_id[key] = max(self._next_id.get(key, 0),
+                                         _sid(eid)[0])
+            entries.append((eid, {str(k): str(v)
+                                  for k, v in fields.items()}))
+            self._cond.notify_all()
+            return eid
+
+    def xlen(self, key: str) -> int:
+        with self._cond:
+            return len(self._streams.get(key) or ())
+
+    def xrange(self, key: str, min: str = "-", max: str = "+",
+               count: Optional[int] = None):
+        with self._cond:
+            entries = list(self._streams.get(key) or ())
+        lo = None if min == "-" else _sid(min)
+        hi = None if max == "+" else _sid(max)
+        out = [(eid, dict(f)) for eid, f in entries
+               if (lo is None or _sid(eid) >= lo)
+               and (hi is None or _sid(eid) <= hi)]
+        return out[:count] if count is not None else out
+
+    def xgroup_create(self, key: str, group: str, id: str = "$",
+                      mkstream: bool = False) -> bool:
+        with self._cond:
+            if key not in self._streams:
+                if not mkstream:
+                    raise FakeRedisError(
+                        "ERR The XGROUP subcommand requires the key to "
+                        "exist (consider MKSTREAM)")
+                self._streams[key] = []
+            if (key, group) in self._groups:
+                raise FakeRedisError(
+                    "BUSYGROUP Consumer Group name already exists")
+            entries = self._streams[key]
+            last = (entries[-1][0] if id == "$" and entries else "0-0")
+            if id not in ("$", "0"):
+                last = str(id)
+            self._groups[(key, group)] = {"last": last, "pending": {}}
+            return True
+
+    def _group(self, key: str, group: str) -> dict:
+        g = self._groups.get((key, group))
+        if g is None:
+            raise FakeRedisError(
+                f"NOGROUP No such consumer group '{group}' for key name "
+                f"'{key}'")
+        return g
+
+    def xreadgroup(self, groupname: str, consumername: str,
+                   streams: Dict[str, str], count: Optional[int] = None,
+                   block: Optional[int] = None):
+        """One stream per call (all this double's users read one); id
+        ``>`` delivers NEW entries (recorded pending under this
+        consumer, blocking up to ``block`` ms when none), any other id
+        replays THIS consumer's pending entries above it (the
+        crash-redelivery path) without blocking."""
+        (key, from_id), = streams.items()
+        deadline = (time.monotonic() + block / 1000.0
+                    if block is not None else None)
+        while True:
+            with self._cond:
+                g = self._group(key, groupname)
+                entries = self._streams.get(key) or []
+                if from_id == ">":
+                    lo = _sid(g["last"])
+                    fresh = [(eid, dict(f)) for eid, f in entries
+                             if _sid(eid) > lo]
+                    if count is not None:
+                        fresh = fresh[:count]
+                    if fresh:
+                        for eid, _ in fresh:
+                            g["pending"][eid] = consumername
+                        g["last"] = fresh[-1][0]
+                        return [[key, fresh]]
+                else:
+                    lo = _sid(from_id)
+                    mine = sorted(
+                        (eid for eid, owner in g["pending"].items()
+                         if owner == consumername and _sid(eid) > lo),
+                        key=_sid)
+                    if count is not None:
+                        mine = mine[:count]
+                    by_id = dict(entries)
+                    return ([[key, [(eid, dict(by_id[eid]))
+                                    for eid in mine if eid in by_id]]]
+                            if mine else [])
+                if deadline is None:
+                    return []
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return []
+                self._cond.wait(remaining)
+
+    def xack(self, key: str, group: str, *ids) -> int:
+        with self._cond:
+            g = self._group(key, group)
+            n = 0
+            for eid in ids:
+                if g["pending"].pop(str(eid), None) is not None:
+                    n += 1
+            return n
+
+    def xpending(self, key: str, group: str) -> dict:
+        with self._cond:
+            g = self._group(key, group)
+            pend = sorted(g["pending"], key=_sid)
+            return {"pending": len(pend),
+                    "min": pend[0] if pend else None,
+                    "max": pend[-1] if pend else None}
+
+    def advance_id_clock(self, key: str, ms: int) -> None:
+        """Advance the stream's id counter to at least ``ms``.  A real
+        server's entry ids are millisecond-clock based and therefore
+        monotonic across process restarts; this double's counter clock
+        restarts at 1, so a consumer resuming an offset checkpoint
+        against a FRESH in-process broker calls this with its watermark
+        — otherwise every new entry would sort below the watermark and
+        be deduplicated away."""
+        with self._cond:
+            self._next_id[key] = max(self._next_id.get(key, 0), int(ms))
+
+
+def _redis_client(host: str, port: int, client=None):
+    """The injected client (e.g. :class:`FakeRedis`) or a real redis-py
+    connection.  Construction itself is lazy on the redis side (redis-py
+    connects per command), so the transient-failure surface is the
+    commands — each wrapped in ``with_retries`` at its call site."""
+    if client is not None:
+        return client
+    import redis  # optional dependency; gate at construction
+    return redis.Redis(host=host, port=port, decode_responses=True)
 
 
 class RedisTransport(Transport):
     """Redis-list transport matching the reference's queue protocol
     (``rpop`` events, reward list, ``lpush`` actions).  ``client``
     injects a ready client (e.g. :class:`FakeRedis`); otherwise the
-    optional ``redis`` package connects to ``host:port``."""
+    optional ``redis`` package connects to ``host:port``.  Every network
+    command runs under ``core.resilience.with_retries`` (transient
+    ``OSError``-family failures back off and reattempt; the io-retry
+    analysis rule patrols these call sites)."""
 
     def __init__(self, host: str, port: int, event_queue: str,
                  reward_queue: str, action_queue: str, client=None):
-        if client is None:
-            import redis  # optional dependency; gate at construction
-            client = redis.Redis(host=host, port=port,
-                                 decode_responses=True)
-        self._r = client
+        self._r = _redis_client(host, port, client)
         self.event_queue = event_queue
         self.reward_queue = reward_queue
         self.action_queue = action_queue
 
     def next_event(self) -> Optional[str]:
-        return self._r.rpop(self.event_queue)
+        return with_retries(lambda: self._r.rpop(self.event_queue),
+                            op="redis")
 
     def read_rewards(self) -> List[str]:
         out = []
         while True:
-            msg = self._r.rpop(self.reward_queue)
+            msg = with_retries(lambda: self._r.rpop(self.reward_queue),
+                               op="redis")
             if msg is None:
                 return out
             out.append(msg)
 
     def write_action(self, message: str) -> None:
-        self._r.lpush(self.action_queue, message)
+        with_retries(lambda: self._r.lpush(self.action_queue, message),
+                     op="redis")
+
+
+class RedisStreamTransport:
+    """Redis-STREAM transport for the ``avenir_tpu/stream`` feedback
+    subsystem: reward events are stream entries consumed through a
+    consumer group (XREADGROUP), so at-least-once delivery with
+    per-consumer pending-entry redelivery is the substrate the
+    exactly-once checkpoint layer rides on.  ``client`` injects a ready
+    client (:class:`FakeRedis` in tests and server-less deployments);
+    otherwise the optional ``redis`` package connects to ``host:port``.
+    Every network command runs under ``core.resilience.with_retries``."""
+
+    def __init__(self, host: str, port: int, stream: str, group: str,
+                 consumer: str, client=None):
+        self._r = _redis_client(host, port, client)
+        self.stream = stream
+        self.group = group
+        self.consumer = consumer
+
+    def ensure_group(self) -> None:
+        """Create the consumer group from the stream head (idempotent:
+        an existing group is fine — BUSYGROUP is the already-exists
+        signal, not an error)."""
+        try:
+            with_retries(
+                lambda: self._r.xgroup_create(self.stream, self.group,
+                                              id="0", mkstream=True),
+                op="redis")
+        except Exception as e:                      # noqa: BLE001
+            if "BUSYGROUP" not in str(e):
+                raise
+
+    def publish(self, fields: Dict[str, str]) -> str:
+        """XADD one reward event; returns the assigned entry id."""
+        return with_retries(lambda: self._r.xadd(self.stream, fields),
+                            op="redis")
+
+    def read_new(self, count: int,
+                 block_ms: Optional[int] = None) -> List[tuple]:
+        """XREADGROUP ``>``: up to ``count`` new entries (recorded in
+        this consumer's pending list), blocking up to ``block_ms``."""
+        res = with_retries(
+            lambda: self._r.xreadgroup(self.group, self.consumer,
+                                       {self.stream: ">"}, count=count,
+                                       block=block_ms),
+            op="redis")
+        return list(res[0][1]) if res else []
+
+    def read_pending(self, count: int,
+                     after: str = "0-0") -> List[tuple]:
+        """XREADGROUP from an explicit id: THIS consumer's still-pending
+        (delivered but unacknowledged) entries above ``after`` — the
+        crash-redelivery read a resumed consumer drains, cursor-style,
+        before any new entries (applied-but-unacked entries stay in the
+        PEL until their covering checkpoint, so the cursor is what keeps
+        the drain a single pass)."""
+        res = with_retries(
+            lambda: self._r.xreadgroup(self.group, self.consumer,
+                                       {self.stream: after}, count=count),
+            op="redis")
+        return list(res[0][1]) if res else []
+
+    def ack(self, ids: Sequence[str]) -> int:
+        if not ids:
+            return 0
+        return with_retries(
+            lambda: self._r.xack(self.stream, self.group, *ids),
+            op="redis")
+
+    def pending_count(self) -> int:
+        return int(with_retries(
+            lambda: self._r.xpending(self.stream, self.group),
+            op="redis")["pending"])
+
+    def length(self) -> int:
+        return int(with_retries(lambda: self._r.xlen(self.stream),
+                                op="redis"))
 
 
 def _get(config: Dict, *keys, default=None, required=False):

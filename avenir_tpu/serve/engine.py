@@ -562,6 +562,68 @@ class NearestNeighborAdapter(ModelAdapter):
         return results
 
 
+# ---------------------------------------------------------------------------
+# streaming bandit decisions (avenir_tpu/stream)
+# ---------------------------------------------------------------------------
+
+class BanditDecisionAdapter(ModelAdapter):
+    """Serves ``decide`` requests for the streaming decision service
+    (avenir_tpu/stream): request lines are ``eventID,tenant``, responses
+    ``eventID,tenant,arm`` — arm selection by Thompson sampling or UCB
+    over the tenant's device-resident per-arm posterior.
+
+    The posterior is the LIVE :class:`~avenir_tpu.stream.posterior.
+    PosteriorStore` named by ``stream.store`` — every pool replica's
+    adapter resolves to the SAME store (so all replicas answer from one
+    posterior, and the feedback consumer's folds are visible to every
+    replica immediately), created from this model's config manifest when
+    not yet registered.  Decisions are pure functions of (posterior,
+    ``stream.seed``, event id) — see ``stream.posterior`` — so responses
+    are byte-identical across micro-batch composition, replica choice,
+    and kill/resume.  Unknown tenants and short rows are rejected
+    per-row (a ``None`` result -> structured error response), never
+    scored against a wrong tenant's posterior."""
+
+    KIND = "banditDecision"
+
+    def __init__(self, config: JobConfig, counters: Counters, **kw):
+        super().__init__(config, counters, **kw)
+        from ..stream.posterior import ensure_store, event_crc
+
+        self.store = ensure_store(config, mesh=self.mesh)
+        self._crc = event_crc
+        self._min_fields = 2
+
+    def warm(self, bucket: int) -> None:
+        self.store.decide(np.zeros(bucket, np.int32),
+                          np.zeros(bucket, np.uint32))
+
+    def predict_lines(self, lines: List[str]) -> List[Optional[str]]:
+        records = self._split(lines)
+        index = self.store.tenant_index
+        ok = [i for i, r in enumerate(records)
+              if len(r) >= self._min_fields and r[1] in index]
+        results: List[Optional[str]] = [None] * len(lines)
+        if not ok:
+            return results
+        n = len(ok)
+        b = self._bucket(n)
+        tid = np.zeros(b, np.int32)
+        crc = np.zeros(b, np.uint32)
+        for j, i in enumerate(ok):
+            tid[j] = index[records[i][1]]
+            crc[j] = self._crc(records[i][0])
+        sels = self.store.decide(tid, crc)
+        arms = self.store.arms
+        for j, i in enumerate(ok):
+            r = records[i]
+            results[i] = (f"{r[0]}{self.delim}{r[1]}{self.delim}"
+                          f"{arms[int(sels[j])]}")
+            self.counters.incr(SERVE_GROUP, "Decisions")
+        return results
+
+
 ADAPTER_KINDS: Dict[str, type] = {
     cls.KIND: cls for cls in (NaiveBayesAdapter, MarkovClassifierAdapter,
-                              DecisionTreeAdapter, NearestNeighborAdapter)}
+                              DecisionTreeAdapter, NearestNeighborAdapter,
+                              BanditDecisionAdapter)}

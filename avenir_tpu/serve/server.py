@@ -174,6 +174,9 @@ class PredictionServer:
         # evaluates SLO windows) — they run here, never on an I/O shard
         self._cmd_pool = ThreadPoolExecutor(
             max_workers=2, thread_name_prefix="serve-cmd")
+        #: subsystem command hooks: cmd name -> fn(request obj) -> response
+        #: dict (the stream service registers "feedback"/"stream" here)
+        self.command_extensions: Dict[str, Callable[[dict], dict]] = {}
         self._watchdog_thread = self._start_watchdog(
             config.get_float("serve.watchdog.interval.sec", 0.5))
         telemetry.configure_from_config(config)
@@ -445,6 +448,12 @@ class PredictionServer:
                                      replica=obj.get("replica"))
             return {"ok": True, "model": entry.name,
                     "version": entry.version}
+        ext = self.command_extensions.get(cmd)
+        if ext is not None:
+            # subsystem-registered commands (e.g. the stream service's
+            # "feedback"/"stream"): responses funnel through the same
+            # _finish_response chokepoint as every built-in command
+            return ext(obj)
         return {"error": f"unknown cmd {cmd!r}"}
 
     # -- predict: routing + submission (shared sync/async) -----------------
@@ -467,9 +476,13 @@ class PredictionServer:
         single = rows is None
         if single:
             row = obj.get("row")
+            if row is None:
+                # streaming-decision alias: {"decide": "eventID,tenant"}
+                # routes identically to {"row": ...} (avenir_tpu/stream)
+                row = obj.get("decide")
             if not isinstance(row, str):
-                return {"error": 'request needs "row" (string) or '
-                                 '"rows" (list of strings)'}
+                return {"error": 'request needs "row" (string), "rows" '
+                                 '(list of strings), or "decide" (string)'}
             rows = [row]
         elif (not isinstance(rows, list)
               or not all(isinstance(r, str) for r in rows)):

@@ -1,0 +1,30 @@
+"""Streaming decision service: real-time bandit serving with
+exactly-once feedback folding over Redis streams.
+
+The reference avenir's real-time layer was Storm topologies fed by Redis
+queues doing online reinforcement learning; ``models/streaming.py``
+rebuilt the queue topology as a pull loop.  This package is the
+production half (ROADMAP item 6): a per-tenant bandit scorer (Thompson
+sampling / UCB over device-resident per-arm posterior state) served
+through the event-loop frontend/pool/router path, and a feedback
+consumer that reads reward events from a Redis stream and folds them
+into the posterior carry online — registered as a ``FoldSpec`` so the
+fold-algebra verifier certifies it like every batch fold, with
+exactly-once application riding the checkpoint layer (stream offset +
+carry in ONE sidecar, generation fallback on corruption).
+
+Modules:
+
+- :mod:`.posterior` — the per-(tenant, arm) posterior monoid: the pure
+  fold ``local_fn``, the host-form :class:`~.posterior.ArmPosterior`
+  (state_dict/from_state/merge), the device-resident
+  :class:`~.posterior.PosteriorStore` (donated-carry folds + jitted
+  Thompson/UCB decisions), and the shared-scan
+  :class:`~.posterior.FeedbackFoldSpec`.
+- :mod:`.consumer` — the exactly-once Redis-stream feedback consumer
+  (XREADGROUP + watermark dedup + offset checkpointing + regret
+  anomaly triggers).
+- :mod:`.service` — the ``python -m avenir_tpu stream`` entry point
+  composing a :class:`~avenir_tpu.serve.server.PredictionServer` with
+  the consumer.
+"""

@@ -1,0 +1,549 @@
+"""Per-(tenant, arm) bandit posterior state: the streaming-decision monoid.
+
+Every tenant owns one row of per-arm sufficient statistics — pull counts
+and reward sums — held device-resident as a fold carry exactly like the
+batch count-table models: reward events fold in as tiny donated-carry
+scatter-adds (``_posterior_local``, the same ``local_fn`` contract every
+``core.pipeline`` fold uses), and two carries combine by elementwise add
+(``core.multiscan.merge_carries``) — a commutative monoid, certified by
+the PR-12 split-invariance verifier through :class:`FeedbackFoldSpec`
+(registered in ``core.algebra.verification_jobs``).  Rewards are
+INTEGERS on the wire (the reference's ``actionID,reward`` format), so
+their float sums are exact in any association order — byte-identical
+posteriors however the event stream is chunked, replayed, or merged.
+
+Three layers:
+
+- :class:`ArmPosterior` — the host-form state value: ``state_dict`` /
+  ``from_state`` / ``merge`` (the telemetry-snapshot merge contract,
+  linted by the merge-closure rule) plus the canonical emitted line
+  format shared by the batch aggregator and the streaming audit.
+- :class:`PosteriorStore` — the live device-resident store: donated-
+  carry folds for the feedback consumer, a donation-free serving
+  snapshot for the decide path, and the jitted Thompson-sampling / UCB
+  decision kernels (per-decision keys derive from the event id's CRC,
+  so a decision is a pure function of (posterior, seed, event id) —
+  byte-identical across batching, restarts, and replica pools).
+- :class:`FeedbackFoldSpec` — the shared-scan FoldSpec replaying a
+  reward-event CSV log into posterior state; the batch twin the
+  byte-equivalence gate compares the online consumer against.
+
+Config surface (``stream.*``; README "Streaming decisioning"):
+``stream.tenants`` / ``stream.tenants.path`` (tenant manifest),
+``stream.arms``, ``stream.algorithm`` (``thompson`` | ``ucb``),
+``stream.seed``, ``stream.thompson.sigma``, ``stream.posterior.dtype``
+(``float64`` | ``float32``), ``stream.store`` (process-local store
+registry key), and the batch-replay column mapping
+``stream.tenant.ordinal`` / ``stream.arm.ordinal`` /
+``stream.reward.ordinal``.
+"""
+
+from __future__ import annotations
+
+import re
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core import sanitizer, telemetry
+from ..core.metrics import Counters
+from ..core.multiscan import FoldSpec as MultiScanFoldSpec
+from ..core.io import read_lines, write_output
+from ..ops.counting import count_table
+
+KEY_TENANTS = "stream.tenants"
+KEY_TENANTS_PATH = "stream.tenants.path"
+KEY_ARMS = "stream.arms"
+KEY_ALGORITHM = "stream.algorithm"
+KEY_SEED = "stream.seed"
+KEY_SIGMA = "stream.thompson.sigma"
+KEY_DTYPE = "stream.posterior.dtype"
+KEY_STORE = "stream.store"
+KEY_TENANT_ORD = "stream.tenant.ordinal"
+KEY_ARM_ORD = "stream.arm.ordinal"
+KEY_REWARD_ORD = "stream.reward.ordinal"
+
+DEFAULT_SEED = 2026
+DEFAULT_SIGMA = 1.0
+DEFAULT_DTYPE = "float64"
+DEFAULT_STORE = "default"
+
+ALGO_THOMPSON = "thompson"
+ALGO_UCB = "ucb"
+
+STREAM_GROUP = "Stream"
+
+#: strict integer reward syntax (int() alone would admit '1_0'/' 10') —
+#: the same guard the streaming learner loop applies to its reward queue
+_INT_RE = re.compile(r"-?\d+", re.ASCII)
+
+
+def _posterior_local(t, a, r, mask, n_tenants, n_arms, dtype_name):
+    """The per-chunk fold: scatter one event batch's (tenant, arm,
+    reward) triples into the ``{"pulls": [T, A] int64, "reward": [T, A]
+    <dtype>}`` carry.  Pure (no clock/RNG/globals — the fold-purity
+    rule) and elementwise-additive, so ``merge_carries`` is its monoid
+    merge; out-of-range or masked rows contribute nothing (the
+    ``count_table`` range drop)."""
+    import jax.numpy as jnp
+
+    sizes = (n_tenants, n_arms)
+    return {
+        "pulls": count_table(sizes, (t, a), mask=mask, dtype=jnp.int64),
+        "reward": count_table(sizes, (t, a), weights=r, mask=mask,
+                              dtype=np.dtype(dtype_name)),
+    }
+
+
+def _ucb_decide(pulls, reward, tid):
+    """Deterministic UCB1 over normalized posterior means: untried arms
+    first (infinite bonus; ties resolve to the lowest arm index), else
+    ``mean + sqrt(2 ln N_tenant / n_arm)``."""
+    import jax.numpy as jnp
+
+    n = pulls[tid].astype(reward.dtype)                    # [B, A]
+    mean = reward[tid] / jnp.maximum(n, 1.0)
+    total = jnp.maximum(jnp.sum(pulls, axis=1), 1)[tid]
+    bonus = jnp.sqrt(2.0 * jnp.log(total.astype(reward.dtype))[:, None]
+                     / jnp.maximum(n, 1.0))
+    val = jnp.where(n == 0, jnp.inf, mean + bonus)
+    return jnp.argmax(val, axis=1)
+
+
+def _thompson_decide(pulls, reward, tid, crc, seed, sigma):
+    """Gaussian Thompson sampling: per-arm draw ``N(mean, sigma /
+    sqrt(n + 1))`` with the per-decision PRNG key derived by folding the
+    event id's CRC32 into the configured seed — a decision is a pure
+    function of (posterior, seed, event id), independent of how requests
+    batch together, so responses are byte-identical across micro-batch
+    composition, replica choice, and kill/resume."""
+    import jax
+    import jax.numpy as jnp
+
+    n = pulls[tid].astype(reward.dtype)                    # [B, A]
+    mean = reward[tid] / jnp.maximum(n, 1.0)
+    sd = sigma / jnp.sqrt(n + 1.0)
+    base = jax.random.PRNGKey(seed)
+    n_arms = mean.shape[1]
+
+    def draw(c):
+        return jax.random.normal(jax.random.fold_in(base, c), (n_arms,),
+                                 mean.dtype)
+
+    z = jax.vmap(draw)(crc)
+    return jnp.argmax(mean + sd * z, axis=1)
+
+
+def event_crc(event_id: str) -> int:
+    """The per-decision RNG discriminator: CRC32 of the event id (stable
+    across processes and platforms)."""
+    return zlib.crc32(event_id.encode("utf-8"))
+
+
+def parse_event(fields: Sequence[str], t_ord: int, a_ord: int, r_ord: int,
+                tenant_index: Dict[str, int], arm_index: Dict[str, int]
+                ) -> Optional[Tuple[int, int, int]]:
+    """One reward event's (tenant idx, arm idx, reward) — or None for a
+    malformed event (short row, unknown tenant/arm, non-integer reward).
+    ONE parser shared by the online consumer and the batch replay spec,
+    so the two paths cannot drift on what counts as an event."""
+    need = max(t_ord, a_ord, r_ord) + 1
+    if len(fields) < need:
+        return None
+    ti = tenant_index.get(str(fields[t_ord]))
+    ai = arm_index.get(str(fields[a_ord]))
+    rs = str(fields[r_ord])
+    if ti is None or ai is None or not _INT_RE.fullmatch(rs):
+        return None
+    return ti, ai, int(rs)
+
+
+def posterior_lines(tenants: Sequence[str], arms: Sequence[str],
+                    pulls: np.ndarray, reward: np.ndarray,
+                    delim: str = ",") -> List[str]:
+    """The canonical posterior emission: one ``tenant,arm,pulls,
+    rewardSum`` line per (tenant, arm), in manifest order — the format
+    both the batch aggregator's output file and the streaming audit
+    produce, so byte equality IS posterior equality."""
+    out = []
+    for i, tenant in enumerate(tenants):
+        for j, arm in enumerate(arms):
+            out.append(f"{tenant}{delim}{arm}{delim}{int(pulls[i, j])}"
+                       f"{delim}{float(reward[i, j])!r}")
+    return out
+
+
+def _dtype_from_name(name: str) -> np.dtype:
+    if name not in ("float32", "float64"):
+        raise ValueError(
+            f"{KEY_DTYPE} must be float32 or float64: {name!r}")
+    return np.dtype(name)
+
+
+def tenants_from_config(config) -> List[str]:
+    """The declared tenant manifest: the inline ``stream.tenants`` list,
+    or one tenant id per line of ``stream.tenants.path``.  Declared up
+    front (never discovered from traffic) so carry shapes are fixed,
+    checkpoints are portable, and per-host encoder alignment can never
+    be an issue for this fold."""
+    inline = config.get(KEY_TENANTS)
+    if inline:
+        names = [s.strip() for s in inline.split(",") if s.strip()]
+    else:
+        path = config.get(KEY_TENANTS_PATH)
+        if not path:
+            raise KeyError(
+                f"missing tenant manifest: set {KEY_TENANTS} or "
+                f"{KEY_TENANTS_PATH}")
+        names = [l.strip() for l in read_lines(path) if l.strip()]
+    if not names:
+        raise ValueError(f"{KEY_TENANTS} is empty")
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate tenant ids in {KEY_TENANTS}")
+    return names
+
+
+def arms_from_config(config) -> List[str]:
+    names = [s.strip() for s in config.must(KEY_ARMS).split(",")
+             if s.strip()]
+    if len(names) < 2:
+        raise ValueError(f"{KEY_ARMS} needs at least two arms: {names}")
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate arm ids in {KEY_ARMS}")
+    return names
+
+
+class ArmPosterior:
+    """Host-form per-(tenant, arm) posterior state — the monoid value.
+
+    ``merge`` is elementwise add over identical manifests (exactly
+    ``core.multiscan.merge_carries`` on the host side); ``state_dict`` /
+    ``from_state`` round-trip it for checkpoints and snapshots.  Rewards
+    are integer-valued, so merges are exact in any order."""
+
+    __slots__ = ("tenants", "arms", "pulls", "reward")
+
+    def __init__(self, tenants: Sequence[str], arms: Sequence[str],
+                 pulls: Optional[np.ndarray] = None,
+                 reward: Optional[np.ndarray] = None,
+                 dtype: np.dtype = np.dtype(DEFAULT_DTYPE)):
+        self.tenants = list(tenants)
+        self.arms = list(arms)
+        shape = (len(self.tenants), len(self.arms))
+        self.pulls = (np.zeros(shape, np.int64) if pulls is None
+                      else np.asarray(pulls, np.int64).reshape(shape))
+        self.reward = (np.zeros(shape, dtype) if reward is None
+                       else np.asarray(reward, dtype).reshape(shape))
+
+    def state_dict(self) -> dict:
+        return {"tenants": list(self.tenants), "arms": list(self.arms),
+                "pulls": np.asarray(self.pulls),
+                "reward": np.asarray(self.reward),
+                "dtype": str(self.reward.dtype)}
+
+    @classmethod
+    def from_state(cls, state: dict) -> "ArmPosterior":
+        return cls(state["tenants"], state["arms"], pulls=state["pulls"],
+                   reward=state["reward"],
+                   dtype=np.dtype(state["dtype"]))
+
+    def merge(self, other: "ArmPosterior") -> "ArmPosterior":
+        if (self.tenants != other.tenants or self.arms != other.arms):
+            raise ValueError(
+                "cannot merge posteriors over different manifests")
+        self.pulls = self.pulls + other.pulls
+        self.reward = self.reward + other.reward.astype(self.reward.dtype)
+        return self
+
+    def apply(self, t_idx: np.ndarray, a_idx: np.ndarray,
+              rewards: np.ndarray) -> None:
+        """Host-side fold of one event batch (the consumer's mirror —
+        integer adds, so it stays byte-equal to the device carry)."""
+        np.add.at(self.pulls, (t_idx, a_idx), 1)
+        np.add.at(self.reward, (t_idx, a_idx),
+                  np.asarray(rewards).astype(self.reward.dtype))
+
+    def means(self) -> np.ndarray:
+        """Per-(tenant, arm) posterior mean reward (0 for untried)."""
+        return self.reward / np.maximum(self.pulls, 1)
+
+    def lines(self, delim: str = ",") -> List[str]:
+        return posterior_lines(self.tenants, self.arms, self.pulls,
+                               self.reward, delim)
+
+
+class PosteriorStore:
+    """The live device-resident posterior for one tenant fleet.
+
+    The feedback consumer folds event batches in through a donated-carry
+    :class:`~avenir_tpu.core.pipeline.ChunkFold` (the same jitted
+    machinery every batch fold uses); the decide path scores against a
+    donation-free on-device SNAPSHOT republished after every fold, so a
+    concurrent decision can never read a donated buffer.  Decisions are
+    pure functions of (snapshot, seed, event id) — see
+    :func:`_thompson_decide`."""
+
+    def __init__(self, key: str, tenants: Sequence[str],
+                 arms: Sequence[str], algorithm: str = ALGO_THOMPSON,
+                 seed: int = DEFAULT_SEED, sigma: float = DEFAULT_SIGMA,
+                 dtype: str = DEFAULT_DTYPE, mesh=None):
+        from ..core import pipeline
+        from ..parallel.mesh import get_mesh
+
+        if algorithm not in (ALGO_THOMPSON, ALGO_UCB):
+            raise ValueError(
+                f"{KEY_ALGORITHM} must be {ALGO_THOMPSON} or {ALGO_UCB}: "
+                f"{algorithm!r}")
+        self.key = key
+        self.tenants = list(tenants)
+        self.arms = list(arms)
+        self.tenant_index = {t: i for i, t in enumerate(self.tenants)}
+        self.arm_index = {a: i for i, a in enumerate(self.arms)}
+        self.algorithm = algorithm
+        self.seed = int(seed)
+        self.sigma = float(sigma)
+        self.dtype = _dtype_from_name(dtype)
+        self.mesh = mesh or get_mesh()
+        self._lock = sanitizer.make_lock("stream.posterior")
+        self._xfer = pipeline.ChunkTransfer(self.mesh, capacity=None)
+        self._fold = pipeline.ChunkFold(
+            _posterior_local,
+            static_args=(len(self.tenants), len(self.arms),
+                         str(self.dtype)),
+            mesh=self.mesh, span_name="stream.fold",
+            span_attrs={"store": key})
+        self._fold.seed(self._zero_state())
+        self._serve_state = self._fold.snapshot()
+        self._decide_fns: dict = {}
+
+    def _zero_state(self) -> dict:
+        shape = (len(self.tenants), len(self.arms))
+        return {"pulls": np.zeros(shape, np.int64),
+                "reward": np.zeros(shape, self.dtype)}
+
+    @classmethod
+    def from_config(cls, key: str, config, mesh=None) -> "PosteriorStore":
+        return cls(key,
+                   tenants_from_config(config),
+                   arms_from_config(config),
+                   algorithm=config.get(KEY_ALGORITHM, ALGO_THOMPSON),
+                   seed=config.get_int(KEY_SEED, DEFAULT_SEED),
+                   sigma=config.get_float(KEY_SIGMA, DEFAULT_SIGMA),
+                   dtype=config.get(KEY_DTYPE, DEFAULT_DTYPE),
+                   mesh=mesh)
+
+    # -- the feedback fold (consumer side) ---------------------------------
+    def fold_events(self, t_idx: np.ndarray, a_idx: np.ndarray,
+                    rewards: np.ndarray) -> None:
+        """Fold one parsed event batch into the device carry (donated,
+        async dispatch) and republish the serving snapshot."""
+        n = len(t_idx)
+        if n == 0:
+            return
+        arrs = (np.asarray(t_idx, np.int32), np.asarray(a_idx, np.int32),
+                np.asarray(rewards, np.int64))
+        with self._lock:
+            self._fold.fold(self._xfer(arrs))
+            self._serve_state = self._fold.snapshot()
+
+    def restore(self, state: dict) -> None:
+        """Seed the carry from a checkpointed host posterior (resume)."""
+        post = ArmPosterior.from_state(state)
+        if post.tenants != self.tenants or post.arms != self.arms:
+            raise ValueError(
+                "checkpointed posterior manifest does not match this "
+                "store's tenant/arm manifest")
+        with self._lock:
+            self._fold.seed({"pulls": post.pulls,
+                             "reward": post.reward.astype(self.dtype)})
+            self._serve_state = self._fold.snapshot()
+
+    def host_posterior(self) -> ArmPosterior:
+        """The carry materialized to host (blocks on pending folds)."""
+        with self._lock:
+            carry = self._fold.result()
+        return ArmPosterior(self.tenants, self.arms,
+                            pulls=np.asarray(carry["pulls"]),
+                            reward=np.asarray(carry["reward"]),
+                            dtype=self.dtype)
+
+    # -- the decide path (serving side) ------------------------------------
+    def _decide_fn(self):
+        fn = self._decide_fns.get(self.algorithm)
+        if fn is None:
+            if self.algorithm == ALGO_UCB:
+                fn = telemetry.profiled_jit(
+                    _ucb_decide, f"stream.decide.ucb:{self.key}")
+            else:
+                seed, sigma = self.seed, self.sigma
+
+                def thompson(pulls, reward, tid, crc):
+                    return _thompson_decide(pulls, reward, tid, crc,
+                                            seed, sigma)
+
+                fn = telemetry.profiled_jit(
+                    thompson, f"stream.decide.thompson:{self.key}")
+            self._decide_fns[self.algorithm] = fn
+        return fn
+
+    def decide(self, tenant_idx: np.ndarray,
+               crcs: np.ndarray) -> np.ndarray:
+        """Arm index per request row (rows pre-padded by the caller; pad
+        rows score against tenant 0 and are discarded)."""
+        with self._lock:
+            state = self._serve_state
+            fn = self._decide_fn()     # memo mutation under the lock
+        tid = np.asarray(tenant_idx, np.int32)
+        if self.algorithm == ALGO_UCB:
+            sels = fn(state["pulls"], state["reward"], tid)
+        else:
+            sels = fn(state["pulls"], state["reward"], tid,
+                      np.asarray(crcs, np.uint32))
+        return np.asarray(sels)
+
+
+# ---------------------------------------------------------------------------
+# the process-local store registry (shared by replicas + the consumer)
+# ---------------------------------------------------------------------------
+
+_STORES: Dict[str, PosteriorStore] = {}
+_STORES_LOCK = sanitizer.make_lock("stream.stores")
+
+
+def get_store(key: str) -> Optional[PosteriorStore]:
+    with _STORES_LOCK:
+        return _STORES.get(key)
+
+
+def register_store(store: PosteriorStore) -> PosteriorStore:
+    with _STORES_LOCK:
+        _STORES[store.key] = store
+    return store
+
+
+def _check_store_config(store: PosteriorStore, config) -> None:
+    """A config resolving to an already-registered store must not
+    silently disagree with it: every stream.* identity field the config
+    DECLARES (an adapter built from just ``stream.store`` declares
+    none) is checked against the registered store, so a stale-manifest
+    store can never quietly serve a newer config's decisions."""
+    declared = []
+    if config.get(KEY_TENANTS) or config.get(KEY_TENANTS_PATH):
+        declared.append(("tenants", tenants_from_config(config),
+                         store.tenants))
+    if config.get(KEY_ARMS):
+        declared.append(("arms", arms_from_config(config), store.arms))
+    if config.get(KEY_ALGORITHM):
+        declared.append(("algorithm", config.get(KEY_ALGORITHM),
+                         store.algorithm))
+    if config.get(KEY_SEED) is not None:
+        declared.append(("seed", config.get_int(KEY_SEED), store.seed))
+    if config.get(KEY_DTYPE):
+        declared.append(("dtype", str(_dtype_from_name(
+            config.get(KEY_DTYPE))), str(store.dtype)))
+    for field, want, have in declared:
+        if want != have:
+            raise ValueError(
+                f"stream.store {store.key!r} is already registered with "
+                f"{field}={have!r}, but this config declares {want!r} — "
+                f"use a different {KEY_STORE} key (or restart) instead "
+                f"of silently serving from the stale manifest")
+
+
+def ensure_store(config, mesh=None) -> PosteriorStore:
+    """The store named by ``stream.store`` — the registered instance
+    when one exists (every pool replica's adapter and the feedback
+    consumer resolve to the SAME device state; any stream.* identity
+    fields the config declares must MATCH it — see
+    :func:`_check_store_config`), else built from the config manifest
+    and registered (idempotent, thread-safe)."""
+    key = config.get(KEY_STORE, DEFAULT_STORE)
+    with _STORES_LOCK:
+        store = _STORES.get(key)
+        if store is None:
+            store = _STORES[key] = PosteriorStore.from_config(
+                key, config, mesh=mesh)
+        else:
+            _check_store_config(store, config)
+        return store
+
+
+def clear_stores() -> None:
+    """Drop every registered store (test isolation)."""
+    with _STORES_LOCK:
+        _STORES.clear()
+
+
+# ---------------------------------------------------------------------------
+# the shared-scan FoldSpec (batch replay of a reward-event log)
+# ---------------------------------------------------------------------------
+
+class FeedbackFoldSpec(MultiScanFoldSpec):
+    """Shared-scan FoldSpec replaying a ``tenant,arm,reward`` event CSV
+    into per-arm posterior state — the batch twin of the online feedback
+    consumer, and the byte-equivalence reference the streaming gate
+    compares against.  Tenant/arm manifests are DECLARED
+    (``stream.tenants`` / ``stream.arms``), so ``static_args`` are fixed
+    at construction and no discovery-order state exists; malformed
+    events (unknown tenant/arm, non-integer reward) are skipped and
+    counted, identically to the online consumer's
+    :func:`parse_event` guard.
+
+    Split invariance (fold(A ++ B) == merge_carries(fold(A), fold(B)),
+    any chunk boundaries/order) is property-tested at mesh=1 and 8-way
+    by the fold-algebra verifier (core.algebra, tests/test_algebra.py —
+    jid ``bandit_fb``); rewards are integers, so float sums are exact
+    under every arrangement.
+    """
+
+    fixed_capacity = False
+
+    def __init__(self, config, out_path: str):
+        self.out_path = out_path
+        self.name = "FeedbackFold"
+        self.tenants = tenants_from_config(config)
+        self.arms = arms_from_config(config)
+        self.tenant_index = {t: i for i, t in enumerate(self.tenants)}
+        self.arm_index = {a: i for i, a in enumerate(self.arms)}
+        self.dtype = _dtype_from_name(config.get(KEY_DTYPE, DEFAULT_DTYPE))
+        self.t_ord = config.get_int(KEY_TENANT_ORD, 0)
+        self.a_ord = config.get_int(KEY_ARM_ORD, 1)
+        self.r_ord = config.get_int(KEY_REWARD_ORD, 2)
+        self.delim_out = config.field_delim_out()
+        self.local_fn = _posterior_local
+        self.static_args = (len(self.tenants), len(self.arms),
+                            str(self.dtype))
+        self.malformed = 0
+        self.events = 0
+
+    def encode(self, ctx):
+        t_idx, a_idx, rewards = [], [], []
+        for fields in ctx.fields():
+            ev = parse_event(fields, self.t_ord, self.a_ord, self.r_ord,
+                             self.tenant_index, self.arm_index)
+            if ev is None:
+                self.malformed += 1
+                continue
+            t_idx.append(ev[0])
+            a_idx.append(ev[1])
+            rewards.append(ev[2])
+        if not t_idx:
+            return None
+        self.events += len(t_idx)
+        return (np.asarray(t_idx, np.int32), np.asarray(a_idx, np.int32),
+                np.asarray(rewards, np.int64))
+
+    def finalize(self, carry) -> Counters:
+        counters = Counters()
+        if carry is None:
+            pulls = np.zeros((len(self.tenants), len(self.arms)), np.int64)
+            reward = np.zeros_like(pulls, dtype=self.dtype)
+        else:
+            pulls = np.asarray(carry["pulls"])
+            reward = np.asarray(carry["reward"])
+        write_output(self.out_path, posterior_lines(
+            self.tenants, self.arms, pulls, reward, self.delim_out))
+        counters.set(STREAM_GROUP, "Events folded", self.events)
+        counters.set(STREAM_GROUP, "Malformed events", self.malformed)
+        return counters

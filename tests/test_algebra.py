@@ -17,7 +17,7 @@ from avenir_tpu.core import multiscan
 from avenir_tpu.core.metrics import Counters
 from avenir_tpu.core.obs import LatencyHistogram, Metrics
 
-JIDS = ["nb", "mi", "corr", "het", "mst", "stats"]
+JIDS = ["nb", "mi", "corr", "het", "mst", "stats", "bandit_fb"]
 ROWS = algebra.verification_rows()
 
 
