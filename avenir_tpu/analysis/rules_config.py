@@ -34,6 +34,9 @@ NAMESPACE_GROUPS: Dict[str, str] = {
     # the streaming decision service (avenir_tpu/stream); the literal
     # dot keeps the legacy `streaming.max.pending.batches` key out
     "stream": r"(?:stream)",
+    # the multi-tenant managed model cache (serve/modelcache.py +
+    # serve/admission.py): serve.cache.* residency/cold-start/quota keys
+    "cache": r"(?:serve\.cache)",
 }
 
 _ACCESSORS = (r"\.(?:get|get_int|get_float|get_boolean|get_list|must|"

@@ -62,6 +62,8 @@ RESPONSE_SITES_OK: Dict[str, str] = {
     "_handle_obj": "returns into handle_line/dispatch_line funnels",
     "_command": "returns into the funnels via _handle_obj",
     "_submit": "returns into _predict -> funnels",
+    "_evicted_mid_request": "returns into _submit's cold-start paths "
+                            "-> _predict -> funnels",
     "_assemble": "returns into _predict/_AsyncCollector -> funnels",
     "_finish": "_AsyncCollector: fires the wrapped (funnel) callback",
 }

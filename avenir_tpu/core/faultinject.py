@@ -89,6 +89,15 @@ Instrumented points (grep ``fire(`` / ``mangle(`` call sites):
                       applied (consumer crash: the entries stay pending
                       unacked and must be redelivered on resume with
                       zero drops or double-applies)
+``promote_slow``      model-cache promote jobs (serve/modelcache.py),
+                      fired with the MODEL NAME as the call-site tag —
+                      sleeps ``arg`` ms (default 20): deterministic slow
+                      cold starts for the retry_after / deadline tests
+``promote_fail``      model-cache promote jobs (tagged by model name) —
+                      raises ``InjectedFault`` before any variant group
+                      builds: the promote fails structurally and the
+                      previously-resident set keeps serving untouched
+                      (the chaos test in tests/test_modelcache.py)
 ====================  =====================================================
 
 Disabled-mode cost: ``get_injector()`` returns None until a plan is
@@ -114,7 +123,7 @@ KEY_SEED = "fault.inject.seed"
 POINTS = ("read", "corrupt", "slow", "h2d", "worker_death", "scorer",
           "scorer_slow", "batcher_death", "scorer_poison", "torn_write",
           "ckpt_corrupt", "feedback_dup", "feedback_reorder",
-          "feedback_drop")
+          "feedback_drop", "promote_slow", "promote_fail")
 
 
 class InjectedReadError(OSError):
@@ -316,7 +325,7 @@ class FaultInjector:
         where = f"{point}@{index if index is not None else 'auto'}"
         if point == "read":
             raise InjectedReadError(f"injected transient read error ({where})")
-        if point in ("slow", "scorer_slow"):
+        if point in ("slow", "scorer_slow", "promote_slow"):
             time.sleep(float(e.arg or 20) / 1000.0)
             return
         if point == "h2d":

@@ -101,6 +101,10 @@ def _sid(entry_id: str) -> Tuple[int, int]:
     return int(ms), int(seq or 0)
 
 
+#: below every real entry id (trimming to here would be a no-op)
+ZERO_TRIM_ID = "0-0"
+
+
 class FakeRedis:
     """fakeredis-style in-process double of the redis-py commands the
     transports use — the list commands (:class:`RedisTransport`: same
@@ -276,6 +280,44 @@ class FakeRedis:
                     "min": pend[0] if pend else None,
                     "max": pend[-1] if pend else None}
 
+    def xtrim(self, key: str, maxlen: Optional[int] = None,
+              minid: Optional[str] = None) -> int:
+        """XTRIM: drop entries below ``minid`` (exclusive, the server's
+        MINID strategy) or beyond ``maxlen`` newest; returns entries
+        removed.  Deliberately DUMB, like the server command — computing
+        a safe horizon across consumer groups is the caller's job
+        (``RedisStreamTransport.trim_acked``)."""
+        if (maxlen is None) == (minid is None):
+            raise FakeRedisError(
+                "ERR XTRIM requires exactly one of maxlen / minid")
+        with self._cond:
+            entries = self._streams.get(key)
+            if not entries:
+                return 0
+            if minid is not None:
+                cut = _sid(str(minid))
+                keep = [e for e in entries if _sid(e[0]) >= cut]
+            else:
+                keep = entries[len(entries) - min(maxlen, len(entries)):]
+            removed = len(entries) - len(keep)
+            self._streams[key] = keep
+            return removed
+
+    def xinfo_groups(self, key: str) -> List[dict]:
+        """XINFO GROUPS: per-group name / last-delivered-id / pending
+        count (the subset the trim-horizon computation reads)."""
+        with self._cond:
+            if key not in self._streams:
+                raise FakeRedisError("ERR no such key")
+            out = []
+            for (k, group), g in sorted(self._groups.items()):
+                if k != key:
+                    continue
+                out.append({"name": group,
+                            "last-delivered-id": g["last"],
+                            "pending": len(g["pending"])})
+            return out
+
     def advance_id_clock(self, key: str, ms: int) -> None:
         """Advance the stream's id counter to at least ``ms``.  A real
         server's entry ids are millisecond-clock based and therefore
@@ -408,6 +450,57 @@ class RedisStreamTransport:
     def length(self) -> int:
         return int(with_retries(lambda: self._r.xlen(self.stream),
                                 op="redis"))
+
+    # -- trimming (ROADMAP: streams grow forever without it) ---------------
+    @staticmethod
+    def _next_id(eid: str) -> str:
+        ms, seq = _sid(eid)
+        return f"{ms}-{seq + 1}"
+
+    def all_groups_ack_floor(self) -> Optional[str]:
+        """The smallest entry id ANY consumer group of this stream still
+        needs: per group, the oldest pending (delivered, unacked) entry
+        when one exists, else the first id past its last-delivered
+        cursor (undelivered entries must survive).  Entries BELOW the
+        minimum across groups are acked by every consumer — safe to
+        trim.  None when the stream has no groups (nothing is provably
+        consumed, so nothing trims)."""
+        groups = with_retries(lambda: self._r.xinfo_groups(self.stream),
+                              op="redis")
+        floors = []
+        for g in groups:
+            name = g.get("name")
+            oldest = None
+            if int(g.get("pending", 0)) > 0:
+                p = with_retries(
+                    lambda n=name: self._r.xpending(self.stream, n),
+                    op="redis")
+                # the group may have acked its last pending entry
+                # between the xinfo read and this call: min comes back
+                # None and the last-delivered fallback below applies
+                oldest = p.get("min")
+            if oldest is not None:
+                floors.append(str(oldest))
+            else:
+                floors.append(self._next_id(
+                    str(g.get("last-delivered-id", "0-0"))))
+        if not floors:
+            return None
+        return min(floors, key=_sid)
+
+    def trim_acked(self, horizon: str) -> int:
+        """XTRIM entries at or below ``horizon`` (a checkpoint-covered
+        ack horizon), clamped to the ALL-consumers ack floor so no
+        group's undelivered or still-pending entries are ever dropped;
+        returns entries removed."""
+        floor = self.all_groups_ack_floor()
+        if floor is None:
+            return 0
+        cut = min(self._next_id(horizon), floor, key=_sid)
+        if _sid(cut) <= _sid(ZERO_TRIM_ID):
+            return 0
+        return int(with_retries(
+            lambda: self._r.xtrim(self.stream, minid=cut), op="redis"))
 
 
 def _get(config: Dict, *keys, default=None, required=False):

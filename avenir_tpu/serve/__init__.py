@@ -38,12 +38,21 @@ PAPERS.md "Online serving").
   scorer failures, half-open probes) behind the graceful-degradation
   surface: deadlines, degraded health, and a watchdog that restarts dead
   batcher workers (README "Fault tolerance").
+- ``modelcache`` + ``admission`` — multi-tenant model multiplexing:
+  ``serve.cache.models`` registers thousands of tenants as COLD catalog
+  descriptors behind an HBM-budget-aware resident LRU with async
+  promote/demote, structured cold-start responses, per-tenant promote
+  quotas, and shape-signature compile reuse across same-schema tenants
+  (README "Multi-tenant model multiplexing").
 """
 
+from .admission import QuotaExceeded, TenantAdmission           # noqa: F401
 from .batcher import MicroBatcher, ShedError                    # noqa: F401
 from .breaker import CircuitBreaker, CircuitOpenError           # noqa: F401
-from .engine import ADAPTER_KINDS, pow2_bucket                  # noqa: F401
+from .engine import (ADAPTER_KINDS, SharedCompileTier,          # noqa: F401
+                     get_shared_tier, pow2_bucket)
 from .frontend import EventLoopFrontend                         # noqa: F401
+from .modelcache import ColdStartPending, ModelCache            # noqa: F401
 from .pool import ScorerPool                                    # noqa: F401
 from .registry import ModelRegistry                             # noqa: F401
 from .router import VariantRouter                               # noqa: F401
@@ -52,7 +61,9 @@ from .server import (PredictionServer, TruncatedResponseError,  # noqa: F401
 from .slo import SLOBoard                                       # noqa: F401
 
 __all__ = ["ADAPTER_KINDS", "CircuitBreaker", "CircuitOpenError",
-           "EventLoopFrontend", "MicroBatcher", "ModelRegistry",
-           "PredictionServer", "SLOBoard", "ScorerPool", "ShedError",
-           "TruncatedResponseError", "VariantRouter", "pow2_bucket",
-           "serve_main"]
+           "ColdStartPending", "EventLoopFrontend", "MicroBatcher",
+           "ModelCache", "ModelRegistry", "PredictionServer",
+           "QuotaExceeded", "SLOBoard", "ScorerPool",
+           "SharedCompileTier", "ShedError", "TenantAdmission",
+           "TruncatedResponseError", "VariantRouter", "get_shared_tier",
+           "pow2_bucket", "serve_main"]

@@ -25,11 +25,13 @@ move it (asserted in tests/test_serve.py).
 
 from __future__ import annotations
 
+import threading
+from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..core import telemetry
+from ..core import sanitizer, telemetry
 from ..core.config import JobConfig
 from ..core.io import split_line
 from ..core.metrics import Counters
@@ -82,6 +84,93 @@ def pow2_buckets(cap: int) -> List[int]:
     return out
 
 
+class SharedCompileTier:
+    """Process-shared compiled-scorer cache keyed by SHAPE SIGNATURE —
+    the multi-tenant compile-reuse tier (INFaaS/TF-Serving, PAPERS.md;
+    README "Multi-tenant model multiplexing").
+
+    Adapters key their compiled scorers by everything XLA compilation
+    actually depends on — score-function identity, padded bucket, and
+    the model tables' shapes/dtypes — NOT by adapter identity, so 1,000
+    same-schema NB tenants resolve to ONE compiled fold: the first
+    tenant's warmup compiles it, every later tenant's warmup and traffic
+    hit.  Steady-state ``Serve / Scorer compilations`` across a tenant
+    fleet therefore stays flat (asserted in tests/test_modelcache.py).
+
+    Concurrency: lookups are SINGLE-FLIGHT — N promote workers racing
+    the same signature block on one build instead of compiling N times
+    (per-key build events; a failed build wakes the waiters and the
+    next caller retries as the builder).  Eviction (bounded LRU, ``cap``
+    signatures) only drops the tier's reference: an in-flight score
+    holding the compiled fn keeps it alive, and a re-request simply
+    recompiles.  ``compiles + hits`` always equals total resolved gets
+    (the consistency the hammer test asserts)."""
+
+    def __init__(self, cap: int = 256):
+        self.cap = max(1, int(cap))
+        self._lock = sanitizer.make_lock("serve.compile.tier")
+        self._cache: "OrderedDict[tuple, object]" = OrderedDict()
+        self._building: Dict[tuple, threading.Event] = {}
+        self.compiles = 0
+        self.hits = 0
+        self.waits = 0
+
+    def get(self, key, build: Callable[[], object]):
+        """Resolve ``key`` to its compiled fn, building at most once per
+        key concurrently; returns ``(fn, compiled)`` where ``compiled``
+        says THIS call did the build."""
+        while True:
+            ev = None
+            with self._lock:
+                fn = self._cache.get(key)
+                if fn is not None:
+                    self._cache.move_to_end(key)
+                    self.hits += 1
+                    return fn, False
+                ev = self._building.get(key)
+                if ev is None:
+                    ev = self._building[key] = threading.Event()
+                    break
+                self.waits += 1
+            ev.wait()
+        try:
+            fn = build()
+        except BaseException:
+            # waiters retry; the next one becomes the builder
+            with self._lock:
+                self._building.pop(key, None)
+            ev.set()
+            raise
+        with self._lock:
+            self._cache[key] = fn
+            self._cache.move_to_end(key)
+            while len(self._cache) > self.cap:
+                self._cache.popitem(last=False)
+            self.compiles += 1
+            self._building.pop(key, None)
+        ev.set()
+        return fn, True
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._cache)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"size": len(self._cache), "cap": self.cap,
+                    "compiles": self.compiles, "hits": self.hits,
+                    "waits": self.waits}
+
+
+_SHARED_TIER = SharedCompileTier()
+
+
+def get_shared_tier() -> SharedCompileTier:
+    """The one process-wide compile tier (multi-tenant serving shares
+    compiled scorers across every registry/pool in the process)."""
+    return _SHARED_TIER
+
+
 class ScorerCompileCache:
     """Bounded LRU of compiled scorer functions with hit/miss counters.
 
@@ -89,14 +178,28 @@ class ScorerCompileCache:
     its first invocation — so ``Serve / Scorer compilations`` counts real
     compilation work.  Keys include the padded bucket shape, so a warmed
     bucket never recompiles until evicted (cap is sized above the bucket
-    count to make steady-state eviction impossible)."""
+    count to make steady-state eviction impossible).
 
-    def __init__(self, counters: Counters, cap: int = 32):
+    With ``tier`` set (multi-tenant cache mode; serve/modelcache.py)
+    lookups delegate to the process-shared :class:`SharedCompileTier`:
+    the per-model counters then bill only the compiles THIS model
+    caused — a tenant whose shapes another tenant already compiled
+    records hits, not compilations."""
+
+    def __init__(self, counters: Counters, cap: int = 32,
+                 tier: Optional[SharedCompileTier] = None):
         self._cache: dict = {}
         self._counters = counters
         self._cap = cap
+        self._tier = tier
 
     def get(self, key, build: Callable[[], object]):
+        if self._tier is not None:
+            fn, compiled = self._tier.get(key, build)
+            self._counters.incr(
+                SERVE_GROUP,
+                "Scorer compilations" if compiled else "Scorer cache hits")
+            return fn
         fn = bounded_cache_get(self._cache, key)
         if fn is None:
             fn = build()
@@ -137,6 +240,15 @@ class ModelAdapter:
 
     def warm(self, bucket: int) -> None:
         """Pre-compile the scorer at one batch bucket (no-op by default)."""
+
+    def device_bytes(self) -> int:
+        """Approximate bytes of device-resident model state this adapter
+        pins (tables, training matrices) — what the multi-tenant model
+        cache accounts against ``serve.cache.hbm.budget.bytes``.  Host-
+        only adapters (decision trees) and adapters over process-shared
+        state (bandit stores) report 0; the cache applies a per-replica
+        floor so residency is never free."""
+        return 0
 
     # -- shared helpers ----------------------------------------------------
     def _bucket(self, n: int) -> int:
@@ -212,12 +324,22 @@ class NaiveBayesAdapter(ModelAdapter):
         self._min_fields = max(
             [f.ordinal for f in self.encoder.feature_fields]
             + [self._cls_ord]) + 1
+        # shape signature: everything the XLA compile depends on — the
+        # score fn, the padded row width, and the table shapes/dtypes.
+        # Same-schema tenants share it, so the process-shared compile
+        # tier resolves all of them to ONE compiled scorer per bucket.
+        self._shape_sig = (
+            self._score_fn.__name__, self._F,
+            tuple((tuple(t.shape), str(t.dtype)) for t in self._tables))
+
+    def device_bytes(self) -> int:
+        return sum(int(t.nbytes) for t in self._tables)
 
     def _compiled(self, bucket: int):
         # profiled_jit: the (warmup or first-traffic) XLA compile of each
         # bucket's scorer lands in the xla.compile.ms telemetry counter
         return self.cache.get(
-            ("nb", id(self), bucket),
+            ("nb", self._shape_sig, bucket),
             lambda: telemetry.profiled_jit(self._score_fn,
                                            f"serve.nb.score.b{bucket}"))
 
@@ -316,6 +438,16 @@ class MarkovClassifierAdapter(ModelAdapter):
         self.seq_buckets = sorted({
             int(v) for v in
             (config.get("seq.buckets", "16,64")).split(",")})
+        # shape signature (see NaiveBayesAdapter): transition-table
+        # shapes/dtypes — same-state-space tenants share one compiled
+        # pair-log-odds gather per (row, length) bucket pair
+        clf = self.classifier
+        self._shape_sig = tuple(
+            (tuple(t.shape), str(t.dtype)) for t in (clf._t0, clf._t1))
+
+    def device_bytes(self) -> int:
+        clf = self.classifier
+        return int(clf._t0.nbytes) + int(clf._t1.nbytes)
 
     def _len_bucket(self, length: int) -> int:
         for b in self.seq_buckets:
@@ -326,7 +458,7 @@ class MarkovClassifierAdapter(ModelAdapter):
     def _compiled(self, bucket: int, len_bucket: int):
         from ..models.markov import _mmc_pair_log_odds
         return self.cache.get(
-            ("markov", id(self), bucket, len_bucket),
+            ("markov", self._shape_sig, bucket, len_bucket),
             lambda: telemetry.profiled_jit(
                 _mmc_pair_log_odds,
                 f"serve.markov.score.b{bucket}.l{len_bucket}"))
@@ -508,17 +640,27 @@ class NearestNeighborAdapter(ModelAdapter):
             [self.id_ord, self.cls_ord]
             + [f.ordinal for f in schema.feature_fields()]) + 1
 
+    def device_bytes(self) -> int:
+        return sum(int(np.asarray(a).nbytes) for a in
+                   (self.tnum, self.tcat, self.num_w, self.cat_w))
+
     def _distances(self, qnum, qcat):
         from ..ops.distance import pairwise_distances
 
         # count a "compilation" per first-seen padded query shape: the
         # distance engine's own bounded cache compiles per shape, so this
-        # mirrors its real compile behavior for the warmup counters
+        # mirrors its real compile behavior for the warmup counters —
+        # keyed by the TRAINING-set shape signature (not adapter
+        # identity), matching the engine's actual shape-keyed compiles
         from ..parallel.mesh import get_mesh
         mesh = self.mesh or get_mesh()
         d = int(mesh.devices.size)
         padded_q = -(-qnum.shape[0] // d) * d
-        self.cache.get(("knn-shape", id(self), padded_q), lambda: True)
+        self.cache.get(
+            ("knn-shape", tuple(self.tnum.shape), tuple(self.tcat.shape),
+             self.top_k, self.algorithm, self.scale, self.topk_method,
+             padded_q),
+            lambda: True)
         return pairwise_distances(
             qnum, qcat, self.tnum, self.tcat, self.num_w, self.cat_w,
             algorithm=self.algorithm, scale=self.scale, top_k=self.top_k,

@@ -418,34 +418,121 @@ class ScorerPool:
             return q
 
     def _load_model(self, name: str) -> None:
-        self._ensure_quarantine(name)
         variants = self.registry.variant_names(name)
-        n = _resolve_replicas(self.config, name)
-        devices = _devices_for(n)
-        single_default = variants == [DEFAULT_VARIANT]
         groups: Dict[str, VariantGroup] = {}
-        built: List[Replica] = []
         try:
             for v in variants:
-                reps = []
-                for i in range(n):
-                    rep = self._build_replica(name, v, i, devices[i])
-                    built.append(rep)
-                    reps.append(rep)
-                groups[v] = VariantGroup(
-                    name, v, reps,
-                    slo_key=name if single_default else f"{name}@{v}")
+                groups[v] = self.build_variant_group(name, v)
         except BaseException:
             # e.g. a later variant with no declared overlay: stop the
-            # batcher workers this call already started
-            for rep in built:
-                rep.batcher.close()
+            # batcher workers the earlier groups already started (a
+            # failing group closes its own partial build)
+            for g in groups.values():
+                for rep in g.replicas:
+                    rep.batcher.close()
             raise
         with self._lock:
             self.groups[name] = groups
         # the registry keeps serving its legacy surface (get/entries =
         # the PRIMARY replica of the preferred variant)
         self.registry.adopt(groups[variants[0]].replicas[0].entry)
+
+    # -- managed-cache surface (serve/modelcache.py) -----------------------
+    def build_variant_group(self, name: str, variant: str) -> VariantGroup:
+        """Build one variant's complete replica set WITHOUT installing it
+        — the model cache's promote worker builds off the request path
+        (the PR-9 pre-swap pattern: nothing observable changes until the
+        group installs), closing the built batchers itself on failure."""
+        self._ensure_quarantine(name)
+        variants = self.registry.variant_names(name)
+        if variant not in variants:
+            raise KeyError(
+                f"model {name!r} declares no variant {variant!r} "
+                f"(declared: {', '.join(variants)})")
+        n = _resolve_replicas(self.config, name)
+        devices = _devices_for(n)
+        single_default = variants == [DEFAULT_VARIANT]
+        reps: List[Replica] = []
+        try:
+            for i in range(n):
+                reps.append(self._build_replica(name, variant, i,
+                                                devices[i]))
+        except BaseException:
+            for rep in reps:
+                rep.batcher.close(drain=False)
+            raise
+        return VariantGroup(
+            name, variant, reps,
+            slo_key=name if single_default else f"{name}@{variant}")
+
+    def install_group(self, name: str, group: VariantGroup) -> None:
+        """Install a built variant group, preserving the model's DECLARED
+        variant order (the router iterates groups in cost order), and
+        re-adopt the preferred resident variant's primary entry into the
+        registry surface."""
+        order = self.registry.variant_names(name)
+        with self._lock:
+            groups = dict(self.groups.get(name) or {})
+            old = groups.get(group.variant)
+            groups[group.variant] = group
+            self.groups[name] = {
+                v: groups[v] for v in order if v in groups}
+            head = next(g for g in self.groups[name].values())
+        if old is not None:
+            for rep in old.replicas:
+                rep.batcher.close(drain=True)
+        self.registry.adopt(head.replicas[0].entry)
+
+    def unload_variant(self, name: str, variant: str) -> bool:
+        """Drop ONE variant group (drain its batchers, release its
+        replicas' device state).  The model keeps serving its remaining
+        variants; dropping the last group unloads the model."""
+        with self._lock:
+            groups = self.groups.get(name)
+            if not groups or variant not in groups:
+                return False
+            g = groups.pop(variant)
+            last = not groups
+            if last:
+                del self.groups[name]
+            head = next(iter(groups.values())) if groups else None
+        for rep in g.replicas:
+            rep.batcher.close(drain=True)
+        if last:
+            self._forget_model(name)
+        elif head is not None:
+            self.registry.adopt(head.replicas[0].entry)
+        return True
+
+    def unload_model(self, name: str) -> bool:
+        """Drop EVERY variant group of a model (the cache DEMOTE path):
+        batchers drain (queued requests complete), device tables are
+        released with the replicas, the registry forgets the adopted
+        entries, and the model's poison quarantine is cleared — a later
+        re-promote builds a FRESH replica set, so stale offender
+        signatures must not re-quarantine rows against it (the
+        demote→re-promote fix regression-tested in
+        tests/test_modelcache.py)."""
+        with self._lock:
+            groups = self.groups.pop(name, None)
+        if not groups:
+            return False
+        for g in groups.values():
+            for rep in g.replicas:
+                rep.batcher.close(drain=True)
+        self._forget_model(name)
+        return True
+
+    def _forget_model(self, name: str) -> None:
+        """Shared demote bookkeeping: drop the registry's adopted entries
+        and the model's poison-quarantine signatures (same rationale as
+        the whole-model reload clear: the next resident set is a fresh
+        build and deserves a fresh trial)."""
+        self.registry.drop(name)
+        with self._lock:
+            q = self.quarantines.pop(name, None)
+        if q is not None:
+            q.clear()
 
     # -- lookup ------------------------------------------------------------
     def model_names(self) -> List[str]:

@@ -38,6 +38,7 @@ buckets so steady-state traffic triggers zero new XLA compilations
 
 from __future__ import annotations
 
+import hashlib
 import threading
 from typing import Dict, List, Optional, Tuple
 
@@ -46,10 +47,39 @@ from ..core.config import JobConfig, parse_properties
 from ..core.io import TornArtifactError
 from ..core.metrics import Counters
 from .engine import (ADAPTER_KINDS, VARIANT_PRESETS, ModelAdapter,
-                     ScorerCompileCache, pow2_bucket, pow2_buckets)
+                     ScorerCompileCache, get_shared_tier, pow2_bucket,
+                     pow2_buckets)
 
 #: the implicit single variant of a model that declares none
 DEFAULT_VARIANT = "default"
+
+#: models REGISTERED to the managed model cache (serve/modelcache.py):
+#: cold catalog descriptors, NOT built or device-resident at startup —
+#: the decoupling of *registered* from *resident* (README "Multi-tenant
+#: model multiplexing").  ``serve.models`` keeps its eager always-
+#: resident semantics.
+KEY_CACHE_MODELS = "serve.cache.models"
+
+#: force the process-shared compile tier on/off; unset, the tier is on
+#: exactly when the model cache is active (cataloged models share
+#: compiled scorers by shape signature — engine.SharedCompileTier)
+KEY_COMPILE_SHARED = "serve.cache.compile.shared"
+
+
+class ModelDescriptor:
+    """A cataloged model's COLD registration: everything needed to
+    admit/promote it later without holding any device state — the
+    registry keeps thousands of these while only the model cache's
+    resident set owns adapters."""
+
+    __slots__ = ("name", "kind", "variants", "fingerprint")
+
+    def __init__(self, name: str, kind: str, variants: List[str],
+                 fingerprint: str):
+        self.name = name
+        self.kind = kind
+        self.variants = variants
+        self.fingerprint = fingerprint
 
 
 class ModelEntry:
@@ -85,6 +115,14 @@ class ModelRegistry:
         self._lock = sanitizer.make_lock("serve.registry")
         self._entries: Dict[Tuple[str, str], ModelEntry] = {}
         self._latest: Dict[str, str] = {}
+        # the process-shared compile tier (multi-tenant compile reuse):
+        # on when the model cache is active, overridable explicitly
+        shared = config.get(KEY_COMPILE_SHARED)
+        if shared is not None:
+            use_tier = str(shared).strip().lower() == "true"
+        else:
+            use_tier = bool(config.get(KEY_CACHE_MODELS))
+        self.compile_tier = get_shared_tier() if use_tier else None
 
     # -- configuration -----------------------------------------------------
     def model_names(self) -> List[str]:
@@ -92,6 +130,40 @@ class ModelRegistry:
         if not names:
             return []
         return [n.strip() for n in names.split(",") if n.strip()]
+
+    def cached_model_names(self) -> List[str]:
+        """Models registered to the managed cache (cold catalog entries;
+        ``serve.cache.models``) — disjoint use from the eager
+        ``serve.models`` list, whose entries stay resident forever."""
+        names = self.config.get(KEY_CACHE_MODELS)
+        if not names:
+            return []
+        return [n.strip() for n in names.split(",") if n.strip()]
+
+    def describe_all(self, names: List[str]) -> Dict[str, ModelDescriptor]:
+        """Catalog descriptors for many models sharing ONE parsed-conf
+        memo: a 1,000-tenant fleet whose entries point at the same
+        ``conf`` properties file parses it once, not per tenant."""
+        memo: Dict[str, Dict[str, str]] = {}
+        return {n: self.describe(n, _conf_memo=memo) for n in names}
+
+    def describe(self, name: str,
+                 _conf_memo: Optional[Dict[str, Dict[str, str]]] = None
+                 ) -> ModelDescriptor:
+        """The model's cold catalog descriptor: declared kind + variant
+        presets + a fingerprint over its resolved base config (artifact
+        paths included) — no artifact is read, no device state built."""
+        props = self._base_props(name, conf_memo=_conf_memo)
+        kind = props.get("kind")
+        if not kind:
+            raise KeyError(f"missing serve.model.{name}.kind")
+        if kind not in ADAPTER_KINDS:
+            raise ValueError(
+                f"unknown model kind {kind!r} for {name!r}; known: "
+                + ", ".join(sorted(ADAPTER_KINDS)))
+        digest = hashlib.sha1(
+            repr(sorted(props.items())).encode()).hexdigest()[:16]
+        return ModelDescriptor(name, kind, self.variant_names(name), digest)
 
     def variant_names(self, name: str) -> List[str]:
         """The model's declared scorer variants in COST ORDER (cheapest
@@ -136,10 +208,15 @@ class ModelRegistry:
         return {"overlay": overlay, "latency_class": lat,
                 "accuracy_class": acc}
 
-    def _base_props(self, name: str) -> Dict[str, str]:
+    def _base_props(self, name: str,
+                    conf_memo: Optional[Dict[str, Dict[str, str]]] = None
+                    ) -> Dict[str, str]:
         """The model's job config before any variant overlay: its
         ``conf`` file (if named) under the inline ``serve.model.<n>.*``
-        overrides, minus the ``variant.`` subtree."""
+        overrides, minus the ``variant.`` subtree.  ``conf_memo`` (the
+        bulk-registration path only) caches parsed conf files across
+        calls; adapter BUILDS always re-read — an operator edits the
+        conf and ``reload``s, and must get the fresh bytes."""
         prefix = f"serve.model.{name}."
         vprefix = f"{prefix}variant."
         inline = {k[len(prefix):]: v for k, v in self.config.props.items()
@@ -147,8 +224,14 @@ class ModelRegistry:
         props: Dict[str, str] = {}
         conf_path = inline.pop("conf", None)
         if conf_path:
-            with open(conf_path, "r") as fh:
-                props.update(parse_properties(fh.read()))
+            parsed = (conf_memo.get(conf_path)
+                      if conf_memo is not None else None)
+            if parsed is None:
+                with open(conf_path, "r") as fh:
+                    parsed = parse_properties(fh.read())
+                if conf_memo is not None:
+                    conf_memo[conf_path] = parsed
+            props.update(parsed)
         props.update(inline)
         return props
 
@@ -185,7 +268,8 @@ class ModelRegistry:
         counters = counters if counters is not None else Counters()
         try:
             adapter = cls(mconf, counters,
-                          cache=ScorerCompileCache(counters),
+                          cache=ScorerCompileCache(counters,
+                                                   tier=self.compile_tier),
                           max_bucket=pow2_bucket(self.max_batch),
                           mesh=self.mesh)
         except TornArtifactError as e:
@@ -244,6 +328,17 @@ class ModelRegistry:
     def entries(self) -> List[ModelEntry]:
         with self._lock:
             return [self._entries[(n, v)] for n, v in self._latest.items()]
+
+    def drop(self, name: str) -> bool:
+        """Forget a model's adopted entries (the model cache DEMOTE path:
+        device state is released by the pool; the cold catalog descriptor
+        — just config — survives, so the model stays registered and can
+        be promoted again)."""
+        with self._lock:
+            had = self._latest.pop(name, None) is not None
+            for key in [k for k in self._entries if k[0] == name]:
+                del self._entries[key]
+            return had
 
     # -- warmup ------------------------------------------------------------
     def _warm(self, entry: ModelEntry) -> None:

@@ -57,11 +57,21 @@ class SLOUnattainableError(ValueError):
 
 
 class VariantRouter:
-    """Per-request variant selection over a :class:`ScorerPool`."""
+    """Per-request variant selection over a :class:`ScorerPool`.
 
-    def __init__(self, config, pool: ScorerPool, slo_board):
+    With a managed model cache attached (serve/modelcache.py), the
+    router also knows the DECLARED variant order of cataloged models —
+    variants that exist but are not (yet) device-resident are treated
+    exactly like soft-degraded ones: demoted to a resident sibling
+    before any request fails, counted in the demotions surface, and
+    nudged back toward residency with a background promote.  A request
+    that PINS a declared-but-non-resident variant gets the structured
+    cold-start response instead of a routing error."""
+
+    def __init__(self, config, pool: ScorerPool, slo_board, cache=None):
         self.pool = pool
         self.slo = slo_board
+        self.cache = cache
         self.default_slo_ms = config.get_float(KEY_DEFAULT_SLO_MS, 0.0)
         self.strict = config.get_boolean(KEY_STRICT, False)
         self._lock = sanitizer.make_lock("serve.router")
@@ -87,14 +97,21 @@ class VariantRouter:
         decision dict).  Raises KeyError for unknown model/variant and
         :class:`SLOUnattainableError` in strict mode."""
         groups = self.pool.variant_groups(model)
+        declared = (self.cache.declared_variants(model)
+                    if self.cache is not None else None)
         if variant is not None:
             for g in groups:
                 if g.variant == variant:
                     return g, self._done(model, g, groups, pinned=True,
                                          slo_ms=None)
+            if declared is not None and variant in declared:
+                # declared but not resident: the pin gets the structured
+                # cold-start signal (promote enqueued), not a routing
+                # error — the variant exists, it just is not loaded yet
+                raise self.cache.variant_cold(model, variant, ctx=None)
             raise KeyError(
                 f"model {model!r} has no variant {variant!r} "
-                f"(declared: {', '.join(g.variant for g in groups)})")
+                f"(declared: {', '.join(declared or (g.variant for g in groups))})")
 
         hint = slo_ms if slo_ms is not None else (
             self.default_slo_ms if self.default_slo_ms > 0 else None)
@@ -143,6 +160,17 @@ class VariantRouter:
         admitted = set(id(g) for g in candidates)
         demoted = any(id(g) not in admitted
                       for g in groups[:groups.index(chosen)])
+        if declared is not None and chosen.variant in declared:
+            # a cheaper DECLARED variant that is not resident is demoted
+            # the same way a breaker-open one is — the request lands on
+            # a resident sibling instead of failing, and a background
+            # promote nudges the missing variant back toward residency
+            resident_variants = {g.variant for g in groups}
+            missing = [v for v in declared[:declared.index(chosen.variant)]
+                       if v not in resident_variants]
+            for v in missing:
+                self.cache.nudge_promote(model, variant=v)
+            demoted = demoted or bool(missing)
         return chosen, self._done(model, chosen, groups, pinned=False,
                                   slo_ms=hint, slo_met=slo_met,
                                   demoted=demoted)

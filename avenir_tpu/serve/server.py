@@ -60,13 +60,15 @@ from typing import Callable, Dict, List, Optional
 
 from ..core import flight, obs, sanitizer, telemetry
 from ..core.config import JobConfig, load_job_config, parse_cli_args
+from .admission import QuotaExceeded, TenantAdmission
 from .batcher import MicroBatcher, PoisonRowError, ShedError
 from .breaker import CircuitOpenError
 from .frontend import (DEFAULT_BACKLOG, DEFAULT_IO_THREADS,
                        DEFAULT_PIPELINE_MAX, EventLoopFrontend, KEY_BACKLOG,
                        KEY_IO_THREADS, KEY_PIPELINE_MAX)
+from .modelcache import ColdStartPending, ModelCache
 from .pool import ScorerPool, merged_hist_state
-from .registry import ModelRegistry
+from .registry import KEY_CACHE_MODELS, ModelRegistry
 from .router import SLOUnattainableError, VariantRouter
 from .slo import SLOBoard
 
@@ -169,11 +171,34 @@ class PredictionServer:
         # periodic exporter whose snapshot backs the ``metrics`` command
         # (Prometheus exposition) and the telemetry.jsonl.path series
         self.slo = SLOBoard(config)
-        self.router = VariantRouter(config, self.pool, self.slo)
+        # managed model cache (serve/modelcache.py): serve.cache.models
+        # registers thousands of tenants as COLD descriptors behind an
+        # HBM-budget-aware resident LRU with per-tenant promote quotas
+        try:
+            self.admission = TenantAdmission.from_config(config)
+            self.cache: Optional[ModelCache] = None
+            if self.registry.cached_model_names():
+                self.cache = ModelCache(config, self.registry, self.pool,
+                                        admission=self.admission,
+                                        slo=self.slo)
+        except BaseException:
+            # a bad cache/quota config must not leak the pool's already
+            # started batcher workers (the no-leak hammer catches this)
+            self.pool.close()
+            raise
+        self.router = VariantRouter(config, self.pool, self.slo,
+                                    cache=self.cache)
         # commands can block (a reload rebuilds adapters; health
         # evaluates SLO windows) — they run here, never on an I/O shard
         self._cmd_pool = ThreadPoolExecutor(
             max_workers=2, thread_name_prefix="serve-cmd")
+        # deadline-blocked cold-start requests park on their OWN small
+        # executor: a burst of cold tenants must not occupy the command
+        # workers and black out health/metrics for the deadline window
+        self._cold_pool: Optional[ThreadPoolExecutor] = (
+            ThreadPoolExecutor(max_workers=4,
+                               thread_name_prefix="serve-coldwait")
+            if self.cache is not None else None)
         #: subsystem command hooks: cmd name -> fn(request obj) -> response
         #: dict (the stream service registers "feedback"/"stream" here)
         self.command_extensions: Dict[str, Callable[[dict], dict]] = {}
@@ -228,10 +253,32 @@ class PredictionServer:
         default variant, ``model@variant`` otherwise."""
         out: Dict[str, dict] = {}
         for name in self.pool.model_names():
-            for g in self.pool.variant_groups(name):
+            for g in self._groups_or_gone(name):
                 out[g.slo_key] = self.slo.observe(
                     g.slo_key, g.stats_facade, config_name=name)
         return out
+
+    def _groups_or_gone(self, name: str) -> List:
+        """The model's variant groups, or [] when a concurrent cache
+        demote unloaded it between the name listing and this read (the
+        reporting loops must tolerate models leaving mid-iteration)."""
+        try:
+            return self.pool.variant_groups(name)
+        except KeyError:
+            return []
+
+    def _model_view(self, name: str):
+        """(registry entry, variant groups) for a reporting loop, or
+        None when a concurrent cache demote removed the model between
+        the name listing and either read — the ONE place the
+        demote-vs-reporting race is tolerated."""
+        groups = self._groups_or_gone(name)
+        if not groups:
+            return None
+        try:
+            return self.registry.get(name), groups
+        except KeyError:
+            return None
 
     def _telemetry_overlay(self) -> dict:
         """The per-model snapshot sections the exporter/`metrics` scrape
@@ -252,7 +299,9 @@ class PredictionServer:
                 "value": float(value), "ts": now}
 
         for name in sorted(self.pool.model_names()):
-            groups = self.pool.variant_groups(name)
+            groups = self._groups_or_gone(name)
+            if not groups:
+                continue
             all_replicas = [r for grp in groups for r in grp.replicas]
             # model-level surface: byte-compatible with the pre-pool
             # single-batcher names (exactly one sample per model)
@@ -326,6 +375,30 @@ class PredictionServer:
                 g("serve.poison.quarantine.size", q.size(), model=name)
         if self._frontend is not None:
             g("serve.frontend.connections", self._frontend.connections())
+        if self.cache is not None:
+            # managed-cache surface: residency/eviction/promote gauges +
+            # the cold-start histogram (request-arrival -> resident, ms
+            # percentiles via the shared log-bucket ladder, with trace
+            # exemplars in the Prometheus exposition)
+            sec = self.cache.section()
+            g("serve.cache.registered", sec["registered"])
+            g("serve.cache.resident", sec["resident"])
+            g("serve.cache.resident.bytes", sec["resident_bytes"])
+            g("serve.cache.promote.queue.depth",
+              sec["promote_queue_depth"])
+            cc = sec["counters"]
+            g("serve.cache.evictions", cc.get("Evictions", 0))
+            g("serve.cache.promotes", cc.get("Promotes", 0))
+            g("serve.cache.promote.failures",
+              cc.get("Promote failures", 0))
+            g("serve.cache.quota.rejected", cc.get("Quota rejected", 0))
+            tier = sec.get("compile_tier")
+            if tier:
+                g("serve.cache.compile.tier.size", tier["size"])
+                g("serve.cache.compile.tier.compiles", tier["compiles"])
+            hists["serve.cache.coldstart"] = \
+                self.cache.coldstart_hist.state_dict()
+            counters["Cache"] = dict(cc)
         return {"gauges": gauges, "hists": hists, "counters": counters}
 
     def metrics_text(self) -> str:
@@ -448,6 +521,24 @@ class PredictionServer:
                                      replica=obj.get("replica"))
             return {"ok": True, "model": entry.name,
                     "version": entry.version}
+        if cmd == "promote":
+            if self.cache is None:
+                return {"error": "no model cache configured "
+                                 "(serve.cache.models)"}
+            model = obj.get("model")
+            if not isinstance(model, str):
+                return {"error": 'promote needs "model" (string)'}
+            ok = self.cache.promote(model, wait=bool(obj.get("wait", True)))
+            return {"ok": ok, "model": model, "resident": ok}
+        if cmd == "demote":
+            if self.cache is None:
+                return {"error": "no model cache configured "
+                                 "(serve.cache.models)"}
+            model = obj.get("model")
+            if not isinstance(model, str):
+                return {"error": 'demote needs "model" (string)'}
+            ok = self.cache.demote(model, variant=obj.get("variant"))
+            return {"ok": ok, "model": model, "resident": False}
         ext = self.command_extensions.get(cmd)
         if ext is not None:
             # subsystem-registered commands (e.g. the stream service's
@@ -457,15 +548,40 @@ class PredictionServer:
         return {"error": f"unknown cmd {cmd!r}"}
 
     # -- predict: routing + submission (shared sync/async) -----------------
-    def _submit(self, obj: dict, ctx=None) -> object:
+    def _submit(self, obj: dict, ctx=None, allow_wait: bool = True) -> object:
         """Validate, route, and submit one predict request's rows; returns
         a :class:`_Submission`, or a complete error-response dict for
         malformed requests.  ``ctx`` (the request's trace context) rides
         into the queue entries so the batcher worker can link its shared
-        batch span back to this request."""
+        batch span back to this request.  ``allow_wait=False`` (the
+        event-loop frontend's inline path) turns a cold-start block into
+        an immediate structured response — an I/O shard thread must
+        never park on a promote."""
         name = obj.get("model") or self._default_model()
+        if self.cache is not None:
+            try:
+                # cold-start admission: resident models bump LRU recency
+                # and fall through; cold cataloged models enqueue a
+                # promote and either block here (up to the configured
+                # cold-start deadline, on a cold-wait executor thread
+                # for the async path) or surface the structured signal
+                self.cache.ensure(name, ctx=ctx, allow_wait=allow_wait)
+            except ColdStartPending as e:
+                return {"model": name, "error": str(e),
+                        "cold_start": True,
+                        "retry_after_ms": e.retry_after_ms}
+            except QuotaExceeded as e:
+                return {"model": name, "error": str(e),
+                        "quota_exceeded": True,
+                        "retry_after_ms": e.retry_after_ms}
         # version validation against the registry's adopted surface
-        entry = self.registry.get(name, obj.get("version"))
+        try:
+            entry = self.registry.get(name, obj.get("version"))
+        except KeyError:
+            resp = self._evicted_mid_request(name, ctx)
+            if resp is None:
+                raise
+            return resp
         slo_ms = obj.get("slo_ms")
         if slo_ms is not None and not isinstance(slo_ms, (int, float)):
             return {"error": '"slo_ms" must be a number (milliseconds)'}
@@ -507,7 +623,31 @@ class PredictionServer:
         except SLOUnattainableError as e:
             return {"model": entry.name, "version": entry.version,
                     "error": str(e), "slo_unattainable": True}
-        multi = len(self.pool.variant_groups(name)) > 1
+        except ColdStartPending as e:
+            # a pinned declared-but-non-resident variant: its promote is
+            # enqueued, the client retries on the structured signal
+            return {"model": entry.name, "version": entry.version,
+                    "error": str(e), "cold_start": True,
+                    "retry_after_ms": e.retry_after_ms}
+        except QuotaExceeded as e:
+            return {"model": entry.name, "version": entry.version,
+                    "error": str(e), "quota_exceeded": True,
+                    "retry_after_ms": e.retry_after_ms}
+        except KeyError:
+            # the routed model was demoted between the registry lookup
+            # and routing: same structured signal as any cold start
+            resp = self._evicted_mid_request(name, ctx)
+            if resp is None:
+                raise
+            return resp
+        # "multi-variant" responses carry the routed variant: judged by
+        # the DECLARED variant count for cache-managed models (a model
+        # temporarily down to one resident variant still reports which
+        # variant — and that it was demoted)
+        declared = (self.cache.declared_variants(name)
+                    if self.cache is not None else None)
+        multi = (len(declared) if declared is not None
+                 else len(self.pool.variant_groups(name))) > 1
         futures: List[Optional[object]] = []
         shed, degraded = 0, 0
         last_err = "request failed"
@@ -538,6 +678,34 @@ class PredictionServer:
                 last_err = str(e)
         return _Submission(entry, decision, multi, single, futures,
                            shed, degraded, last_err)
+
+    def _evicted_mid_request(self, name: str, ctx) -> Optional[dict]:
+        """A cache-managed model can be EVICTED between this request's
+        admission check and its registry/route lookups (a concurrent
+        promote picked it as the LRU victim).  Clients honoring the
+        documented signals must see the structured ``cold_start`` — a
+        generic unknown-model error would read as 'stop retrying'.
+        Returns the response dict, or None when the KeyError was not
+        this race (unknown model/variant/version: let it propagate)."""
+        if (self.cache is None or not self.cache.is_cataloged(name)
+                or self.cache.is_resident(name)):
+            return None
+        try:
+            self.cache.ensure(name, ctx=ctx, allow_wait=False)
+        except ColdStartPending as e:
+            return {"model": name, "error": str(e), "cold_start": True,
+                    "retry_after_ms": e.retry_after_ms}
+        except QuotaExceeded as e:
+            return {"model": name, "error": str(e),
+                    "quota_exceeded": True,
+                    "retry_after_ms": e.retry_after_ms}
+        # promoted again in the race window: tell the client to retry
+        # now rather than re-entering the submit path recursively
+        return {"model": name,
+                "error": f"model {name!r} was evicted and re-promoted "
+                         f"mid-request; retry",
+                "cold_start": True,
+                "retry_after_ms": 50}
 
     def _assemble(self, sub: _Submission, outputs: List[Optional[str]],
                   errors: int, timeouts: int, last_err: str,
@@ -665,8 +833,24 @@ class PredictionServer:
             except RuntimeError:                     # executor shut down
                 cb({"error": "server shutting down"})
             return meta
+        if (self._cold_pool is not None
+                and self.cache.needs_wait(obj.get("model"))):
+            # a cold-start request that would BLOCK up to the configured
+            # cold-start deadline waiting for its promote: park it on
+            # the cold-wait executor so it stalls neither an I/O shard
+            # nor the command workers (health/metrics stay responsive
+            # through a cold burst)
+            try:
+                self._cold_pool.submit(
+                    lambda: cb(self._handle_obj(obj, ctx)))
+            except RuntimeError:
+                cb({"error": "server shutting down"})
+            return meta
         try:
-            sub = self._submit(obj, ctx)
+            # inline path: a model evicted between needs_wait and here
+            # must yield the structured cold-start response, never park
+            # this I/O shard on the promote
+            sub = self._submit(obj, ctx, allow_wait=False)
         except (KeyError, ValueError) as e:
             cb({"error": str(e)})
             return meta
@@ -724,8 +908,10 @@ class PredictionServer:
         slo_stats = self._observe_slo()
         models, degraded = [], []
         for name in sorted(self.pool.model_names()):
-            groups = self.pool.variant_groups(name)
-            entry = self.registry.get(name)
+            view = self._model_view(name)
+            if view is None:
+                continue
+            entry, groups = view
             primary_brk = groups[0].replicas[0].batcher.breaker
             state = primary_brk.state if primary_brk is not None else "closed"
             worker_ok = all(r.batcher.worker_alive()
@@ -746,14 +932,19 @@ class PredictionServer:
                     grp.variant: grp.section(slo_stats.get(grp.slo_key))
                     for grp in groups},
                 "router": self.router.section(name)})
-        return {"ok": not degraded, "degraded": degraded, "models": models,
-                "slo": slo_stats}
+        out = {"ok": not degraded, "degraded": degraded, "models": models,
+               "slo": slo_stats}
+        if self.cache is not None:
+            out["cache"] = self.cache.section()
+        return out
 
     def _stats(self) -> dict:
         models = {}
         for name in sorted(self.pool.model_names()):
-            entry = self.registry.get(name)
-            groups = self.pool.variant_groups(name)
+            view = self._model_view(name)
+            if view is None:
+                continue
+            entry, groups = view
             b = groups[0].replicas[0].batcher
             models[name] = {
                 "version": entry.version,
@@ -782,6 +973,8 @@ class PredictionServer:
         out = {"models": models, "obs": obs.get_tracer().stats(),
                "slo": self.slo.section(),
                "flight": flight.get_recorder().stats()}
+        if self.cache is not None:
+            out["cache"] = self.cache.section()
         if self._frontend is not None:
             out["frontend"] = {
                 "connections": self._frontend.connections(),
@@ -831,6 +1024,12 @@ class PredictionServer:
         # — the shutdown lint hammers start/stop and asserts no leaked
         # avenir-telemetry thread
         self.telemetry.stop()
+        # cache promote workers stop before the pool they build into;
+        # queued promotes fail fast with a structured shutdown error
+        if self.cache is not None:
+            self.cache.close()
+        if self._cold_pool is not None:
+            self._cold_pool.shutdown(wait=True)
         self._cmd_pool.shutdown(wait=True)
         self.pool.close(drain=False)
 
@@ -1019,8 +1218,9 @@ def serve_main(argv) -> int:
               file=sys.stderr)
         return 2
     config = load_job_config(defines)
-    if not config.get("serve.models"):
-        print("serve: no models configured (serve.models=...)",
+    if not (config.get("serve.models") or config.get(KEY_CACHE_MODELS)):
+        print("serve: no models configured (serve.models=... for eager "
+              "residency, serve.cache.models=... for managed residency)",
               file=sys.stderr)
         return 2
     if metrics_out:
@@ -1035,6 +1235,10 @@ def serve_main(argv) -> int:
     port = server.start()
     names = ", ".join(
         f"{e.name}:{e.version}({e.kind})" for e in server.registry.entries())
+    if server.cache is not None:
+        cached = len(server.cache.catalog)
+        names = (f"{names} + {cached} cached tenants" if names
+                 else f"{cached} cached tenants (cold; promote on demand)")
     print(f"serving {names} on "
           f"{config.get('serve.host', '127.0.0.1')}:{port}", file=sys.stderr,
           flush=True)

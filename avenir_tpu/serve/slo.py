@@ -236,6 +236,17 @@ class SLOBoard:
                     degrade_evals=self.degrade_evals)
             return mon
 
+    def drop_model(self, name: str) -> None:
+        """Forget a model's monitors (the bare key and every
+        ``model@variant`` key) — the model-cache demote path: thousands
+        of tenants cycling through residency must not grow the board
+        without bound, and a re-promoted model's fresh replica set
+        deserves a fresh window."""
+        with self._lock:
+            for k in [k for k in self._monitors
+                      if k == name or k.startswith(name + "@")]:
+                del self._monitors[k]
+
     def peek(self, name: str) -> Optional[Dict[str, object]]:
         """Last evaluated window stats for one monitor WITHOUT creating
         it or re-evaluating (the router's read path; None before the

@@ -65,6 +65,7 @@ KEY_BATCH = "stream.consumer.batch"
 KEY_BLOCK_MS = "stream.consumer.block.ms"
 KEY_CKPT_EVENTS = "stream.checkpoint.interval.events"
 KEY_REGRET_THRESHOLD = "stream.regret.threshold"
+KEY_TRIM = "stream.trim.enable"
 
 DEFAULT_STREAM = "avenir-feedback"
 DEFAULT_GROUP = "deciders"
@@ -91,6 +92,14 @@ class FeedbackConsumer:
         self.batch = config.get_int(KEY_BATCH, DEFAULT_BATCH)
         self.block_ms = config.get_int(KEY_BLOCK_MS, DEFAULT_BLOCK_MS)
         self.regret_threshold = config.get_float(KEY_REGRET_THRESHOLD, 0.0)
+        #: stream trimming (ROADMAP: the feedback stream otherwise grows
+        #: forever): after each checkpoint's acks, XTRIM entries at or
+        #: below the ack horizon — every one of them is applied, acked,
+        #: AND covered by a known-valid checkpoint, so a resumed
+        #: consumer never needs them again (byte-identical resume from
+        #: the watermark asserted in tests/test_stream.py).  The trim is
+        #: clamped to the ALL-consumer-groups ack floor by the transport.
+        self.trim = config.get_boolean(KEY_TRIM, False)
         self.counters = Counters()
         self.last_applied = ZERO_OFFSET
         #: the ack horizon: the offset of the newest checkpoint KNOWN
@@ -301,6 +310,14 @@ class FeedbackConsumer:
         self._unacked = [i for i in self._unacked if _sid(i) > cut]
         self.transport.ack(ack)
         self._since_save = 0
+        if self.trim and cut > _sid(ZERO_OFFSET):
+            # everything at or below the horizon is applied + acked +
+            # checkpoint-covered; the transport clamps to the slowest
+            # consumer group's floor before issuing XTRIM
+            removed = self.transport.trim_acked(self._ack_horizon)
+            if removed:
+                self.counters.incr(STREAM_GROUP, "Trimmed entries",
+                                   removed)
 
     # -- the pull loop -----------------------------------------------------
     def step(self) -> int:

@@ -2025,6 +2025,177 @@ def bench_serving_pool():
     return finish_metric(out)
 
 
+def bench_multitenant_cache():
+    """Multi-tenant model multiplexing (serve/modelcache.py): 1,000
+    registered NB/Markov tenants on the dev host behind the
+    HBM-budget-aware managed cache sized for ~50 resident.  Headline:
+    cold-tenant first response (request -> served output, with the
+    build+warmup promote OFF the request path but the request blocked
+    on it).  Gated in-line: steady-state compile count FLAT while 50
+    same-schema tenants promote (the shape-signature compile tier), and
+    resident-tenant p99 within noise of the single-tenant eager
+    baseline (the PR-8 shape: same artifact, serve.models, per-model
+    compile cache) — ``vs_baseline`` is single-tenant p99 over
+    resident-tenant p99 (1.0 = multiplexing is free for residents)."""
+    import statistics as _stats
+    import tempfile
+
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.core.io import write_output
+    from avenir_tpu.datagen import gen_state_sequences, gen_telecom_churn
+    from avenir_tpu.models.bayesian import BayesianDistribution
+    from avenir_tpu.models.markov import MarkovStateTransitionModel
+    from avenir_tpu.serve import PredictionServer, get_shared_tier
+
+    tmp = tempfile.mkdtemp(prefix="avenir_mtc_bench_")
+    schema = dict(_CHURN_SCHEMA)
+    schema["fields"] = [dict(f) for f in _CHURN_SCHEMA["fields"]]
+    schema["fields"][1]["cardinality"] = ["planA", "planB"]
+    schema_path = os.path.join(tmp, "schema.json")
+    with open(schema_path, "w") as fh:
+        fh.write(json.dumps(schema))
+    rows = gen_telecom_churn(8_000, seed=7)
+    write_output(os.path.join(tmp, "nb_train"), [",".join(r) for r in rows])
+    BayesianDistribution(JobConfig(
+        {"feature.schema.file.path": schema_path})).run(
+        os.path.join(tmp, "nb_train"), os.path.join(tmp, "nb_model"))
+    nb_props = {"feature.schema.file.path": schema_path,
+                "bayesian.model.file.path": os.path.join(tmp, "nb_model")}
+    nb_lines = [",".join(r) for r in rows[:512]]
+
+    states = ["LL", "LM", "LH", "ML", "MM", "MH", "HL", "HM", "HH"]
+    S = len(states)
+    T = np.full((S, S), 0.4 / (S - 1))
+    np.fill_diagonal(T, 0.6)
+    seqs = gen_state_sequences(300, states, {"L": T, "C": T.T},
+                               seq_len=(12, 24), seed=9)
+    write_output(os.path.join(tmp, "mk_train"),
+                 [",".join(r) for r in seqs[:200]])
+    MarkovStateTransitionModel(JobConfig({
+        "model.states": ",".join(states),
+        "class.label.field.ord": "1", "skip.field.count": "1",
+        "trans.prob.scale": "1000"})).run(
+        os.path.join(tmp, "mk_train"), os.path.join(tmp, "mk_model"))
+    mk_props = {"mm.model.path": os.path.join(tmp, "mk_model"),
+                "class.label.based.model": "true", "class.labels": "L,C",
+                "validation.mode": "true", "class.label.field.ord": "1",
+                "skip.field.count": "1"}
+    mk_lines = [",".join(r) for r in seqs[200:260]]
+
+    def tenant_props(n_nb, n_mk, extra):
+        props = {
+            "serve.cache.models": ",".join(
+                [f"nb{i:04d}" for i in range(n_nb)]
+                + [f"mk{i:04d}" for i in range(n_mk)]),
+            "serve.cache.coldstart.deadline.ms": "30000",
+            "serve.batch.max.size": "16",
+            "serve.batch.max.delay.ms": "2",
+            "serve.queue.max.depth": "4096",
+            "serve.warmup.buckets": "16",
+        }
+        for i in range(n_nb):
+            props[f"serve.model.nb{i:04d}.kind"] = "naiveBayes"
+            for k, v in nb_props.items():
+                props[f"serve.model.nb{i:04d}.{k}"] = v
+        for i in range(n_mk):
+            props[f"serve.model.mk{i:04d}.kind"] = "markovClassifier"
+            for k, v in mk_props.items():
+                props[f"serve.model.mk{i:04d}.{k}"] = v
+        props.update(extra)
+        return props
+
+    def drive_p99(srv, model, lines, n=1500):
+        batcher = srv.batcher(model)
+        batcher.clear_latency_window()
+        futures = [batcher.submit(lines[i % len(lines)])
+                   for i in range(n)]
+        for f in futures:
+            f.result(timeout=120)
+        return batcher.latency_percentiles_ms()["p99"]
+
+    # single-tenant eager baseline: the PR-8 shape (serve.models,
+    # per-model compile cache, resident forever)
+    base = PredictionServer(JobConfig({
+        "serve.models": "churn",
+        "serve.model.churn.kind": "naiveBayes",
+        "serve.model.churn.feature.schema.file.path": schema_path,
+        "serve.model.churn.bayesian.model.file.path":
+            os.path.join(tmp, "nb_model"),
+        "serve.batch.max.size": "16", "serve.batch.max.delay.ms": "2",
+        "serve.queue.max.depth": "4096", "serve.warmup.buckets": "16"}))
+    drive_p99(base, "churn", nb_lines, n=400)           # warm
+    p99_single = min(drive_p99(base, "churn", nb_lines) for _ in range(3))
+    base.stop()
+
+    # budget probe: one resident NB + Markov pair's estimated bytes —
+    # with the shared compile tier OFF, so the probe cannot pre-warm
+    # the fleet's compiles (the headline cold start must include the
+    # first tenants' real XLA compile time)
+    probe = PredictionServer(JobConfig(tenant_props(1, 1, {
+        "serve.cache.compile.shared": "false"})))
+    assert probe.cache.promote("nb0000", wait=True)
+    assert probe.cache.promote("mk0000", wait=True)
+    pair_bytes = probe.cache.resident_bytes()
+    probe.stop()
+
+    # the 1,000-tenant fleet, budget sized for ~50 resident (25 pairs)
+    budget = 25 * pair_bytes + pair_bytes // 4
+    t0 = time.perf_counter()
+    srv = PredictionServer(JobConfig(tenant_props(500, 500, {
+        "serve.cache.hbm.budget.bytes": str(budget)})))
+    register_sec = time.perf_counter() - t0
+    tier = get_shared_tier()
+    cold_s = []
+
+    def first_response(name, line, expect_out=True):
+        t1 = time.perf_counter()
+        r = srv.handle_line(json.dumps({"model": name, "row": line}))
+        dt = time.perf_counter() - t1
+        assert ("output" in r) == expect_out, r
+        return dt
+
+    try:
+        # the first NB + Markov tenants pay the fleet's compiles (one
+        # FIXED probe row per kind: the gate measures tenant sharing,
+        # not shape novelty — a genuinely new sequence-length bucket
+        # would rightly compile once for the whole fleet)
+        cold_s.append(first_response("nb0000", nb_lines[0]))
+        cold_s.append(first_response("mk0000", mk_lines[0]))
+        compiles_first = tier.stats()["compiles"]
+        for i in range(1, 25):
+            cold_s.append(first_response(f"nb{i:04d}", nb_lines[0]))
+            cold_s.append(first_response(f"mk{i:04d}", mk_lines[0]))
+        compiles_after = tier.stats()["compiles"]
+        assert compiles_after == compiles_first, \
+            (f"compile count moved under same-shape tenants: "
+             f"{compiles_first} -> {compiles_after}")
+        sec = srv.cache.section()
+        # resident-tenant latency with 1,000 registered / ~50 resident
+        drive_p99(srv, "nb0001", nb_lines, n=400)       # warm window
+        p99_resident = min(drive_p99(srv, "nb0001", nb_lines)
+                           for _ in range(3))
+    finally:
+        srv.stop()
+
+    out = {"metric": "multitenant_cache_cold_first_response_ms",
+           "value": round(_stats.median(cold_s) * 1000.0, 1),
+           "unit": "ms request->first served output for a cold tenant "
+                   "(async promote: build+warmup off the request path; "
+                   "1,000 registered NB/Markov tenants, HBM budget "
+                   "sized for ~50 resident)",
+           "vs_baseline": round(p99_single / p99_resident, 3),
+           "cold_max_ms": round(max(cold_s) * 1000.0, 1),
+           "register_1000_sec": round(register_sec, 3),
+           "single_tenant_p99_ms": p99_single,
+           "resident_tenant_p99_ms": p99_resident,
+           "tier_compiles_after_50_tenants": compiles_after,
+           "resident": sec["resident"],
+           "resident_bytes": sec["resident_bytes"],
+           "budget_bytes": budget,
+           "evictions": sec["counters"].get("Evictions", 0)}
+    return finish_metric(out, cold_s, bigger_is_better=False)
+
+
 def bench_obs_overhead():
     """Observability tax (core.obs): the NB train-and-predict job and
     serving steady-state, tracer off vs on.
@@ -2490,6 +2661,7 @@ def main():
                      ("nb_score", bench_nb_score),
                      ("serving", bench_serving),
                      ("serving_pool", bench_serving_pool),
+                     ("multitenant_cache", bench_multitenant_cache),
                      ("obs_overhead", bench_obs_overhead),
                      ("telemetry_overhead", bench_telemetry_overhead),
                      ("trace_overhead", bench_trace_overhead),

@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Multi-tenant model multiplexing: register 1,000 synthetic NB tenants
+# (cold catalog descriptors sharing ONE trained artifact + schema) behind
+# the managed model cache, then storm 50 hot tenants + a cold long tail.
+# Watch: flat compile count across the fleet (shape-signature compile
+# tier), bounded cold starts, LRU residency at the budget.
+set -euo pipefail
+cd "$(dirname "$0")"
+PY=${PYTHON:-python}
+rm -rf work && mkdir -p work/train work/test
+
+$PY -m avenir_tpu.datagen telecom_churn 3000 --seed 31 --out work/all.csv
+head -n 2400 work/all.csv > work/train/part-00000
+tail -n 600  work/all.csv > work/test/part-00000
+
+# 1. ONE trained artifact every tenant shares (per-segment models per
+#    tenant with one product schema — the deployment shape)
+$PY -m avenir_tpu BayesianDistribution -Dconf.path=nb.properties work/train work/model
+
+# 2. generate the 1,000-tenant serve config: all tenants registered to
+#    the managed cache (cold), budget sized for ~50 resident
+$PY gen_tenants.py work/serve.properties 1000 50
+
+# 3. serve: startup is instant — registration builds NO device state
+$PY -m avenir_tpu serve -Dconf.path=work/serve.properties \
+    2> work/server.log &
+SERVER_PID=$!
+trap 'kill $SERVER_PID 2>/dev/null || true' EXIT
+
+# 4. the storm: 50 hot tenants concurrently + 30-tenant cold tail;
+#    asserts flat compiles, bounded cold starts, budget-capped residency
+$PY storm.py work/server.log work/test/part-00000
+
+# 5. graceful stop
+kill -TERM $SERVER_PID
+wait $SERVER_PID 2>/dev/null || true
+trap - EXIT
+echo "multitenant runbook complete"

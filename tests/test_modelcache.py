@@ -1,0 +1,664 @@
+"""Multi-tenant model multiplexing (serve/modelcache.py +
+serve/admission.py + engine.SharedCompileTier): 1,000+ registered
+tenants behind an HBM-budget-sized resident LRU — steady-state compile
+count flat across same-schema tenants, resident responses byte-identical
+to the batch predictor, cold starts structured and bounded, hot-tenant
+storms quota-fenced, promote failures leaving the old resident set
+untouched, and the demote→re-promote poison-quarantine regression."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core import JobConfig, faultinject
+from avenir_tpu.core.io import write_output
+from avenir_tpu.datagen import gen_state_sequences, gen_telecom_churn
+from avenir_tpu.models.bayesian import BayesianDistribution, BayesianPredictor
+from avenir_tpu.models.markov import (MarkovModelClassifier,
+                                      MarkovStateTransitionModel)
+from avenir_tpu.serve import PredictionServer, get_shared_tier
+from avenir_tpu.serve.engine import SERVE_GROUP, SharedCompileTier
+from avenir_tpu.serve.server import request
+
+CHURN_SCHEMA = {"fields": [
+    {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+    {"name": "plan", "ordinal": 1, "dataType": "categorical",
+     "feature": True, "cardinality": ["planA", "planB"]},
+    {"name": "minUsed", "ordinal": 2, "dataType": "int", "feature": True,
+     "min": 0, "max": 2200, "bucketWidth": 200},
+    {"name": "dataUsed", "ordinal": 3, "dataType": "int", "feature": True,
+     "min": 0, "max": 1000, "bucketWidth": 100},
+    {"name": "csCall", "ordinal": 4, "dataType": "int", "feature": True,
+     "min": 0, "max": 14, "bucketWidth": 2},
+    {"name": "csEmail", "ordinal": 5, "dataType": "int", "feature": True,
+     "min": 0, "max": 22, "bucketWidth": 4},
+    {"name": "network", "ordinal": 6, "dataType": "int", "feature": True},
+    {"name": "churned", "ordinal": 7, "dataType": "categorical",
+     "cardinality": ["N", "Y"]},
+]}
+
+MARKOV_STATES = ["LL", "LM", "LH", "ML", "MM", "MH", "HL", "HM", "HH"]
+
+
+@pytest.fixture(autouse=True)
+def _no_injector():
+    yield
+    faultinject.set_injector(None)
+
+
+@pytest.fixture(scope="module")
+def artifacts(tmp_path_factory):
+    """One NB artifact + one Markov artifact every synthetic tenant
+    shares (same schema -> same shape signature -> one compiled scorer
+    per bucket across the whole fleet) plus the batch-predictor output
+    the parity assertions compare against byte-for-byte."""
+    tmp = tmp_path_factory.mktemp("mtc_artifacts")
+    art = {"dir": tmp}
+
+    schema_path = tmp / "churn_schema.json"
+    schema_path.write_text(json.dumps(CHURN_SCHEMA))
+    rows = gen_telecom_churn(400, seed=5)
+    train, test = rows[:300], rows[300:330]
+    write_output(str(tmp / "nb_train"), [",".join(r) for r in train])
+    write_output(str(tmp / "nb_test"), [",".join(r) for r in test])
+    BayesianDistribution(JobConfig(
+        {"feature.schema.file.path": str(schema_path)})).run(
+        str(tmp / "nb_train"), str(tmp / "nb_model"))
+    nb_props = {"feature.schema.file.path": str(schema_path),
+                "bayesian.model.file.path": str(tmp / "nb_model")}
+    BayesianPredictor(JobConfig(dict(nb_props))).run(
+        str(tmp / "nb_test"), str(tmp / "nb_pred"))
+    art["nb_props"] = nb_props
+    art["nb_test_lines"] = [",".join(r) for r in test]
+    art["nb_batch_lines"] = (
+        tmp / "nb_pred" / "part-r-00000").read_text().splitlines()
+
+    S = len(MARKOV_STATES)
+    T = np.full((S, S), 0.4 / (S - 1))
+    np.fill_diagonal(T, 0.6)
+    seqs = gen_state_sequences(80, MARKOV_STATES, {"L": T, "C": T.T},
+                               seq_len=(12, 24), seed=9)
+    mtrain, mtest = seqs[:60], seqs[60:]
+    write_output(str(tmp / "mk_train"), [",".join(r) for r in mtrain])
+    write_output(str(tmp / "mk_test"), [",".join(r) for r in mtest])
+    MarkovStateTransitionModel(JobConfig({
+        "model.states": ",".join(MARKOV_STATES),
+        "class.label.field.ord": "1", "skip.field.count": "1",
+        "trans.prob.scale": "1000"})).run(
+        str(tmp / "mk_train"), str(tmp / "mk_model"))
+    mk_props = {"mm.model.path": str(tmp / "mk_model"),
+                "class.label.based.model": "true", "class.labels": "L,C",
+                "validation.mode": "true", "class.label.field.ord": "1",
+                "skip.field.count": "1"}
+    MarkovModelClassifier(JobConfig(dict(mk_props))).run(
+        str(tmp / "mk_test"), str(tmp / "mk_pred"))
+    art["mk_props"] = mk_props
+    art["mk_test_lines"] = [",".join(r) for r in mtest]
+    art["mk_batch_lines"] = (
+        tmp / "mk_pred" / "part-r-00000").read_text().splitlines()
+    return art
+
+
+def _tenant_config(art, n_nb, n_mk=0, **overrides):
+    """N synthetic tenants registered to the managed cache, all sharing
+    the module artifacts (the 'per-segment model per tenant' shape with
+    identical schemas)."""
+    props = {
+        "serve.cache.models": ",".join(
+            [f"t{i:04d}" for i in range(n_nb)]
+            + [f"m{i:04d}" for i in range(n_mk)]),
+        "serve.cache.coldstart.deadline.ms": "15000",
+        "serve.batch.max.size": "8",
+        "serve.warmup.buckets": "8",
+        "serve.batch.max.delay.ms": "2",
+        "serve.port": "0",
+    }
+    for i in range(n_nb):
+        props[f"serve.model.t{i:04d}.kind"] = "naiveBayes"
+        for k, v in art["nb_props"].items():
+            props[f"serve.model.t{i:04d}.{k}"] = v
+    for i in range(n_mk):
+        props[f"serve.model.m{i:04d}.kind"] = "markovClassifier"
+        for k, v in art["mk_props"].items():
+            props[f"serve.model.m{i:04d}.{k}"] = v
+    props.update({k: str(v) for k, v in overrides.items()})
+    return JobConfig(props)
+
+
+def _nb_model_bytes(art):
+    """Per-model resident bytes, probed from a 1-tenant server (sizes
+    the HBM budget for ~K resident in the acceptance test; the shared
+    compile tier stays off so the probe cannot pre-warm the fleet)."""
+    srv = PredictionServer(_tenant_config(art, 1, **{
+        "serve.cache.compile.shared": "false"}))
+    try:
+        assert srv.cache.promote("t0000", wait=True)
+        return srv.cache.resident_bytes()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance gate
+# ---------------------------------------------------------------------------
+
+def test_acceptance_1000_tenants_budget_sized_for_50(artifacts):
+    """1,000+ registered tenants with ``serve.cache.hbm.budget.bytes``
+    sized for ~50 resident: registration is cold (no device state),
+    steady-state compilations stay flat after the first tenant's warmup,
+    resident responses are byte-identical to the batch predictor, cold
+    first responses land within the cold-start deadline, and eviction
+    keeps the resident set at the budget."""
+    per_model = _nb_model_bytes(artifacts)
+    budget = 50 * per_model + per_model // 2
+    cfg = _tenant_config(artifacts, 1000, n_mk=4,
+                         **{"serve.cache.hbm.budget.bytes": str(budget)})
+    srv = PredictionServer(cfg)
+    port = srv.start()
+    tier = get_shared_tier()
+    try:
+        sec = srv.cache.section()
+        assert sec["registered"] == 1004
+        assert sec["resident"] == 0          # registered != resident
+        # first tenant pays the fleet's compiles (warmup + traffic
+        # buckets); every later same-schema tenant must add ZERO
+        deadline_s = 15.0
+        t0 = time.perf_counter()
+        r = request("127.0.0.1", port, {
+            "model": "t0000", "row": artifacts["nb_test_lines"][0]})
+        first_cold_s = time.perf_counter() - t0
+        assert r.get("output") == artifacts["nb_batch_lines"][0]
+        assert first_cold_s < deadline_s
+        compiles_after_first = tier.stats()["compiles"]
+        # promote a 60-tenant spread: budget must cap residency at ~50
+        for i in range(1, 60):
+            r = request("127.0.0.1", port, {
+                "model": f"t{i:04d}",
+                "row": artifacts["nb_test_lines"][i % 20]})
+            assert r.get("output") == \
+                artifacts["nb_batch_lines"][i % 20], r
+        assert tier.stats()["compiles"] == compiles_after_first, \
+            "same-shape tenants must share compiled scorers"
+        sec = srv.cache.section()
+        assert 45 <= sec["resident"] <= 50
+        assert sec["resident_bytes"] <= budget
+        assert sec["counters"]["Evictions"] >= 9
+        # resident tenants: full-batch byte parity + zero new compiles
+        for name in srv.cache.resident_names()[-3:]:
+            r = request("127.0.0.1", port, {
+                "model": name, "rows": artifacts["nb_test_lines"]})
+            assert r["outputs"] == artifacts["nb_batch_lines"]
+        assert tier.stats()["compiles"] == compiles_after_first
+        # a Markov tenant promotes alongside (different signature —
+        # its compiles are its own, and its parity holds too)
+        r = request("127.0.0.1", port, {
+            "model": "m0000", "rows": artifacts["mk_test_lines"]})
+        assert r["outputs"] == artifacts["mk_batch_lines"]
+        mk_compiles = tier.stats()["compiles"]
+        assert mk_compiles > compiles_after_first
+        r = request("127.0.0.1", port, {
+            "model": "m0001", "rows": artifacts["mk_test_lines"]})
+        assert r["outputs"] == artifacts["mk_batch_lines"]
+        assert tier.stats()["compiles"] == mk_compiles
+        # cold-start latency histogram is populated and bounded
+        cs = srv.cache.section()["coldstart_ms"]
+        assert cs["n"] >= 60
+        assert cs["p99"] < deadline_s * 1000.0
+    finally:
+        srv.stop()
+
+
+def test_cold_start_structured_response_and_bounded_retry(artifacts):
+    """Deadline 0: a cold tenant's request never blocks — it gets a
+    structured ``cold_start`` response with a bounded ``retry_after_ms``
+    — and retrying after the promote lands serves normally."""
+    cfg = _tenant_config(artifacts, 3, **{
+        "serve.cache.coldstart.deadline.ms": "0",
+        "serve.cache.retry.after.max.ms": "800"})
+    srv = PredictionServer(cfg)
+    try:
+        line = artifacts["nb_test_lines"][0]
+        r = srv.handle_line(json.dumps({"model": "t0001", "row": line}))
+        assert r.get("cold_start") is True
+        assert "error" in r
+        assert 50 <= r["retry_after_ms"] <= 800
+        # the promote was enqueued; poll-retry like a real client
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            r = srv.handle_line(json.dumps({"model": "t0001",
+                                            "row": line}))
+            if "output" in r:
+                break
+            time.sleep(min(r.get("retry_after_ms", 50), 200) / 1000.0)
+        assert r.get("output") == artifacts["nb_batch_lines"][0]
+        # unregistered models still get the plain unknown-model error
+        r = srv.handle_line(json.dumps({"model": "nope", "row": line}))
+        assert "error" in r and "cold_start" not in r
+    finally:
+        srv.stop()
+
+
+def test_coldstart_deadline_blocks_through_slow_promote(artifacts):
+    """``promote_slow`` holds the build past the deadline: the request
+    gets the structured cold-start signal (bounded wait, never a hang),
+    and the promote still completes in the background."""
+    cfg = _tenant_config(artifacts, 2, **{
+        "serve.cache.coldstart.deadline.ms": "120"})
+    srv = PredictionServer(cfg)
+    try:
+        faultinject.set_injector(faultinject.FaultInjector(
+            faultinject.parse_plan("promote_slow[t0001]@0:600")))
+        line = artifacts["nb_test_lines"][0]
+        t0 = time.perf_counter()
+        r = srv.handle_line(json.dumps({"model": "t0001", "row": line}))
+        waited = time.perf_counter() - t0
+        assert r.get("cold_start") is True
+        assert 0.1 <= waited < 5.0
+        assert srv.cache.promote("t0001", wait=True, timeout_s=20)
+        r = srv.handle_line(json.dumps({"model": "t0001", "row": line}))
+        assert r.get("output") == artifacts["nb_batch_lines"][0]
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos: promote failure leaves the old resident set serving untouched
+# ---------------------------------------------------------------------------
+
+def test_promote_failure_leaves_resident_set_serving(artifacts):
+    cfg = _tenant_config(artifacts, 5)
+    srv = PredictionServer(cfg)
+    try:
+        line = artifacts["nb_test_lines"][0]
+        for name in ("t0000", "t0001"):
+            r = srv.handle_line(json.dumps({"model": name, "row": line}))
+            assert r.get("output") == artifacts["nb_batch_lines"][0]
+        faultinject.set_injector(faultinject.FaultInjector(
+            faultinject.parse_plan("promote_fail[t0004]@0")))
+        r = srv.handle_line(json.dumps({"model": "t0004", "row": line}))
+        assert r.get("cold_start") is True
+        assert "promote failed" in r["error"]
+        assert "InjectedFault" in r["error"]
+        sec = srv.cache.section()
+        assert sec["counters"]["Promote failures"] == 1
+        assert sorted(sec["resident_models"]) == ["t0000", "t0001"]
+        # the survivors keep serving byte-identical responses
+        for name in ("t0000", "t0001"):
+            r = srv.handle_line(json.dumps({"model": name, "row": line}))
+            assert r.get("output") == artifacts["nb_batch_lines"][0]
+        # negative cache: an immediate retry joins the CACHED failure
+        # (no second build hits the promote workers inside the cooldown)
+        r = srv.handle_line(json.dumps({"model": "t0004", "row": line}))
+        assert r.get("cold_start") is True and "promote failed" in r["error"]
+        assert srv.cache.section()["counters"]["Promote failures"] == 1
+        # the injected fault consumed its budget: once the cooldown
+        # lapses, a client retry promotes and serves
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            r = srv.handle_line(json.dumps({"model": "t0004",
+                                            "row": line}))
+            if "output" in r:
+                break
+            time.sleep(r.get("retry_after_ms", 100) / 1000.0)
+        assert r.get("output") == artifacts["nb_batch_lines"][0]
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# fairness: hot-tenant storm under quota
+# ---------------------------------------------------------------------------
+
+def test_hot_tenant_storm_under_quota_spares_siblings(artifacts):
+    """A hot tenant thrashing cold<->resident is fenced by its token
+    bucket: past the burst, its requests get structured quota_exceeded
+    responses — the siblings stay resident, and no breaker trips."""
+    cfg = _tenant_config(artifacts, 6, **{
+        "serve.cache.max.resident": "5",
+        "serve.cache.tenant.quota.rate": "0.001",
+        "serve.cache.tenant.quota.burst": "1"})
+    srv = PredictionServer(cfg)
+    try:
+        line = artifacts["nb_test_lines"][0]
+        siblings = [f"t{i:04d}" for i in range(5)]
+        for name in siblings:
+            r = srv.handle_line(json.dumps({"model": name, "row": line}))
+            assert r.get("output") == artifacts["nb_batch_lines"][0]
+        hot = "t0005"
+        quota_hits = 0
+        for _ in range(25):
+            r = srv.handle_line(json.dumps({"model": hot, "row": line}))
+            if r.get("quota_exceeded"):
+                quota_hits += 1
+                assert r["retry_after_ms"] > 0
+            elif "output" in r:
+                # resident: demote to force the next request back
+                # through admission (the thrash loop)
+                srv.handle_line(json.dumps({"cmd": "demote",
+                                            "model": hot}))
+        assert quota_hits >= 20
+        sec = srv.cache.section()
+        # the one burst token bought at most one eviction: at least 4
+        # of the 5 siblings are still resident
+        still = [s for s in siblings if s in sec["resident_models"]]
+        assert len(still) >= 4
+        assert sec["counters"].get("Evictions", 0) <= 1
+        assert sec["counters"]["Quota rejected"] == quota_hits
+        # no breaker tripped anywhere
+        health = srv.handle_line(json.dumps({"cmd": "health"}))
+        assert health["degraded"] == []
+        for m in health["models"]:
+            assert m["breaker"] == "closed"
+        for s in still:
+            r = srv.handle_line(json.dumps({"model": s, "row": line}))
+            assert r.get("output") == artifacts["nb_batch_lines"][0]
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellite: demote -> re-promote clears the poison quarantine
+# ---------------------------------------------------------------------------
+
+def test_demote_repromote_clears_poison_quarantine(artifacts):
+    """Regression: the quarantine was cleared on whole-model reload but
+    survived a cache demote — stale offender signatures would refuse
+    rows at submit against a freshly built replica set.  After
+    demote -> re-promote the previously quarantined row must get a real
+    scorer trial (and, with the fault plan exhausted, a real result)."""
+    cfg = _tenant_config(artifacts, 1, **{
+        "serve.poison.isolate": "true",
+        "serve.poison.quarantine.threshold": "1"})
+    srv = PredictionServer(cfg)
+    try:
+        row = "POISON-1," + artifacts["nb_test_lines"][0].split(",", 1)[1]
+        expected = ("POISON-1,"
+                    + artifacts["nb_batch_lines"][0].split(",", 1)[1])
+        # batch failure + its bisect rescore both hit the marker
+        faultinject.set_injector(faultinject.FaultInjector(
+            faultinject.parse_plan("scorer_poison@*x2")))
+        r = srv.handle_line(json.dumps({"model": "t0000", "row": row}))
+        assert r.get("poison") is True
+        # now quarantined: refused AT SUBMIT (no scorer call at all)
+        r = srv.handle_line(json.dumps({"model": "t0000", "row": row}))
+        assert r.get("poison") is True and "quarantined" in r["error"]
+        c = srv.registry.get("t0000").counters
+        assert c.get(SERVE_GROUP, "Poison quarantined submits") == 1
+        faultinject.set_injector(None)
+        # demote -> re-promote: the fresh replica set must NOT inherit
+        # the offender signature
+        assert srv.cache.demote("t0000")
+        r = srv.handle_line(json.dumps({"model": "t0000", "row": row}))
+        assert r.get("output") == expected, r
+        assert "poison" not in r
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellite: SharedCompileTier under concurrent promote storms
+# ---------------------------------------------------------------------------
+
+def test_shared_tier_single_flight_storm():
+    """N threads racing the same shape signature produce exactly ONE
+    build; everyone gets the same fn; counters stay consistent."""
+    tier = SharedCompileTier(cap=64)
+    built = []
+    results = []
+    lock = threading.Lock()
+
+    def build():
+        time.sleep(0.05)
+        with lock:
+            built.append(1)
+        return object()
+
+    def racer():
+        fn, _compiled = tier.get(("sig", 1), build)
+        with lock:
+            results.append(fn)
+
+    threads = [threading.Thread(target=racer) for _ in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(built) == 1
+    assert len({id(f) for f in results}) == 1
+    s = tier.stats()
+    assert s["compiles"] == 1 and s["hits"] == 15
+    assert s["compiles"] + s["hits"] == 16
+    assert s["waits"] >= 1
+
+
+def test_shared_tier_failed_build_retries_next_caller():
+    tier = SharedCompileTier(cap=8)
+    attempts = []
+
+    def build_fail():
+        attempts.append(1)
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError, match="boom"):
+        tier.get(("k",), build_fail)
+    # the failure released the single-flight slot: the next caller
+    # becomes the builder (and can succeed)
+    fn, compiled = tier.get(("k",), lambda: "ok")
+    assert fn == "ok" and compiled
+    assert len(attempts) == 1
+
+
+def test_shared_tier_eviction_never_breaks_inflight_and_counters():
+    """cap=1 thrash: eviction drops only the tier's reference — every
+    returned fn is the right one for its key (an in-flight holder is
+    unaffected), and compiles + hits == total resolved gets."""
+    tier = SharedCompileTier(cap=1)
+    errors = []
+    CALLS = 400
+
+    def hammer(seed):
+        rng = np.random.default_rng(seed)
+        try:
+            for _ in range(CALLS):
+                k = int(rng.integers(0, 3))
+                fn, _ = tier.get(("key", k), lambda k=k: ("fn", k))
+                if fn != ("fn", k):
+                    raise AssertionError(f"wrong fn for {k}: {fn}")
+        except BaseException as e:              # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(s,))
+               for s in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    s = tier.stats()
+    assert s["size"] <= 1
+    assert s["compiles"] + s["hits"] == 8 * CALLS
+    assert s["compiles"] >= 3                    # thrash really evicted
+
+
+def test_concurrent_same_shape_promotes_race_one_compile(artifacts):
+    """The promote-storm form of single-flight: 4 promote workers
+    building 8 same-schema tenants concurrently add ZERO compiles after
+    the first tenant's buckets exist."""
+    cfg = _tenant_config(artifacts, 9, **{
+        "serve.cache.promote.threads": "4"})
+    srv = PredictionServer(cfg)
+    tier = get_shared_tier()
+    try:
+        assert srv.cache.promote("t0000", wait=True)
+        before = tier.stats()["compiles"]
+        ps = [srv.cache.request_promote(f"t{i:04d}", charge=False)
+              for i in range(1, 9)]
+        for p in ps:
+            assert p.done_event.wait(30)
+            assert p.error is None
+        assert sorted(srv.cache.resident_names()) == \
+            [f"t{i:04d}" for i in range(9)]
+        assert tier.stats()["compiles"] == before
+        line = artifacts["nb_test_lines"][1]
+        for i in range(9):
+            r = srv.handle_line(json.dumps({"model": f"t{i:04d}",
+                                            "row": line}))
+            assert r.get("output") == artifacts["nb_batch_lines"][1]
+        assert tier.stats()["compiles"] == before
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# router: non-resident variants demote before requests fail
+# ---------------------------------------------------------------------------
+
+def test_nonresident_variant_demotes_and_pin_gets_cold_start(artifacts):
+    cfg = _tenant_config(artifacts, 1, **{
+        "serve.model.t0000.variants": "f32,f64"})
+    srv = PredictionServer(cfg)
+    try:
+        line = artifacts["nb_test_lines"][0]
+        assert srv.cache.promote("t0000", wait=True)
+        # both variants resident: cheapest (f32) serves
+        r = srv.handle_line(json.dumps({"model": "t0000", "row": line}))
+        assert r["variant"] == "f32" and not r.get("demoted")
+        # demote ONLY the cheap variant: requests demote to f64 before
+        # failing, the demotion is counted, a re-promote is nudged
+        assert srv.cache.demote("t0000", variant="f32")
+        r = srv.handle_line(json.dumps({"model": "t0000", "row": line}))
+        assert r["variant"] == "f64"
+        assert r.get("demoted") is True
+        assert "output" in r
+        assert srv.router.demotions("t0000") >= 1
+        # pinning the non-resident variant gets the structured signal
+        r2 = srv.handle_line(json.dumps({"model": "t0000", "row": line,
+                                         "variant": "f32"}))
+        if "cold_start" in r2:
+            assert r2["retry_after_ms"] >= 50
+        else:
+            # the demoted request's nudge may already have restored it
+            assert r2.get("variant") == "f32"
+        # the nudged background promote heals the variant
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            r3 = srv.handle_line(json.dumps({"model": "t0000",
+                                             "row": line}))
+            if r3.get("variant") == "f32":
+                break
+            time.sleep(0.05)
+        assert r3.get("variant") == "f32" and "output" in r3
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# wiring: eager + cached coexistence, telemetry, preload
+# ---------------------------------------------------------------------------
+
+def test_eager_and_cached_coexist_and_conflict_rejected(artifacts):
+    props = _tenant_config(artifacts, 2).props
+    props["serve.models"] = "eager"
+    props["serve.model.eager.kind"] = "naiveBayes"
+    for k, v in artifacts["nb_props"].items():
+        props[f"serve.model.eager.{k}"] = v
+    srv = PredictionServer(JobConfig(dict(props)))
+    try:
+        line = artifacts["nb_test_lines"][0]
+        # the eager model is resident from startup, never cache-managed
+        r = srv.handle_line(json.dumps({"model": "eager", "row": line}))
+        assert r.get("output") == artifacts["nb_batch_lines"][0]
+        assert "eager" not in srv.cache.resident_names()
+        r = srv.handle_line(json.dumps({"model": "t0001", "row": line}))
+        assert r.get("output") == artifacts["nb_batch_lines"][0]
+        assert srv.cache.resident_names() == ["t0001"]
+    finally:
+        srv.stop()
+    # one name in both lists is a configuration error
+    bad = dict(props)
+    bad["serve.cache.models"] = "eager,t0000,t0001"
+    with pytest.raises(ValueError, match="both serve.models"):
+        PredictionServer(JobConfig(bad))
+
+
+def test_cache_gauges_and_coldstart_exemplar_in_exposition(artifacts):
+    from avenir_tpu.core import obs
+
+    cfg = _tenant_config(artifacts, 2)
+    obs.configure(enabled=True)
+    srv = PredictionServer(cfg)
+    try:
+        line = artifacts["nb_test_lines"][0]
+        r = srv.handle_line(json.dumps({
+            "model": "t0000", "row": line,
+            "trace_id": "cafe0123deadbeef"}))   # client trace: sampled
+        assert r.get("output") == artifacts["nb_batch_lines"][0]
+        assert r.get("trace_id") == "cafe0123deadbeef"
+        text = srv.metrics_text()
+        assert "serve_cache_resident 1" in text
+        assert "serve_cache_registered 2" in text
+        assert "serve_cache_promotes 1" in text
+        assert "serve_cache_coldstart_seconds_bucket" in text
+        # the cold-start histogram carries the promoting request's
+        # trace as an OpenMetrics exemplar
+        cold = [l for l in text.splitlines()
+                if "serve_cache_coldstart_seconds_bucket" in l
+                and "cafe0123deadbeef" in l]
+        assert cold, "cold-start exemplar missing from exposition"
+        stats = srv.handle_line(json.dumps({"cmd": "stats"}))
+        assert stats["cache"]["resident"] == 1
+        assert stats["cache"]["coldstart_ms"]["n"] == 1
+    finally:
+        srv.stop()
+        obs.configure(enabled=False)
+
+
+def test_garbage_model_value_over_tcp_keeps_shard_alive(artifacts):
+    """Regression: ``needs_wait`` runs on an I/O shard BEFORE request
+    validation — an unhashable ``"model"`` (list/dict) must produce a
+    structured error response, not a TypeError that kills the shard's
+    event loop (and with it every connection on that shard)."""
+    cfg = _tenant_config(artifacts, 2)
+    srv = PredictionServer(cfg)
+    port = srv.start()
+    try:
+        line = artifacts["nb_test_lines"][0]
+        for bad in ([], {"a": 1}, 5):
+            r = request("127.0.0.1", port, {"model": bad, "row": line})
+            assert "error" in r and "output" not in r, r
+        # the shard survived: real traffic still flows on new requests
+        r = request("127.0.0.1", port, {"model": "t0000", "row": line})
+        assert r.get("output") == artifacts["nb_batch_lines"][0]
+    finally:
+        srv.stop()
+
+
+def test_preload_promote_demote_cmds(artifacts):
+    cfg = _tenant_config(artifacts, 3,
+                         **{"serve.cache.preload": "t0002"})
+    srv = PredictionServer(cfg)
+    try:
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if srv.cache.is_resident("t0002"):
+                break
+            time.sleep(0.02)
+        assert srv.cache.is_resident("t0002")
+        r = srv.handle_line(json.dumps({"cmd": "promote",
+                                        "model": "t0001"}))
+        assert r == {"ok": True, "model": "t0001", "resident": True}
+        r = srv.handle_line(json.dumps({"cmd": "demote",
+                                        "model": "t0001"}))
+        assert r["ok"] is True
+        assert not srv.cache.is_resident("t0001")
+        # registry forgot the adopted entry; the descriptor survives
+        with pytest.raises(KeyError):
+            srv.registry.get("t0001")
+        assert srv.cache.is_cataloged("t0001")
+        health = srv.handle_line(json.dumps({"cmd": "health"}))
+        assert health["cache"]["registered"] == 3
+    finally:
+        srv.stop()

@@ -159,6 +159,74 @@ def test_xreadgroup_without_group_raises_nogroup():
 
 
 # ---------------------------------------------------------------------------
+# stream trimming (XTRIM past the all-consumers ack horizon)
+# ---------------------------------------------------------------------------
+
+def test_trim_clamps_to_slowest_consumer_group():
+    """XTRIM must never drop entries a LAGGING consumer group has not
+    consumed+acked, no matter how far ahead the trimming consumer's own
+    ack horizon is."""
+    fake = FakeRedis()
+    tr = _transport(fake)
+    tr.ensure_group()
+    fake.xgroup_create("fb", "lagging", id="0")
+    for i in range(5):
+        tr.publish({"data": f"t1,a,{i}"})
+    got = tr.read_new(5)
+    tr.ack([e[0] for e in got])
+    # our group acked everything, but `lagging` has read nothing:
+    # its floor (first undelivered id) pins the trim at zero
+    assert tr.trim_acked("5-0") == 0
+    assert fake.xlen("fb") == 5
+    lag = RedisStreamTransport("unused", 0, "fb", "lagging", "c9",
+                               client=fake)
+    lag_got = lag.read_new(2)
+    lag.ack([lag_got[0][0]])                 # acks 1-0, 2-0 stays pending
+    assert tr.trim_acked("5-0") == 1         # only 1-0 is safe everywhere
+    assert [e[0] for e in fake.xrange("fb")] == \
+        ["2-0", "3-0", "4-0", "5-0"]
+
+
+def test_trimmed_stream_resumes_byte_identical(tmp_path, mesh1):
+    """The ROADMAP stream-trimming item: with ``stream.trim.enable`` the
+    consumer XTRIMs entries at or below its ack horizon after each
+    checkpoint — the stream stays bounded — and a consumer resumed from
+    the checkpoint watermark against the TRIMMED stream still ends
+    byte-identical to a batch replay of the full event log."""
+    events = _events(7, n=30)
+    fake = FakeRedis()
+    tr = _transport(fake)
+    tr.ensure_group()
+    _feed(tr, events[:20])
+    props = _props(tmp_path, **{
+        "stream.trim.enable": "true",
+        "checkpoint.path": str(tmp_path / "trim.ckpt")})
+    cfg = JobConfig(props)
+    store = PosteriorStore.from_config("trim-1", cfg, mesh=mesh1)
+    cons = FeedbackConsumer(cfg, store, tr,
+                            checkpointer=checkpointer_from_config(
+                                cfg, store, props["checkpoint.path"]))
+    cons.run(idle_timeout=0.05)
+    # the clean stop's read-back-validated final checkpoint covers
+    # everything applied, so the whole backlog trims away
+    assert cons.counters.get("Stream", "Trimmed entries") == 20
+    assert tr.length() == 0
+    # resume from the watermark against the TRIMMED stream + new events
+    _feed(tr, events[20:])
+    cfg2 = JobConfig(dict(props, **{"checkpoint.resume": "true"}))
+    store2 = PosteriorStore.from_config("trim-2", cfg2, mesh=mesh1)
+    cons2 = FeedbackConsumer(cfg2, store2, _transport(fake),
+                             checkpointer=checkpointer_from_config(
+                                 cfg2, store2, props["checkpoint.path"]))
+    cons2.run(idle_timeout=0.05)
+    assert store2.host_posterior().lines() == _batch_replay(
+        events, tmp_path, mesh1, tag="trimref")
+    # the resumed consumer restores cumulative counters from the
+    # checkpoint: 20 carried + 10 fresh, no drops, no double-applies
+    assert cons2.counters.get("Stream", "Events applied") == len(events)
+
+
+# ---------------------------------------------------------------------------
 # posterior monoid state
 # ---------------------------------------------------------------------------
 
