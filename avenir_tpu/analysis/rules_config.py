@@ -37,6 +37,11 @@ NAMESPACE_GROUPS: Dict[str, str] = {
     # the multi-tenant managed model cache (serve/modelcache.py +
     # serve/admission.py): serve.cache.* residency/cold-start/quota keys
     "cache": r"(?:serve\.cache)",
+    # host ingest: the parallel-parse pool (core/parparse.py) and the
+    # parse-once binary cache (core/ingestcache.py).  Deliberately NOT
+    # bare `ingest` — the legacy `ingest.chunk.bytes` /
+    # `ingest.error.budget` literals predate the rule and stay out
+    "ingest": r"(?:ingest\.parse|ingest\.cache)",
 }
 
 _ACCESSORS = (r"\.(?:get|get_int|get_float|get_boolean|get_list|must|"

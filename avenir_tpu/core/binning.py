@@ -384,7 +384,8 @@ class DatasetEncoder:
                            chunk_rows: Optional[int] = None,
                            start_offset: int = 0,
                            with_offsets: bool = False,
-                           salvage=None):
+                           salvage=None,
+                           parse_threads: int = 1):
         """Generator over C-encoded chunks of the input, split at line
         boundaries: yields ``(x, values, y, n_rows)`` per chunk with the
         SAME shared vocabularies as ``encode_path`` (codes are globally
@@ -411,7 +412,14 @@ class DatasetEncoder:
         whole-chunk ``ChunkedEncodeUnsupported`` on a native encode
         failure with per-row quarantine of the malformed rows.  Each
         chunk also passes the fault-injection hooks
-        (``pipeline.chunk_faults``)."""
+        (``pipeline.chunk_faults``).
+
+        ``parse_threads`` > 1 fans the per-chunk C encode across a
+        ``core.parparse.OrderedParsePool`` (the ``ingest.parse.threads``
+        surface).  Workers run ONLY the GIL-releasing native call; fault
+        injection stays at submission and vocab merge / salvage /
+        quarantine run here in strict chunk order, so output AND vocab
+        discovery order are byte-identical to the serial scan."""
         from .io import is_plain_delim
         from .obs import get_tracer
         from . import pipeline
@@ -441,41 +449,68 @@ class DatasetEncoder:
             # the shared boundary definition (multi-scan passes chunk the
             # same buffer identically — load-bearing for parity)
             row_ends = row_chunk_ends(buf, chunk_rows) if buf else []
-        pos = 0
-        idx = 0
-        while pos < len(buf):
-            if row_ends is not None:
-                end = int(row_ends.pop(0))
-            else:
-                end = min(pos + chunk_bytes, len(buf))
-                if end < len(buf):
-                    nl = buf.find(b"\n", end)
-                    end = len(buf) if nl < 0 else nl + 1
-            if end <= start_offset:
+        n_feat = len(self.feature_fields)
+        has_class = self.class_field is not None
+        parse_threads = max(int(parse_threads), 1)
+
+        def _chunks():
+            # payloads are produced on the CONSUMER thread (pool.map
+            # calls next() there): chunk_faults keeps its serial
+            # worker_death/corrupt semantics per chunk index
+            pos = 0
+            idx = 0
+            while pos < len(buf):
+                if row_ends is not None:
+                    end = int(row_ends.pop(0))
+                else:
+                    end = min(pos + chunk_bytes, len(buf))
+                    if end < len(buf):
+                        nl = buf.find(b"\n", end)
+                        end = len(buf) if nl < 0 else nl + 1
+                if end > start_offset:
+                    yield idx, end, pipeline.chunk_faults(buf[pos:end], idx)
                 pos = end
                 idx += 1
-                continue
-            chunk = pipeline.chunk_faults(buf[pos:end], idx)
-            n_hint = _rows_hint(chunk)
-            with tracer.span("ingest.parse", bytes=len(chunk)):
-                res = native.encode_schema_buffer(
-                    chunk, specs, n_cols, len(self.feature_fields),
-                    self.class_field is not None, id_ordinal=id_ord,
-                    delim=delim, n_rows_hint=n_hint)
-                if res is None:
-                    if salvage is None:
-                        raise ChunkedEncodeUnsupported(
-                            "native encode failed")
-                    # per-row quarantine instead of a whole-chunk abort
-                    x, values, y, n = salvage(chunk)
+
+        def _parse(item):
+            # pure GIL-releasing C call; no shared Python state.  Inner
+            # pthread fan-out is forced to 1 when the pool itself is
+            # parallel so the two levels don't oversubscribe the host.
+            cidx, end, chunk = item
+            res = native.encode_schema_buffer(
+                chunk, specs, n_cols, n_feat, has_class,
+                id_ordinal=id_ord, delim=delim,
+                n_rows_hint=_rows_hint(chunk),
+                n_threads=1 if parse_threads > 1 else None)
+            return cidx, end, chunk, res
+
+        if parse_threads > 1:
+            from .parparse import OrderedParsePool
+            parsed = OrderedParsePool(_parse, parse_threads).map(_chunks())
+        else:
+            parsed = map(_parse, _chunks())
+        try:
+            for cidx, end, chunk, res in parsed:
+                with tracer.span("ingest.parse", bytes=len(chunk),
+                                 threads=parse_threads):
+                    if res is None:
+                        if salvage is None:
+                            raise ChunkedEncodeUnsupported(
+                                "native encode failed")
+                        # per-row quarantine instead of whole-chunk abort
+                        x, values, y, n = salvage(chunk)
+                    else:
+                        # serial, in chunk order: vocab discovery order
+                        # is identical to the serial scan by construction
+                        n, x, values, y, _ = self._remap_native(res)
+                if with_offsets:
+                    yield x, values, y, n, cidx, end
                 else:
-                    n, x, values, y, _ = self._remap_native(res)
-            if with_offsets:
-                yield x, values, y, n, idx, end
-            else:
-                yield x, values, y, n
-            pos = end
-            idx += 1
+                    yield x, values, y, n
+        finally:
+            closer = getattr(parsed, "close", None)
+            if closer is not None:
+                closer()
 
     @staticmethod
     def _cat_lut(vocab: Vocab, uniques) -> np.ndarray:

@@ -89,12 +89,14 @@ from . import telemetry
 KEY_STAGES = "workflow.stages"
 KEY_FUSE = "workflow.fuse"
 KEY_COST_SCAN_MBPS = "workflow.cost.scan.mb.per.sec"
+KEY_COST_SCAN_CACHED_MBPS = "workflow.cost.scan.cached.mb.per.sec"
 KEY_COST_FOLD_DEFAULT = "workflow.cost.fold.sec.default"
 KEY_COST_FUSE_OVERHEAD = "workflow.cost.fuse.overhead.sec"
 KEY_CKPT_PATH = "workflow.checkpoint.path"
 KEY_HANDOFF_VERIFY = "workflow.handoff.verify"
 
 DEFAULT_SCAN_MBPS = 200.0
+DEFAULT_CACHED_SCAN_MBPS = 2000.0
 DEFAULT_FOLD_SEC = 0.02
 DEFAULT_FUSE_OVERHEAD_SEC = 0.005
 
@@ -512,16 +514,31 @@ def measured_fold_sec(sid: str, cls_name: str, scan_bytes: int,
 
 
 def fusion_decision(stages: Sequence[Stage], scan_bytes: int,
-                    config: JobConfig, row_bytes: int = 64) -> Tuple[bool, dict]:
+                    config: JobConfig, row_bytes: int = 64,
+                    in_path: Optional[str] = None) -> Tuple[bool, dict]:
     """Fuse these same-input ready stages into one shared scan, or run
     them separately?  Returns ``(fuse, detail)`` where detail carries
     every estimate (for logs/tests).  See the module docstring for the
-    model; ``workflow.fuse=always|never`` short-circuits it."""
+    model; ``workflow.fuse=always|never`` short-circuits it.
+
+    With ``in_path`` given and a published ingest-cache artifact present
+    for it (core.ingestcache), scans are priced at the cached (mmap
+    replay) rate ``workflow.cost.scan.cached.mb.per.sec`` instead of the
+    parse rate — a warm input makes re-scanning ~10x cheaper, which
+    legitimately flips some fuse decisions toward running separately."""
     mode = (config.get(KEY_FUSE, "auto") or "auto").lower()
     if mode not in ("auto", "always", "never"):
         raise WorkflowConfigError(
             f"{KEY_FUSE}={mode!r}: use auto, always, or never")
-    mbps = config.get_float(KEY_COST_SCAN_MBPS, DEFAULT_SCAN_MBPS)
+    scan_cached = False
+    if in_path is not None:
+        from .ingestcache import probe_scan_boost
+        scan_cached = probe_scan_boost(config, in_path)
+    if scan_cached:
+        mbps = config.get_float(KEY_COST_SCAN_CACHED_MBPS,
+                                DEFAULT_CACHED_SCAN_MBPS)
+    else:
+        mbps = config.get_float(KEY_COST_SCAN_MBPS, DEFAULT_SCAN_MBPS)
     fold_default = config.get_float(KEY_COST_FOLD_DEFAULT, DEFAULT_FOLD_SEC)
     overhead = config.get_float(KEY_COST_FUSE_OVERHEAD,
                                 DEFAULT_FUSE_OVERHEAD_SEC)
@@ -550,7 +567,8 @@ def fusion_decision(stages: Sequence[Stage], scan_bytes: int,
     else:
         fuse = fused_sec < separate_sec
     return fuse, {"mode": mode, "scan_bytes": scan_bytes,
-                  "scan_sec": scan_sec, "fold_sec": folds,
+                  "scan_sec": scan_sec, "scan_cached": scan_cached,
+                  "fold_sec": folds,
                   "fold_source": sources, "separate_sec": separate_sec,
                   "fused_sec": fused_sec, "fuse": fuse}
 
@@ -713,7 +731,7 @@ def run_workflow(config: JobConfig, in_path: str, out_base: Optional[str],
                         continue
                     fuse, detail = fusion_decision(
                         members, _scan_bytes(stage_in(members[0]), store),
-                        config)
+                        config, in_path=stage_in(members[0]))
                     sids = ",".join(m.sid for m in members)
                     say(f"dag: cost model ({detail['mode']}): stages "
                         f"[{sids}] scan={detail['scan_sec']:.4f}s "

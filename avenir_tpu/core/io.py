@@ -506,14 +506,28 @@ class OutputWriter:
     manifests merge, so every part of a partitioned output validates.
     With ``as_dir=False`` the path is written as a bare file (atomic
     replace, no manifest/marker) and ``shard`` is rejected.
+
+    ``binary=True`` opens the stage in bytes mode (use
+    :meth:`write_bytes`) and ``name`` overrides the part file name —
+    the ingest-cache artifact writes raw column matrices this way while
+    inheriting the full manifest/_SUCCESS/torn-write machinery.
+    ``mark_success=False`` publishes the part + manifest but defers the
+    ``_SUCCESS`` marker, so a multi-part artifact's LAST writer commits
+    the whole directory atomically (readers gate on ``_SUCCESS``).
     """
 
-    def __init__(self, out_path: str, shard: Optional[int] = None, as_dir: bool = True):
+    def __init__(self, out_path: str, shard: Optional[int] = None,
+                 as_dir: bool = True, name: Optional[str] = None,
+                 binary: bool = False, mark_success: bool = True):
         self.out_path = out_path
         self.as_dir = as_dir
+        self.mark_success = mark_success
         if as_dir:
             os.makedirs(out_path, exist_ok=True)
-            self.file_path = os.path.join(out_path, f"part-r-{(shard or 0):05d}")
+            if name is not None and shard is not None:
+                raise ValueError("name and shard are mutually exclusive")
+            self.file_path = os.path.join(
+                out_path, name or f"part-r-{(shard or 0):05d}")
         else:
             if shard is not None:
                 raise ValueError("shard is only meaningful with as_dir=True")
@@ -524,12 +538,23 @@ class OutputWriter:
         d = os.path.dirname(self.file_path) or "."
         fd, self._tmp_path = tempfile.mkstemp(
             prefix="." + os.path.basename(self.file_path) + ".", dir=d)
-        self._fh = os.fdopen(fd, "w")
+        self._fh = os.fdopen(fd, "wb" if binary else "w")
+        self._binary = binary
         self._closed = False
 
     def write(self, line: str) -> None:
+        if self._binary:
+            raise TypeError("binary writer: use write_bytes")
         self._fh.write(line)
         self._fh.write("\n")
+
+    def write_bytes(self, data) -> None:
+        """Append raw bytes to the staged part (``binary=True`` mode;
+        accepts anything exposing the buffer protocol, so numpy arrays
+        stream without a copy)."""
+        if not self._binary:
+            raise TypeError("text writer: use write")
+        self._fh.write(data)
 
     def write_all(self, lines: Iterable[str]) -> None:
         for line in lines:
@@ -604,7 +629,8 @@ class OutputWriter:
         _VALIDATED.pop(os.path.abspath(self.out_path), None)
         if self.as_dir:
             self._update_manifest()
-            open(os.path.join(self.out_path, SUCCESS_NAME), "w").close()
+            if self.mark_success:
+                open(os.path.join(self.out_path, SUCCESS_NAME), "w").close()
 
     def __enter__(self) -> "OutputWriter":
         return self

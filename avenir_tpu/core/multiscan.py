@@ -114,11 +114,19 @@ class ChunkContext:
     single-pass kernel straight off the bytes when available (no Python
     string ever materializes for schema-only job sets)."""
 
-    __slots__ = ("raw", "delim", "_tracer", "_memo")
+    __slots__ = ("raw", "delim", "warm", "chunk_idx", "_tracer", "_memo")
 
-    def __init__(self, raw: bytes, delim: str, tracer=None):
+    def __init__(self, raw: bytes, delim: str, tracer=None, warm=None,
+                 chunk_idx: int = -1):
         self.raw = raw
         self.delim = delim
+        # ``warm``: an optional ingest-cache adapter (core.ingestcache
+        # .MultiScanCacheTee) serving this chunk's encode off a
+        # validated mmapped artifact instead of parsing — and teeing
+        # fresh encodes toward a new artifact on a miss; ``chunk_idx``
+        # addresses the recorded slice
+        self.warm = warm
+        self.chunk_idx = chunk_idx
         self._tracer = tracer or get_tracer()
         self._memo: dict = {}
 
@@ -191,13 +199,21 @@ class ChunkContext:
         return self.shared(("encoded", id(enc)), lambda: self._encode(enc))
 
     def _encode(self, enc):
+        if self.warm is not None and self.chunk_idx >= 0:
+            res = self.warm.warm(enc, self.chunk_idx, self.raw)
+            if res is not None:
+                with self._tracer.span("ingest.cache.read",
+                                       rows=int(res[3])):
+                    return res
         res = enc.encode_buffer_chunk(self.raw, self.delim)
-        if res is not None:
-            return res
-        dsc = enc.encode(self.fields())
-        if (dsc.bin_offset != 0).any():
-            raise ChunkedEncodeUnsupported("negative bin")
-        return dsc.x, dsc.values, dsc.y, dsc.n_rows
+        if res is None:
+            dsc = enc.encode(self.fields())
+            if (dsc.bin_offset != 0).any():
+                raise ChunkedEncodeUnsupported("negative bin")
+            res = (dsc.x, dsc.values, dsc.y, dsc.n_rows)
+        if self.warm is not None and self.chunk_idx >= 0:
+            self.warm.tee(enc, self.chunk_idx, res)
+        return res
 
 
 def merge_carries(a, b):
@@ -236,6 +252,9 @@ class MultiScanEngine:
         self.specs: List[FoldSpec] = []
         self.failures: List[_SpecFailure] = []
         self._encoders: Dict[object, object] = {}
+        # optional ingest-cache warm hook (see ChunkContext.warm); set by
+        # run_multi when ingest.cache.enable is on
+        self.warm_source = None
 
     # -- registration ------------------------------------------------------
     def register(self, spec: FoldSpec) -> FoldSpec:
@@ -286,6 +305,11 @@ class MultiScanEngine:
         active: List[FoldSpec] = list(self.specs)
         fed_any: set = {s for s in self.specs if s.name in set(resume_fed)}
         produced: set = {s.name for s in fed_any}
+        # ingest-cache adapter: disabled on resume (a resumed scan's
+        # chunk indices restart mid-file, so warm slices would misalign
+        # and a tee'd artifact would be partial)
+        cache_tee = self.warm_source if resume_offset == 0 else None
+        n_chunks_seen = [0]
 
         def make_fold(spec: FoldSpec) -> pipeline.ChunkFold:
             return pipeline.ChunkFold(
@@ -310,7 +334,9 @@ class MultiScanEngine:
             one raw byte chunk — the parse+encode+H2D half, run on the
             prefetch worker."""
             raw, chunk_idx, end_offset = item
-            ctx = ChunkContext(raw, delim_regex, tracer)
+            n_chunks_seen[0] = max(n_chunks_seen[0], chunk_idx + 1)
+            ctx = ChunkContext(raw, delim_regex, tracer,
+                               warm=cache_tee, chunk_idx=chunk_idx)
             items: list = []
             for spec in list(active):
                 try:
@@ -408,6 +434,9 @@ class MultiScanEngine:
                                   thread_name="avenir-multiscan-prefetch")
         if saver is not None:
             saver.flush()
+        if cache_tee is not None:
+            # publish only builders the scan fed gap-free to the end
+            cache_tee.finish(n_chunks_seen[0])
 
         # -- finalize every surviving spec --------------------------------
         results: Dict[str, Counters] = {}
@@ -545,6 +574,13 @@ def run_multi(config: JobConfig, in_path: str, out_base: Optional[str],
         chunk_rows=config.pipeline_chunk_rows(
             default=pipeline.DEFAULT_CHUNK_ROWS),
         prefetch_depth=config.pipeline_prefetch_depth())
+    # with the ingest cache enabled, schema-encoding specs read their
+    # chunks off a validated mmapped artifact when one matches this
+    # (input, encoder, delim, chunk_rows), and misses tee the fresh
+    # encodes into a new artifact published at scan end
+    from .ingestcache import multiscan_cache_tee
+    engine.warm_source = multiscan_cache_tee(
+        config, in_path, engine.chunk_rows, config.field_delim_regex())
 
     fused_ids = [e.jid for e in entries if e.spec is not None]
     ck = StreamCheckpointer.from_config(

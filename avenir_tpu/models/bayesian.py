@@ -55,7 +55,8 @@ from ..core.config import JobConfig
 from ..core.io import read_lines, split_line, write_output
 from ..core.metrics import ConfusionMatrix, CostBasedArbitrator, Counters
 from ..core.schema import FeatureSchema
-from ..ops.counting import feature_class_counts, sharded_reduce
+from ..ops.counting import (feature_class_counts, feature_class_counts_rawbin,
+                            sharded_reduce)
 
 
 def _java_int32(x):
@@ -116,6 +117,24 @@ def _jstd(vsq: int, cnt: int, mean: int) -> int:
 # at -- the massively parallel binned counting.
 def _nb_local(x, y, mask, n_class, max_bins):
     return feature_class_counts(x, y, n_class, max_bins, mask=mask)
+
+
+def _nb_local_rawbin(x, y, mask, n_class, max_bins, widths):
+    """Warm-cache fold: ``x`` holds PRE-BIN raw integers straight off the
+    mmapped artifact; binning fuses into the count kernel on device
+    (ops.pallas_count rawbin variant on TPU, an XLA-fused div elsewhere)."""
+    return feature_class_counts_rawbin(x, y, n_class, max_bins, widths,
+                                       mask=mask)
+
+
+def _aborting_salvage(builder, inner):
+    """Wrap a salvage callable so a salvaged (quarantined) chunk kills the
+    cache build: the artifact must equal a clean re-encode of the input
+    bytes, and salvage means this scan's output does not."""
+    def salvage(chunk):
+        builder.abort()
+        return inner(chunk)
+    return salvage
 
 
 # Scratch buffers for _host_moments, thread-local so concurrent trainings
@@ -368,9 +387,10 @@ class BayesianDistribution:
         byte-identical output; with ``ingest.error.budget`` set,
         malformed rows quarantine to a sidecar instead of failing the
         chunk."""
-        from ..core import pipeline
+        from ..core import ingestcache, pipeline
         from ..core.binning import ChunkedEncodeUnsupported
         from ..core.checkpoint import StreamCheckpointer
+        from ..core.parparse import parse_threads_from_config
         from ..core.resilience import RowQuarantine, salvage_chunk
 
         enc = DatasetEncoder(self.schema)
@@ -408,15 +428,37 @@ class BayesianDistribution:
                 start_offset = payload["offset"]
                 resumed = True
 
+        # parse-once cache: a validated artifact for (input bytes, encoder
+        # schema, delim, chunk_rows) replays mmapped chunks instead of
+        # parsing; a miss tees this cold scan into a new artifact.  Resumed
+        # runs keep the checkpointed cold path (the sidecar's byte offset
+        # anchors to the raw file, not the cache).
+        cache = ingestcache.IngestCache.from_config(self.config, in_path,
+                                                    enc, delim_in)
+        builder = None
+        if cache is not None and not resumed and start_offset == 0:
+            scan = cache.load(chunk_rows)
+            if scan is not None:
+                lines = self._train_warm(scan, enc, st, counters, mesh,
+                                         delim, quarantine, chunk_rows)
+                if lines is not None and ck is not None:
+                    ck.complete()
+                return lines
+            builder = cache.builder(chunk_rows)
+
         salvage = (salvage_chunk(enc, quarantine, delim_in)
                    if quarantine is not None else None)
+        if builder is not None and salvage is not None:
+            salvage = _aborting_salvage(builder, salvage)
         try:
-            gen = enc.encode_path_chunks(in_path, delim_in,
-                                         chunk_bytes=chunk_bytes,
-                                         chunk_rows=chunk_rows,
-                                         start_offset=start_offset,
-                                         with_offsets=True,
-                                         salvage=salvage)
+            gen = enc.encode_path_chunks(
+                in_path, delim_in,
+                chunk_bytes=chunk_bytes,
+                chunk_rows=chunk_rows,
+                start_offset=start_offset,
+                with_offsets=True,
+                salvage=salvage,
+                parse_threads=parse_threads_from_config(self.config))
             if not resumed:
                 first, gen = pipeline.peek(gen)
                 if first is None:
@@ -440,6 +482,8 @@ class BayesianDistribution:
                     out = st.accept(x, values, y, n)
                     if out is None:
                         continue
+                    if builder is not None:
+                        builder.add(x, values, y, n)
                     if ck is not None and ck.due(idx):
                         token = ck.token(idx, end, {
                             "enc": enc, "st": st,
@@ -455,19 +499,87 @@ class BayesianDistribution:
                 mesh=mesh, prefetch_depth=depth, capacity=chunk_rows,
                 checkpointer=ck, initial_carry=initial_carry)
         except ChunkedEncodeUnsupported:
+            if builder is not None:
+                builder.abort()
             if ck is not None:
                 # the fallback run supersedes any sidecar this attempt
                 # wrote — a stale checkpoint must not shadow it
                 ck.complete()
             return None
         if total is None:
+            if builder is not None:
+                builder.abort()
             return None
+        if builder is not None:
+            builder.finish()
         if quarantine is not None:
             quarantine.finish(counters)
         lines = self._streamed_model_lines(enc, st, total, counters, delim)
         if ck is not None:
             ck.complete()
         return lines
+
+    def _train_warm(self, scan, enc: DatasetEncoder, st: "_NBStreamState",
+                    counters: Counters, mesh, delim: str, quarantine,
+                    chunk_rows: int) -> Optional[List[str]]:
+        """The warm half of ``_train_streamed``: replay the validated
+        cache artifact's recorded chunks off mmap — no parse, no encode —
+        through the SAME stream state (caps, guards, host moments,
+        quarantine accounting), so every downstream byte matches the cold
+        run.  With the raw matrix present and ``ingest.cache.fused`` on,
+        the fold ships pre-bin integers and bins INSIDE the count kernel
+        (``_nb_local_rawbin``); otherwise it folds the stored binned
+        matrix through the standard ``_nb_local``."""
+        from ..core import ingestcache, pipeline
+        from ..core.binning import ChunkedEncodeUnsupported
+
+        tracer = get_tracer()
+        scan.seed_encoder(enc)
+        depth = self.config.pipeline_prefetch_depth()
+        use_raw = (scan.xraw is not None and self.config.get_boolean(
+            ingestcache.KEY_CACHE_FUSED, True))
+        sl0 = scan.chunk_slice(0)
+        if sl0 is None:
+            return None
+        st.size_caps(np.asarray(sl0[0]))
+
+        def chunks():
+            for item in scan.chunks(with_raw=use_raw):
+                if use_raw:
+                    xraw, x, values, y, n, _ = item
+                else:
+                    x, values, y, n, _ = item
+                    xraw = None
+                with tracer.span("ingest.cache.read", rows=n):
+                    if quarantine is not None:
+                        quarantine.admit(n)
+                    out = st.accept(x, values, y, n)
+                if out is None:
+                    continue
+                xs, ys = out
+                yield (np.asarray(xraw), ys) if use_raw else (xs, ys)
+
+        try:
+            if use_raw:
+                widths = tuple(
+                    int(f.bucketWidth) if f.is_bucket_width_defined() else 1
+                    for f in enc.feature_fields)
+                total = pipeline.streaming_fold(
+                    chunks(), _nb_local_rawbin,
+                    static_args=(st.n_class_cap, st.bins_cap, widths),
+                    mesh=mesh, prefetch_depth=depth, capacity=chunk_rows)
+            else:
+                total = pipeline.streaming_fold(
+                    chunks(), _nb_local,
+                    static_args=(st.n_class_cap, st.bins_cap),
+                    mesh=mesh, prefetch_depth=depth, capacity=chunk_rows)
+        except ChunkedEncodeUnsupported:
+            return None
+        if total is None:
+            return None
+        if quarantine is not None:
+            quarantine.finish(counters)
+        return self._streamed_model_lines(enc, st, total, counters, delim)
 
     def _streamed_model_lines(self, enc: DatasetEncoder,
                               st: _NBStreamState, total, counters: Counters,

@@ -220,12 +220,16 @@ def encode_schema(path: str, col_specs: Sequence[Tuple[int, int, int]],
 def encode_schema_buffer(buf: bytes, col_specs, n_file_cols: int,
                          n_feat: int, has_class: bool, id_ordinal: int = -1,
                          delim: str = ",", max_uniq: int = 1 << 16,
-                         n_rows_hint: Optional[int] = None):
+                         n_rows_hint: Optional[int] = None,
+                         n_threads: Optional[int] = None):
     """``encode_schema`` over an in-memory buffer — the chunked-ingest
     entry point (the caller splits a file at line boundaries and encodes
     each chunk while earlier chunks are counting on device).
     ``n_rows_hint`` (an exact line count) skips the csv_scan sizing pass;
-    it is only honored when no bytes (id) column needs width metering."""
+    it is only honored when no bytes (id) column needs width metering.
+    ``n_threads`` forces the inner pthread fan-out (the parallel-parse
+    worker pool passes 1 so chunk-level and byte-range-level parallelism
+    don't multiply); None keeps the size-based heuristic below."""
     lib = get_lib()
     if lib is None or len(delim) != 1:
         return None
@@ -273,12 +277,16 @@ def encode_schema_buffer(buf: bytes, col_specs, n_file_cols: int,
     # single-threaded and the thread count scales down with the
     # categorical column count to cap transient scratch at ~128 MB
     # (a many-categorical schema would otherwise allocate hundreds of MB)
-    n_threads = 1
-    if len(buf) >= MT_MIN_BYTES and max_uniq <= (1 << 16):
-        n_threads = MT_THREADS or min(8, os.cpu_count() or 1)
-        scratch_budget = 128 << 20
-        per_thread = max(len(cat_ordinals), 1) * max_uniq * 16
-        n_threads = max(min(n_threads, scratch_budget // per_thread), 1)
+    forced_threads = n_threads
+    if n_threads is None:
+        n_threads = 1
+        if len(buf) >= MT_MIN_BYTES and max_uniq <= (1 << 16):
+            n_threads = MT_THREADS or min(8, os.cpu_count() or 1)
+            scratch_budget = 128 << 20
+            per_thread = max(len(cat_ordinals), 1) * max_uniq * 16
+            n_threads = max(min(n_threads, scratch_budget // per_thread), 1)
+    else:
+        n_threads = max(int(n_threads), 1)
     rc = lib.csv_encode_mt(
         buf, len(buf), bdelim, n_file_cols,
         (ctypes.c_int * n_file_cols)(*col_type),
@@ -293,7 +301,8 @@ def encode_schema_buffer(buf: bytes, col_specs, n_file_cols: int,
     if rc == -3 and max_uniq < (1 << 22):   # vocab overflow: one retry, 64x
         return encode_schema_buffer(buf, col_specs, n_file_cols, n_feat,
                                     has_class, id_ordinal, delim,
-                                    max_uniq=1 << 22)
+                                    max_uniq=1 << 22,
+                                    n_threads=forced_threads)
     if rc != 0:
         return None
 

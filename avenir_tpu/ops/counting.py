@@ -190,6 +190,53 @@ def feature_class_counts(x: jnp.ndarray, y: jnp.ndarray,
                        mask=m, dtype=dtype)
 
 
+def bin_raw(xraw: jnp.ndarray, widths: Sequence[int]) -> jnp.ndarray:
+    """Trunc-toward-zero bucket binning of a raw integer matrix on device:
+    column f divides by ``widths[f]`` (1 = passthrough).  Java integer
+    division semantics, bit-exact with the host binning in core.binning and
+    native/csv_ingest.c — negative raws round toward zero, not -inf."""
+    xraw = jnp.asarray(xraw)
+    xraw = xraw.astype(jnp.int32) if xraw.dtype.itemsize < 4 else xraw
+    w = jnp.asarray(np.asarray(widths, dtype=np.int32))[None, :]
+    q = jnp.abs(xraw) // w
+    return jnp.where(xraw >= 0, q, -q)
+
+
+def feature_class_counts_rawbin(xraw: jnp.ndarray, y: jnp.ndarray,
+                                n_class: int, max_bins: int,
+                                widths: Sequence[int],
+                                mask: Optional[jnp.ndarray] = None,
+                                dtype=jnp.int32,
+                                force_mxu: Optional[bool] = None) -> jnp.ndarray:
+    """``feature_class_counts`` over PRE-BIN raw integers: the warm ingest
+    cache's count path.  ``xraw`` holds raw bucket values / categorical
+    codes / -1 for continuous columns; ``widths`` the static per-feature
+    bucket divisor (1 = passthrough).
+
+    On TPU, when the wide-table kernel applies, binning fuses INTO the
+    Pallas VMEM pass (ops.pallas_count rawbin variant) so the binned
+    matrix never materializes in HBM.  Everywhere else the division runs
+    on device immediately before the standard count (XLA fuses the
+    elementwise div into the one-hot/scatter consumer) — either way the
+    standalone host bin pass is gone.  Output is bit-identical to
+    ``feature_class_counts(bin_raw(xraw, widths), ...)``.
+    """
+    xraw = jnp.asarray(xraw)
+    n, F = xraw.shape
+    widths = tuple(int(w) for w in widths)
+    if len(widths) != F:
+        raise ValueError(f"widths has {len(widths)} entries for {F} features")
+    if (force_mxu is None and jax.default_backend() == "tpu"
+            and not count_on_mxu(n, None, onehot_elems=n * F * max_bins)):
+        from .pallas_count import (wide_count_applicable,
+                                   wide_feature_class_counts_rawbin)
+        if wide_count_applicable(n_class, F, max_bins):
+            return wide_feature_class_counts_rawbin(
+                xraw, y, n_class, max_bins, widths, mask=mask).astype(dtype)
+    return feature_class_counts(bin_raw(xraw, widths), y, n_class, max_bins,
+                                mask=mask, dtype=dtype, force_mxu=force_mxu)
+
+
 # Compiled-function cache so iterative callers (tree levels, Apriori passes,
 # bandit rounds) hit XLA's jit cache instead of retracing every call: jit keys
 # on the function object, and a fresh closure per call would defeat it.

@@ -50,7 +50,16 @@ def wide_count_applicable(n_class: int, n_features: int, max_bins: int,
             and n_features * n_class * max_bins <= _MAX_OUT_ELEMS)
 
 
-def _make_kernel(F: int, C: int, B: int):
+def _make_kernel(F: int, C: int, B: int, widths=None):
+    """The [R-block] histogram kernel body.  With ``widths`` (a static
+    per-feature int tuple) the kernel FUSES binning into the same VMEM
+    pass: feature f's column is trunc-toward-zero divided by
+    ``widths[f]`` before the one-hot compare (Java bucket semantics,
+    identical to the host binning in core.binning / csv_ingest.c), so
+    the warm cache path feeds raw integers straight from mmap and the
+    encode->bin->count HBM round-trip disappears.  Width 1 is a
+    passthrough (categorical codes, already-binned columns, and the
+    continuous -1 self-mask)."""
     def kernel(x_ref, ym_ref, out_ref):
         @pl.when(pl.program_id(0) == 0)
         def _init():
@@ -63,7 +72,14 @@ def _make_kernel(F: int, C: int, B: int):
         w = (ym == cls).astype(jnp.bfloat16)               # [R, C]
         per_f = []
         for f in range(F):
-            cmp = (x[:, f:f + 1] == bins).astype(jnp.bfloat16)   # [R, B]
+            xf = x[:, f:f + 1]                             # [R, 1]
+            if widths is not None and widths[f] != 1:
+                # trunc toward zero via floor-div on non-negative
+                # operands only (floor == trunc there) — bit-exact with
+                # the host's Java-semantics binning for any sign
+                bw = widths[f]
+                xf = jnp.where(xf >= 0, xf // bw, -((-xf) // bw))
+            cmp = (xf == bins).astype(jnp.bfloat16)        # [R, B]
             per_f.append(jax.lax.dot_general(
                 w, cmp, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32))       # [C, B]
@@ -72,14 +88,9 @@ def _make_kernel(F: int, C: int, B: int):
     return kernel
 
 
-def wide_feature_class_counts(x, y, n_class: int, max_bins: int, mask=None,
-                              interpret: bool | None = None):
-    """``C[class, feature, bin] += 1`` via the VMEM histogram kernel.
-
-    Same contract as ``ops.counting.feature_class_counts``: ``x`` int [n, F]
-    with -1 (or any out-of-range value) self-masking, ``mask`` dropping whole
-    rows.  ``interpret`` forces the Pallas interpreter (CPU tests).
-    """
+def _wide_counts(x, y, n_class: int, max_bins: int, widths, mask,
+                 interpret: bool | None):
+    """Shared driver for the pre-binned and fused (rawbin) kernels."""
     x = jnp.asarray(x)
     y = jnp.asarray(y)
     n, F = x.shape
@@ -106,7 +117,7 @@ def wide_feature_class_counts(x, y, n_class: int, max_bins: int, mask=None,
     from .pallas_topk import _x64_disabled
     with _x64_disabled():
         out = pl.pallas_call(
-            _make_kernel(F, C, B),
+            _make_kernel(F, C, B, widths),
             grid=((n + pad) // _ROW_BLOCK,),
             in_specs=[pl.BlockSpec((_ROW_BLOCK, F), lambda i: (i, 0),
                                    memory_space=pltpu.VMEM),
@@ -118,3 +129,29 @@ def wide_feature_class_counts(x, y, n_class: int, max_bins: int, mask=None,
             interpret=interpret,
         )(x, ym)
     return out.reshape(F, C, B).transpose(1, 0, 2)
+
+
+def wide_feature_class_counts(x, y, n_class: int, max_bins: int, mask=None,
+                              interpret: bool | None = None):
+    """``C[class, feature, bin] += 1`` via the VMEM histogram kernel.
+
+    Same contract as ``ops.counting.feature_class_counts``: ``x`` int [n, F]
+    with -1 (or any out-of-range value) self-masking, ``mask`` dropping whole
+    rows.  ``interpret`` forces the Pallas interpreter (CPU tests).
+    """
+    return _wide_counts(x, y, n_class, max_bins, None, mask, interpret)
+
+
+def wide_feature_class_counts_rawbin(xraw, y, n_class: int, max_bins: int,
+                                     widths, mask=None,
+                                     interpret: bool | None = None):
+    """The fused bin+count kernel: ``xraw`` carries PRE-BIN integers
+    (raw bucket values, categorical codes, -1 for continuous) and
+    ``widths`` the static per-feature bucket divisor (1 = passthrough);
+    binning happens inside the same VMEM pass as the count contraction.
+    Output is bit-identical to host-binning ``xraw`` then calling
+    ``wide_feature_class_counts``."""
+    widths = tuple(int(w) for w in widths)
+    if any(w < 1 for w in widths):
+        raise ValueError(f"bucket widths must be >= 1: {widths}")
+    return _wide_counts(xraw, y, n_class, max_bins, widths, mask, interpret)

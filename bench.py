@@ -735,6 +735,86 @@ def bench_ingest_e2e():
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_ingest_cache():
+    """Warm ingest-cache replay and the parallel-parse sweep over the
+    SAME cold file->model NB workload as bench_ingest_e2e: one cold run
+    with `ingest.cache.enable` tees the scan into the binned binary
+    artifact, warm reps mmap it back (fused bin+count fold on the raw
+    codes — no re-parse, no separate binning pass); the parse-thread
+    sweep measures host-parse scaling of the cold path. Every variant
+    is byte-parity-gated against the serial cold model file."""
+    import shutil
+    import tempfile
+
+    from avenir_tpu.core import JobConfig
+    from avenir_tpu.datagen import gen_telecom_churn
+    from avenir_tpu.models.bayesian import BayesianDistribution
+    from avenir_tpu.parallel.mesh import make_mesh
+
+    tmp = tempfile.mkdtemp(prefix="ingest_cache_")
+    try:
+        n_rows = 2_000_000
+        base = gen_telecom_churn(50_000, seed=3)
+        reps_factor = n_rows // len(base)
+        n_rows = reps_factor * len(base)
+        in_dir = os.path.join(tmp, "in")
+        os.makedirs(in_dir)
+        block = "\n".join(",".join(r) for r in base) + "\n"
+        with open(os.path.join(in_dir, "part-00000"), "w") as fh:
+            for _ in range(reps_factor):
+                fh.write(block)
+        schema_path = os.path.join(tmp, "schema.json")
+        with open(schema_path, "w") as fh:
+            fh.write(json.dumps(_CHURN_SCHEMA))
+        n_chips = make_mesh().devices.size
+        chunk_rows = 1 << 17
+        cache_dir = os.path.join(tmp, "cache")
+
+        def run_once(tag, **props):
+            job = BayesianDistribution(JobConfig(dict({
+                "feature.schema.file.path": schema_path,
+                "pipeline.chunk.rows": str(chunk_rows)}, **props)))
+            out = os.path.join(tmp, f"out_{tag}")
+            job.run(in_dir, out)
+            with open(os.path.join(out, "part-r-00000"), "rb") as fh:
+                return fh.read()
+
+        want = run_once("plain")                     # serial cold reference
+        cached = {"ingest.cache.enable": "true",
+                  "ingest.cache.dir": cache_dir}
+        t0 = time.perf_counter()
+        assert run_once("cold", **cached) == want    # tee + publish
+        cold_sec = time.perf_counter() - t0
+        assert run_once("warm0", **cached) == want   # warmup + parity
+        warm_samples = samples_of(
+            lambda: run_once("warm", **cached))
+
+        sweep = {}
+        for threads in (1, 2, 4, 8):
+            t0 = time.perf_counter()
+            assert run_once(f"p{threads}", **{
+                "ingest.parse.threads": str(threads)}) == want
+            sweep[threads] = round(
+                n_rows / (time.perf_counter() - t0) / n_chips)
+
+        warm_sec = min(warm_samples)
+        out = {"metric": "nb_ingest_warm_cache_rows_per_sec_per_chip",
+               "value": round(n_rows / warm_sec / n_chips),
+               "unit": f"rows/sec/chip (WARM mmap replay file->model, "
+                       f"{n_rows} rows, chunked {chunk_rows}-row ingest, "
+                       f"fused bin+count fold, byte-parity-gated)",
+               "vs_baseline": None,
+               "warm_speedup_vs_cold": round(cold_sec / warm_sec, 3),
+               "cold_with_tee_rows_per_sec_per_chip": round(
+                   n_rows / cold_sec / n_chips),
+               "parse_threads_rows_per_sec_per_chip": sweep,
+               "parse_threads_best_speedup": round(
+                   max(sweep.values()) / sweep[1], 3)}
+        return finish_metric(out, warm_samples)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 # all-binned churn schema variant for the shared-scan bench: identical
 # columns to _CHURN_SCHEMA, but network gets a bucketWidth (MI requires
 # every numeric feature binned) and plan/churned declare cardinalities
@@ -2652,6 +2732,7 @@ def main():
 
     extra = []
     for nm, fn_b in (("ingest_e2e", bench_ingest_e2e),
+                     ("ingest_cache", bench_ingest_cache),
                      ("shared_scan", bench_shared_scan),
                      ("dag_workflow", bench_dag_workflow),
                      ("apriori", bench_apriori),

@@ -1,0 +1,537 @@
+"""Host-ingest optimizations (README "Ingest cache & parallel parse"):
+the ordered parallel-parse pool, the parse-once binary ingest cache
+(cold tee -> warm mmap replay for NB / mutual information / Markov /
+fused multi-scan), the fused bin+count Pallas kernel, invalidation on
+every fingerprint axis (input bytes, binning params, chunk geometry,
+torn artifacts, injected torn publishes, concurrent writers), and the
+DAG cost model's cached-scan rate — all byte-parity-gated against the
+serial cold paths."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from avenir_tpu import native
+from avenir_tpu.core import (DatasetEncoder, FeatureSchema, JobConfig,
+                             faultinject)
+from avenir_tpu.core import ingestcache, parparse
+from avenir_tpu.core.faultinject import FaultInjector, parse_plan
+from avenir_tpu.core.io import SUCCESS_NAME
+from avenir_tpu.core.metrics import Counters
+
+
+@pytest.fixture
+def have_native():
+    if native.get_lib() is None:
+        pytest.skip("C toolchain unavailable")
+
+
+@pytest.fixture(autouse=True)
+def _clear_injector():
+    yield
+    faultinject.set_injector(None)
+
+
+# ---------------------------------------------------------------------------
+# shared workload (categorical + bucketed int + continuous double)
+# ---------------------------------------------------------------------------
+
+NB_SCHEMA = {"fields": [
+    {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+    {"name": "color", "ordinal": 1, "dataType": "categorical",
+     "feature": True, "cardinality": ["red", "green"]},
+    {"name": "amount", "ordinal": 2, "dataType": "int", "feature": True,
+     "min": 0, "max": 100, "bucketWidth": 7},
+    {"name": "score", "ordinal": 3, "dataType": "double", "feature": True},
+    {"name": "label", "ordinal": 4, "dataType": "categorical",
+     "cardinality": ["N", "Y"]},
+]}
+
+# all-binned subset: MutualInformation requires bucketWidth on numerics
+MI_SCHEMA = {"fields": [
+    {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+    {"name": "color", "ordinal": 1, "dataType": "categorical",
+     "feature": True, "cardinality": ["red", "green"]},
+    {"name": "amount", "ordinal": 2, "dataType": "int", "feature": True,
+     "min": 0, "max": 100, "bucketWidth": 7},
+    {"name": "label", "ordinal": 4, "dataType": "categorical",
+     "cardinality": ["N", "Y"]},
+]}
+
+
+def _rows(n=313, seed=3):
+    rng = np.random.default_rng(seed)
+    colors = ["blue", "red", "grey", "green", "teal"]
+    return [[f"id{i:04d}", colors[rng.integers(len(colors))],
+             str(int(rng.integers(0, 100))), f"{rng.uniform(-5, 5):.4f}",
+             "NYYN"[int(rng.integers(4))]] for i in range(n)]
+
+
+def _write(tmp_path, rows, schema=NB_SCHEMA):
+    sp = tmp_path / "schema.json"
+    sp.write_text(json.dumps(schema))
+    ip = tmp_path / "in"
+    ip.mkdir(exist_ok=True)
+    (ip / "part-00000").write_text(
+        "\n".join(",".join(r) for r in rows) + "\n")
+    return str(sp), str(ip)
+
+
+def _nb_props(sp, tmp_path, **extra):
+    return JobConfig(dict({
+        "feature.schema.file.path": sp,
+        "pipeline.chunk.rows": "101",
+        "ingest.cache.enable": "true",
+        "ingest.cache.dir": str(tmp_path / "cache"),
+    }, **extra))
+
+
+def _nb_train(cfg, ip):
+    from avenir_tpu.models.bayesian import BayesianDistribution
+
+    return BayesianDistribution(cfg)._train_streamed(ip, ",", ",",
+                                                     Counters())
+
+
+def _artifact_dirs(tmp_path):
+    base = tmp_path / "cache"
+    if not base.is_dir():
+        return []
+    return sorted(d for d in os.listdir(base)
+                  if (base / d / SUCCESS_NAME).is_file())
+
+
+# ---------------------------------------------------------------------------
+# ordered parallel-parse pool
+# ---------------------------------------------------------------------------
+
+def test_parse_threads_from_config():
+    assert parparse.parse_threads_from_config(JobConfig({})) == 1
+    assert parparse.parse_threads_from_config(
+        JobConfig({"ingest.parse.threads": "3"})) == 3
+    auto = parparse.parse_threads_from_config(
+        JobConfig({"ingest.parse.threads": "0"}))
+    assert 1 <= auto <= 8
+    with pytest.raises(ValueError):
+        parparse.parse_threads_from_config(
+            JobConfig({"ingest.parse.threads": "-2"}))
+
+
+def test_ordered_pool_emits_in_order_despite_skew():
+    """Later-submitted chunks finishing FIRST must still come out in
+    submission order — the vocab-discovery-order obligation."""
+    def slow_square(i):
+        time.sleep(0.02 if i % 3 == 0 else 0.0)   # stagger completion
+        return i * i
+
+    pool = parparse.OrderedParsePool(slow_square, 4)
+    try:
+        assert list(pool.map(range(23))) == [i * i for i in range(23)]
+    finally:
+        pool.close()
+
+
+def test_ordered_pool_reraises_at_position_and_joins():
+    def boom(i):
+        if i == 7:
+            raise ValueError("chunk 7 is bad")
+        return i
+
+    before = {t.name for t in threading.enumerate()}
+    pool = parparse.OrderedParsePool(boom, 3)
+    got = []
+    with pytest.raises(ValueError, match="chunk 7 is bad"):
+        for v in pool.map(range(20)):
+            got.append(v)
+    assert got == list(range(7))       # everything BEFORE the bad chunk
+    pool.close()
+    pool.close()                       # idempotent
+    after = {t.name for t in threading.enumerate()}
+    assert not {n for n in after - before if n.startswith("parse-pool")}
+
+
+def test_parallel_parse_nb_bit_identical(tmp_path, have_native, mesh8):
+    sp, ip = _write(tmp_path, _rows())
+    want = _nb_train(JobConfig({"feature.schema.file.path": sp,
+                                "pipeline.chunk.rows": "101"}), ip)
+    for threads in ("2", "0"):
+        got = _nb_train(JobConfig({"feature.schema.file.path": sp,
+                                   "pipeline.chunk.rows": "101",
+                                   "ingest.parse.threads": threads}), ip)
+        assert got == want, threads
+
+
+# ---------------------------------------------------------------------------
+# fused bin+count kernel
+# ---------------------------------------------------------------------------
+
+def test_bin_raw_trunc_division_matches_host():
+    from avenir_tpu.ops.counting import bin_raw
+
+    rng = np.random.default_rng(0)
+    xraw = rng.integers(-500, 500, (257, 4)).astype(np.int32)
+    widths = (1, 7, 10, 100)
+    want = np.empty_like(xraw)
+    for j, w in enumerate(widths):
+        want[:, j] = np.trunc(xraw[:, j] / w).astype(np.int32)
+    np.testing.assert_array_equal(np.asarray(bin_raw(xraw, widths)), want)
+
+
+def test_fused_rawbin_kernel_parity_interpret(mesh8):
+    """The Pallas kernel binning inside the VMEM count pass equals
+    bin-then-count, including negative raw values and masked rows."""
+    from avenir_tpu.ops.counting import bin_raw, feature_class_counts
+    from avenir_tpu.ops.pallas_count import wide_feature_class_counts_rawbin
+
+    rng = np.random.default_rng(1)
+    n, F, C = 1000, 6, 3
+    widths = (1, 10, 1, 7, 100, 1)
+    xraw = rng.integers(-120, 120, (n, F)).astype(np.int32)
+    xraw[:, 0] = rng.integers(0, 12, n)        # width-1 passthrough
+    xraw[:, 2] = -1                            # continuous self-mask
+    y = rng.integers(0, C, n).astype(np.int32)
+    mask = (rng.random(n) < 0.9)
+    max_bins = int(np.asarray(bin_raw(xraw, widths)).max()) + 1
+    want = np.asarray(feature_class_counts(
+        bin_raw(xraw, widths), y, C, max_bins, mask=mask))
+    got = np.asarray(wide_feature_class_counts_rawbin(
+        xraw, y, C, max_bins, widths, mask=mask, interpret=True))
+    np.testing.assert_array_equal(got, want)
+    with pytest.raises(ValueError):
+        wide_feature_class_counts_rawbin(xraw, y, C, max_bins,
+                                         (0,) * F, interpret=True)
+
+
+def test_feature_class_counts_rawbin_dispatch(mesh8):
+    """The CPU dispatch path (bin_raw + XLA count) and widths-length
+    validation."""
+    from avenir_tpu.ops.counting import (bin_raw, feature_class_counts,
+                                         feature_class_counts_rawbin)
+
+    rng = np.random.default_rng(2)
+    xraw = rng.integers(0, 50, (128, 3)).astype(np.int32)
+    y = rng.integers(0, 2, 128).astype(np.int32)
+    widths = (7, 1, 5)
+    want = np.asarray(feature_class_counts(bin_raw(xraw, widths), y, 2, 8))
+    got = np.asarray(feature_class_counts_rawbin(xraw, y, 2, 8, widths))
+    np.testing.assert_array_equal(got, want)
+    with pytest.raises(ValueError):
+        feature_class_counts_rawbin(xraw, y, 2, 8, (7, 1))
+
+
+# ---------------------------------------------------------------------------
+# NB cold -> warm parity, fused toggle, vocab order
+# ---------------------------------------------------------------------------
+
+def test_nb_cold_warm_fused_byte_parity(tmp_path, have_native, mesh8):
+    from avenir_tpu.core import obs
+
+    rows = _rows()
+    sp, ip = _write(tmp_path, rows)
+    want = _nb_train(JobConfig({"feature.schema.file.path": sp,
+                                "pipeline.chunk.rows": "101"}), ip)
+    # cold scan publishes the artifact
+    cold = _nb_train(_nb_props(sp, tmp_path), ip)
+    assert cold == want
+    dirs = _artifact_dirs(tmp_path)
+    assert len(dirs) == 1 and dirs[0].startswith("enc-")
+    meta = json.loads((tmp_path / "cache" / dirs[0] / "meta.json")
+                      .read_text())
+    assert meta["raw_ok"] is True          # fused kernel eligible
+    assert meta["n_rows"] == len(rows)
+    assert sum(meta["chunk_row_counts"]) == len(rows)
+    # vocab sidecar preserves the cold scan's first-seen order exactly
+    serial = DatasetEncoder(FeatureSchema.from_json(json.dumps(NB_SCHEMA)))
+    serial.encode_path(ip)
+    assert meta["vocabs"]["1"] == list(serial.vocabs[1].values)
+    assert meta["class_vocab"] == list(serial.class_vocab.values)
+
+    # warm replay: fused and unfused, byte-identical; hit gauge recorded
+    tr = obs.configure(enabled=True)
+    tr.clear()
+    try:
+        warm = _nb_train(_nb_props(sp, tmp_path), ip)
+        assert any(getattr(r, "name", "") == "ingest.cache.hit"
+                   for r in tr.records())
+    finally:
+        obs.configure(enabled=False)
+        tr.clear()
+    assert warm == want
+    unfused = _nb_train(_nb_props(sp, tmp_path,
+                                  **{"ingest.cache.fused": "false"}), ip)
+    assert unfused == want
+
+    # PROOF the warm run reads the artifact, not the CSV: rewrite the
+    # input with different bytes but identical size+mtime (the stat
+    # fingerprint still matches) — the warm model must equal the OLD one
+    part = os.path.join(ip, "part-00000")
+    st = os.stat(part)
+    flipped = [list(r) for r in rows]
+    for r in flipped:
+        r[4] = {"N": "Y", "Y": "N"}[r[4]]
+    data = "\n".join(",".join(r) for r in flipped) + "\n"
+    assert len(data) == st.st_size
+    with open(part, "w") as fh:
+        fh.write(data)
+    os.utime(part, ns=(st.st_atime_ns, st.st_mtime_ns))
+    assert _nb_train(_nb_props(sp, tmp_path), ip) == want
+
+
+# ---------------------------------------------------------------------------
+# invalidation: every fingerprint axis is load-bearing
+# ---------------------------------------------------------------------------
+
+def test_invalidation_input_schema_chunks_torn(tmp_path, have_native,
+                                               mesh8):
+    rows = _rows(211, seed=5)
+    sp, ip = _write(tmp_path, rows)
+    cfg = _nb_props(sp, tmp_path)
+    base = _nb_train(cfg, ip)
+    (d,) = _artifact_dirs(tmp_path)
+    adir = tmp_path / "cache" / d
+
+    # (a) mutated input bytes (size changes) -> miss, rebuild, new model
+    extra = _rows(40, seed=99)
+    part = os.path.join(ip, "part-00000")
+    with open(part, "a") as fh:
+        fh.write("\n".join(",".join(r) for r in extra) + "\n")
+    grown = _nb_train(cfg, ip)
+    assert grown != base
+    meta = json.loads((adir / "meta.json").read_text())
+    assert meta["n_rows"] == len(rows) + len(extra)   # artifact rebuilt
+    assert grown == _nb_train(cfg, ip)                # and warm again
+
+    # (b) changed binning params -> different encoder fingerprint ->
+    # a SEPARATE artifact directory (the old one is untouched)
+    schema2 = json.loads(json.dumps(NB_SCHEMA))
+    schema2["fields"][2]["bucketWidth"] = 13
+    sp2 = tmp_path / "schema13.json"
+    sp2.write_text(json.dumps(schema2))
+    _nb_train(_nb_props(str(sp2), tmp_path), ip)
+    assert len(_artifact_dirs(tmp_path)) == 2
+
+    # (c) different chunk geometry -> miss (boundaries must be identical
+    # for bit-exact moment accumulation); the run still succeeds
+    got = _nb_train(_nb_props(sp, tmp_path,
+                              **{"pipeline.chunk.rows": "64"}), ip)
+    assert got == grown
+
+    # (d) torn artifact: bytes under the final name disagree with the
+    # manifest -> validation miss, cold rebuild heals it
+    xbin = adir / "x.bin"
+    blob = xbin.read_bytes()
+    xbin.write_bytes(blob[:len(blob) // 2])
+    cfg3 = _nb_props(sp, tmp_path)                 # chunk.rows back to 101
+    assert ingestcache.IngestCache.from_config(
+        cfg3, ip, DatasetEncoder(
+            FeatureSchema.from_json(json.dumps(NB_SCHEMA))),
+        ",").load(101) is None
+    assert _nb_train(cfg3, ip) == grown            # rebuilt
+    assert xbin.stat().st_size == len(blob)
+
+
+def test_torn_publish_is_best_effort_and_heals(tmp_path, have_native):
+    """An injected ``torn_write`` during artifact publish must not fail
+    the producing run: finish() returns False, nothing is marked
+    ``_SUCCESS``, and the next cold scan rebuilds cleanly."""
+    sp, ip = _write(tmp_path, _rows(97, seed=7))
+    enc = DatasetEncoder(FeatureSchema.from_json(json.dumps(NB_SCHEMA)))
+    cache = ingestcache.IngestCache(str(tmp_path / "cache"), ip, enc, ",")
+    b = cache.builder(50)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 5, (50, 3)).astype(np.int32)   # 3 feature fields
+    vals = rng.random((50, 3))
+    y = rng.integers(0, 2, 50).astype(np.int32)
+    b.add(x, vals, y, 50)
+    faultinject.set_injector(FaultInjector(parse_plan("torn_write@0")))
+    assert b.finish() is False
+    faultinject.set_injector(None)
+    assert not os.path.isfile(os.path.join(cache.dir, SUCCESS_NAME))
+    assert cache.load(50) is None          # torn leftovers never serve
+    b2 = cache.builder(50)
+    b2.add(x, vals, y, 50)
+    assert b2.finish() is True
+    scan = cache.load(50)
+    assert scan is not None
+    np.testing.assert_array_equal(np.asarray(scan.x), x)
+    np.testing.assert_array_equal(np.asarray(scan.y), y)
+
+
+def test_concurrent_writers_one_valid_artifact(tmp_path, have_native):
+    """Two cold scans of the same input racing to publish (the realistic
+    multi-process race: both produce byte-identical artifacts) must
+    leave ONE valid artifact — atomic part replace + last-writer meta."""
+    sp, ip = _write(tmp_path, _rows(120, seed=13))
+
+    def enc():
+        return DatasetEncoder(FeatureSchema.from_json(json.dumps(NB_SCHEMA)))
+
+    rng = np.random.default_rng(4)
+    x = rng.integers(0, 6, (120, 3)).astype(np.int32)
+    vals = rng.integers(0, 90, (120, 3)).astype(np.float64)
+    y = rng.integers(0, 2, 120).astype(np.int32)
+    start = threading.Barrier(2)
+    oks = []
+
+    def writer():
+        cache = ingestcache.IngestCache(str(tmp_path / "cache"), ip,
+                                        enc(), ",")
+        b = cache.builder(60)
+        start.wait()
+        for s in (0, 60):
+            b.add(x[s:s + 60], vals[s:s + 60], y[s:s + 60], 60)
+        oks.append(b.finish())
+
+    ts = [threading.Thread(target=writer) for _ in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert any(oks)
+    cache = ingestcache.IngestCache(str(tmp_path / "cache"), ip, enc(), ",")
+    # exactly the published artifact on disk — no staging litter
+    assert os.listdir(tmp_path / "cache") == [os.path.basename(cache.dir)]
+    scan = cache.load(60)
+    assert scan is not None
+    np.testing.assert_array_equal(np.asarray(scan.x), x)
+    np.testing.assert_array_equal(np.asarray(scan.values), vals)
+    np.testing.assert_array_equal(np.asarray(scan.y), y)
+
+
+# ---------------------------------------------------------------------------
+# the other consumers: MI, Markov pairs, fused multi-scan
+# ---------------------------------------------------------------------------
+
+def _slurp(out):
+    return "".join(
+        open(os.path.join(out, f)).read()
+        for f in sorted(os.listdir(out)) if not f.startswith("_"))
+
+
+def test_mutual_info_cold_warm_byte_parity(tmp_path, have_native, mesh8):
+    from avenir_tpu.models.mutual_info import MutualInformation
+
+    sp, ip = _write(tmp_path, _rows(259, seed=21), schema=MI_SCHEMA)
+    base = {"feature.schema.file.path": sp, "pipeline.chunk.rows": "64",
+            "ingest.cache.enable": "true",
+            "ingest.cache.dir": str(tmp_path / "cache")}
+    MutualInformation(JobConfig({"feature.schema.file.path": sp})).run(
+        ip, str(tmp_path / "mono"), mesh=mesh8)
+    want = _slurp(str(tmp_path / "mono"))
+    MutualInformation(JobConfig(dict(base))).run(
+        ip, str(tmp_path / "cold"), mesh=mesh8)
+    assert _slurp(str(tmp_path / "cold")) == want
+    assert len(_artifact_dirs(tmp_path)) == 1
+    MutualInformation(JobConfig(dict(base))).run(
+        ip, str(tmp_path / "warm"), mesh=mesh8)
+    assert _slurp(str(tmp_path / "warm")) == want
+
+
+def test_markov_pair_cache_cold_warm_byte_parity(tmp_path, mesh8):
+    from avenir_tpu.models.markov import (MARKETING_STATES,
+                                          MarkovStateTransitionModel)
+
+    rng = np.random.default_rng(0)
+    lines = []
+    for i in range(157):
+        seq = [MARKETING_STATES[j]
+               for j in rng.integers(0, 9, rng.integers(2, 9))]
+        lines.append(",".join([f"c{i}"] + seq))
+    (tmp_path / "in.txt").write_text("\n".join(lines) + "\n")
+    base = {"mst.model.states": ",".join(MARKETING_STATES),
+            "skip.field.count": "1", "pipeline.chunk.rows": "13",
+            "ingest.cache.enable": "true",
+            "ingest.cache.dir": str(tmp_path / "cache")}
+    MarkovStateTransitionModel(JobConfig(dict(
+        base, **{"ingest.cache.enable": "false"}))).run(
+        str(tmp_path / "in.txt"), str(tmp_path / "mono"))
+    want = _slurp(str(tmp_path / "mono"))
+    MarkovStateTransitionModel(JobConfig(dict(base))).run(
+        str(tmp_path / "in.txt"), str(tmp_path / "cold"))
+    assert _slurp(str(tmp_path / "cold")) == want
+    dirs = _artifact_dirs(tmp_path)
+    assert len(dirs) == 1 and dirs[0].startswith("mkv-")
+    MarkovStateTransitionModel(JobConfig(dict(base))).run(
+        str(tmp_path / "in.txt"), str(tmp_path / "warm"))
+    assert _slurp(str(tmp_path / "warm")) == want
+
+
+def test_multiscan_tee_and_warm_byte_parity(tmp_path, have_native, mesh8):
+    """The fused shared scan both BUILDS the artifact (cold tee, one per
+    encoder) and SERVES from it (warm), byte-identical outputs; the
+    artifact it builds also warms a standalone run."""
+    from avenir_tpu.cli import _job_resolver
+    from avenir_tpu.core import multiscan
+    from avenir_tpu.models.bayesian import BayesianDistribution
+
+    rows = _rows(239, seed=17)
+    sp, ip = _write(tmp_path, rows)
+    sp_mi = tmp_path / "mi_schema.json"
+    sp_mi.write_text(json.dumps(MI_SCHEMA))
+    jobs = {"nb": ("BayesianDistribution",
+                   {"feature.schema.file.path": sp}),
+            "mi": ("MutualInformation",
+                   {"feature.schema.file.path": str(sp_mi)})}
+
+    def run(tag, cache):
+        props = {"pipeline.chunk.rows": "64",
+                 "multi.jobs": ",".join(jobs)}
+        if cache:
+            props.update({"ingest.cache.enable": "true",
+                          "ingest.cache.dir": str(tmp_path / "cache")})
+        for jid, (cls, jprops) in jobs.items():
+            props[f"multi.job.{jid}.class"] = cls
+            for k, v in jprops.items():
+                props[f"multi.job.{jid}.{k}"] = v
+        out = tmp_path / tag
+        multiscan.run_multi(JobConfig(props), ip, str(out),
+                            _job_resolver, mesh=mesh8)
+        return {jid: _slurp(str(out / jid)) for jid in jobs}
+
+    want = run("plain", cache=False)
+    cold = run("cold", cache=True)
+    assert cold == want
+    assert len(_artifact_dirs(tmp_path)) == 2     # one per encoder
+    warm = run("warm", cache=True)
+    assert warm == want
+    # cross-consumer: the multiscan-built NB artifact warms standalone NB
+    got = _nb_train(_nb_props(sp, tmp_path,
+                              **{"pipeline.chunk.rows": "64"}), ip)
+    assert got == BayesianDistribution(JobConfig(
+        {"feature.schema.file.path": sp,
+         "pipeline.chunk.rows": "64"}))._train_streamed(
+        ip, ",", ",", Counters())
+
+
+# ---------------------------------------------------------------------------
+# DAG cost model: cached-scan rate
+# ---------------------------------------------------------------------------
+
+def test_fusion_decision_prices_cached_scans(tmp_path):
+    from avenir_tpu.core.dag import Stage, fusion_decision
+
+    ip = tmp_path / "in.csv"
+    ip.write_text("a,1\n")
+    stages = [Stage(f"s{i}", "BayesianDistribution", {}, str(ip),
+                    f"/t/s{i}", True, 0.05, []) for i in range(3)]
+    cfg = JobConfig({"ingest.cache.enable": "true",
+                     "ingest.cache.dir": str(tmp_path / "cache")})
+    # no artifact yet: parse-rate pricing, scan-dominated -> fuse
+    fuse, d = fusion_decision(stages, 50_000_000, cfg, in_path=str(ip))
+    assert fuse and d["scan_cached"] is False
+    # publish a marker artifact -> cached (mmap) pricing, 10x cheaper
+    adir = tmp_path / "cache" / "enc-deadbeef"
+    adir.mkdir(parents=True)
+    (adir / SUCCESS_NAME).write_text("")
+    assert ingestcache.probe_scan_boost(cfg, str(ip))
+    fuse2, d2 = fusion_decision(stages, 50_000_000, cfg, in_path=str(ip))
+    assert d2["scan_cached"] is True
+    assert d2["scan_sec"] < d["scan_sec"]
+    # the 10x-cheaper scan legitimately flips this workload to separate
+    assert not fuse2
+    # disabled cache: the probe never fires
+    assert not ingestcache.probe_scan_boost(JobConfig({}), str(ip))
