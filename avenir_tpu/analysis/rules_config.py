@@ -42,6 +42,12 @@ NAMESPACE_GROUPS: Dict[str, str] = {
     # bare `ingest` — the legacy `ingest.chunk.bytes` /
     # `ingest.error.budget` literals predate the rule and stay out
     "ingest": r"(?:ingest\.parse|ingest\.cache)",
+    # the workload harness (avenir_tpu/workload): scenario/fleet/SLO
+    # keys.  The per-phase `workload.phase.<name>.*` family is derived
+    # at runtime (f-strings over declared phase names, like
+    # `serve.model.<name>.*`) and is deliberately outside governance —
+    # only the scalar workload.* keys are KEY_-bound
+    "workload": r"(?:workload)",
 }
 
 _ACCESSORS = (r"\.(?:get|get_int|get_float|get_boolean|get_list|must|"

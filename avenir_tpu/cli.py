@@ -311,6 +311,8 @@ def main(argv=None) -> int:
               file=sys.stderr)
         print("       python -m avenir_tpu stream -Dconf.path=<stream.properties> [--resume]",
               file=sys.stderr)
+        print("       python -m avenir_tpu workload --scenario <scenario.properties> [--assert]",
+              file=sys.stderr)
         print("       python -m avenir_tpu analyze [--strict] [--json report.json] [--rules a,b] [--list]",
               file=sys.stderr)
         print("                                    [--dynamic] [--seeds N] [--baseline findings.json] [--update-baseline] [--no-cache]",
@@ -344,6 +346,13 @@ def main(argv=None) -> int:
         _init_runtime()
         from .stream.service import stream_main
         return stream_main(rest)
+    if job_name == "workload":
+        # production-shaped workload harness (avenir_tpu/workload):
+        # seeded scenario factory + open-loop fleet + SLO-envelope
+        # verdicts against the real serve/stream frontends
+        _init_runtime()
+        from .workload.runner import workload_main
+        return workload_main(rest)
     # --trace <out.json>: record core.obs spans for the whole job and
     # export them as Chrome/Perfetto trace_event JSON on exit
     rest, trace_path = extract_trace_flag(rest)

@@ -1727,31 +1727,66 @@ def bench_streaming_decisions():
     feeder.start()
 
     def drive(rate, duration):
-        """Offered load (rate=None: open loop); returns
-        (completed/sec, shed, p50_ms, p99_ms)."""
+        """Offered load (rate=None: saturation/capacity leg); returns
+        (completed/sec, shed, p50_ms, p99_ms).
+
+        Paced legs are OPEN-LOOP off the workload harness's arrival
+        generator (avenir_tpu/workload) with intended-start-time
+        accounting: each submission has a schedule-derived intended
+        start, a driver that falls behind fires immediately instead of
+        re-spacing, and latency runs from the INTENDED start — so
+        backlog surfaces in the percentiles instead of silently
+        thinning the offered load (the coordinated-omission fix; the
+        old pacer measured from enqueue time, which understates tail
+        latency under queueing by construction)."""
+        import random as _random
+
+        from avenir_tpu.workload.generators import arrival_offsets
+
         batcher.clear_latency_window()
-        futures, shed, i = [], 0, 0
+        lat, futures, shed = [], [], 0
+
+        def stamp(t_intended):
+            # done-callbacks run on the batcher worker: list.append is
+            # atomic under the GIL, and the percentile read happens
+            # after every future has resolved
+            return lambda _f: lat.append(time.perf_counter() - t_intended)
+
         t0 = time.perf_counter()
-        next_t = t0
-        interval = (1.0 / rate) if rate else 0.0
-        while True:
-            now = time.perf_counter()
-            if now - t0 >= duration:
-                break
-            if rate and now < next_t:
-                time.sleep(min(next_t - now, 0.0005))
-                continue
-            try:
-                futures.append(batcher.submit(lines[i % len(lines)]))
-            except ShedError:
-                shed += 1
-            i += 1
-            next_t += interval
+        if rate:
+            offsets = arrival_offsets("constant", float(rate), duration,
+                                      _random.Random(11))
+            for i, off in enumerate(offsets):
+                intended = t0 + off
+                delay = intended - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                try:
+                    fut = batcher.submit(lines[i % len(lines)])
+                except ShedError:
+                    shed += 1
+                    continue
+                fut.add_done_callback(stamp(intended))
+                futures.append(fut)
+        else:
+            i = 0
+            while time.perf_counter() - t0 < duration:
+                submitted = time.perf_counter()
+                try:
+                    fut = batcher.submit(lines[i % len(lines)])
+                except ShedError:
+                    shed += 1
+                else:
+                    fut.add_done_callback(stamp(submitted))
+                    futures.append(fut)
+                i += 1
         for f in futures:
             f.result(timeout=120)
         elapsed = time.perf_counter() - t0
-        pct = batcher.latency_percentiles_ms()
-        return len(futures) / elapsed, shed, pct["p50"], pct["p99"]
+        lat.sort()
+        p = lambda q: round(lat[int(q * (len(lat) - 1))] * 1000.0, 3) \
+            if lat else 0.0  # noqa: E731
+        return len(futures) / elapsed, shed, p(0.50), p(0.99)
 
     drive(None, 0.3)                        # warm the steady state
     # count only folds concurrent with the MEASURED windows, not warm-up
@@ -1972,40 +2007,34 @@ def bench_serving_pool():
         return srv, srv.start()
 
     def drive(port, n_active, payloads, rows_per_payload, per_conn, depth):
-        """Pipelined closed-population load: each active connection keeps
-        up to ``depth`` request lines in flight until ``per_conn``
-        complete; returns (rows_per_sec, p50_ms, p99_ms).  Requests are
+        """Pipelined closed-population CAPACITY run: each active
+        connection keeps up to ``depth`` request lines in flight until
+        ``per_conn`` complete; returns rows_per_sec.  Requests are
         written in BURSTS with TCP_NODELAY set — one small send per
         request would measure Nagle/delayed-ACK stalls, not the serving
-        stack."""
-        lat = []
-        lat_lock = threading.Lock()
+        stack.  Latency is deliberately NOT sampled here: a closed
+        population self-throttles when the server stalls, so send-time
+        latencies coordinate-omit exactly the tail the SLO cares about
+        (``openloop_probe`` below measures that honestly)."""
 
         def conn_worker(ci):
             with _socket.create_connection(("127.0.0.1", port),
                                            timeout=120) as s:
                 s.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
-                pend = deque()
                 f = s.makefile("rb")
                 sent = recvd = 0
                 base = (ci * 37) % len(payloads)
-                my_lat = []
                 while recvd < per_conn:
                     burst = min(per_conn - sent, depth - (sent - recvd))
                     if burst > 0:
                         s.sendall(b"".join(
                             payloads[(base + sent + j) % len(payloads)]
                             for j in range(burst)))
-                        now = time.perf_counter()
-                        pend.extend([now] * burst)
                         sent += burst
                     line = f.readline()
                     if not line:
                         raise RuntimeError("connection closed mid-run")
-                    my_lat.append(time.perf_counter() - pend.popleft())
                     recvd += 1
-            with lat_lock:
-                lat.extend(my_lat)
 
         threads = [threading.Thread(target=conn_worker, args=(i,))
                    for i in range(n_active)]
@@ -2015,10 +2044,74 @@ def bench_serving_pool():
         for t in threads:
             t.join()
         elapsed = time.perf_counter() - t0
+        return (n_active * per_conn * rows_per_payload) / elapsed
+
+    def openloop_probe(port, payloads, rows_per_payload, req_rate,
+                       duration, n_conns):
+        """Coordinated-omission-free latency measurement for one sweep
+        cell: offered load comes from the workload harness's open-loop
+        arrival generator (avenir_tpu/workload), split round-robin
+        across ``n_conns`` pipelined connections, and every request's
+        latency runs from its INTENDED schedule start — a writer that
+        falls behind fires immediately and the backlog it queued shows
+        up in p99 (the closed-population ``drive`` above measures
+        capacity; its send-time latencies understate tails under
+        queueing by construction, so latency is probed here instead).
+        Returns (p50_ms, p99_ms, completed)."""
+        import random as _random
+
+        from avenir_tpu.workload.generators import arrival_offsets
+
+        offsets = arrival_offsets("constant", max(req_rate, 1.0),
+                                  duration, _random.Random(13))
+        slices = [offsets[k::n_conns] for k in range(n_conns)]
+        lat = []
+        lat_lock = threading.Lock()
+        epoch = time.perf_counter() + 0.05
+
+        def conn_worker(ci):
+            offs = slices[ci]
+            if not offs:
+                return
+            with _socket.create_connection(("127.0.0.1", port),
+                                           timeout=120) as s:
+                s.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+                f = s.makefile("rb")
+                pend = deque()
+                my_lat = []
+
+                def reader():
+                    # FIFO pipelining: response k answers request k, so
+                    # each completion pops its own intended start
+                    for _ in range(len(offs)):
+                        line = f.readline()
+                        if not line:
+                            return
+                        my_lat.append(time.perf_counter() - pend.popleft())
+
+                rt = threading.Thread(target=reader, daemon=True)
+                rt.start()
+                base = (ci * 37) % len(payloads)
+                for j, off in enumerate(offs):
+                    delay = (epoch + off) - time.perf_counter()
+                    if delay > 0:
+                        time.sleep(delay)
+                    pend.append(epoch + off)
+                    s.sendall(payloads[(base + j) % len(payloads)])
+                rt.join(timeout=120)
+            with lat_lock:
+                lat.extend(my_lat)
+
+        threads = [threading.Thread(target=conn_worker, args=(i,))
+                   for i in range(n_conns)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
         lat.sort()
-        p = lambda q: round(lat[int(q * (len(lat) - 1))] * 1000.0, 2)  # noqa: E731
-        return ((n_active * per_conn * rows_per_payload) / elapsed,
-                p(0.50), p(0.99))
+        p = lambda q: round(lat[int(q * (len(lat) - 1))] * 1000.0, 2) \
+            if lat else 0.0  # noqa: E731
+        return p(0.50), p(0.99), len(lat)
 
     modes = {
         # latency-shaped: one row per JSON line, deeper pipeline
@@ -2042,8 +2135,15 @@ def bench_serving_pool():
                 "models"]["churn"]["counters"]["Serve"].get("Shed", 0)
             for mode, (pl, rpp, per_conn, depth) in modes.items():
                 for n_active in (8, 16, 32):
-                    rate, p50, p99 = drive(port, n_active, pl, rpp,
-                                           per_conn, depth)
+                    rate = drive(port, n_active, pl, rpp,
+                                 per_conn, depth)
+                    # latency is NOT taken from the capacity run: the
+                    # open-loop probe offers 70% of the just-measured
+                    # capacity and charges every request its intended
+                    # start, so these percentiles are CO-free
+                    probe_req_rate = max((rate / rpp) * 0.7, 1.0)
+                    p50, p99, probed = openloop_probe(
+                        port, pl, rpp, probe_req_rate, 0.6, n_active)
                     stats = request("127.0.0.1", port, {"cmd": "stats"},
                                     timeout=120)
                     m = stats["models"]["churn"]
@@ -2057,6 +2157,9 @@ def bench_serving_pool():
                         "active_conns": n_active,
                         "open_conns": open_conns,
                         "achieved_rows_per_sec": round(rate),
+                        "probe_offered_req_per_sec":
+                            round(probe_req_rate),
+                        "probe_completed": probed,
                         "p50_ms": p50, "p99_ms": p99,
                         "p99_within_slo": p99 <= slo_p99_ms,
                         "shed": shed})

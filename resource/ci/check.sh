@@ -3,7 +3,9 @@
 # exclusion-registry hygiene included) + the tier-1 test suite (which
 # carries the lock-sanitizer-enabled chaos soak and hammer fixtures,
 # the split-invariance verifier matrix, and the analyze-strict-clean
-# wrapper).  Exit nonzero on ANY failure.
+# wrapper) + the ~30s strict-envelope workload smoke (the seeded
+# open-loop harness end-to-end against the real serve frontend).
+# Exit nonzero on ANY failure.
 #
 # Usage: resource/ci/check.sh [extra pytest args...]
 set -euo pipefail
@@ -11,14 +13,19 @@ cd "$(dirname "$0")/../.."
 PY=${PYTHON:-python}
 export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
 
-echo "== gate 1/2: analyze --strict (incremental; sidecar .avenir-analyze/) =="
+echo "== gate 1/3: analyze --strict (incremental; sidecar .avenir-analyze/) =="
 mkdir -p .avenir-analyze
 $PY -m avenir_tpu analyze --strict --json .avenir-analyze/ci-report.json
 
 echo
-echo "== gate 2/2: tier-1 pytest (lock sanitizer rides the chaos/hammer fixtures) =="
+echo "== gate 2/3: tier-1 pytest (lock sanitizer rides the chaos/hammer fixtures) =="
 $PY -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@"
+
+echo
+echo "== gate 3/3: workload smoke (strict SLO envelope, --assert) =="
+$PY -m avenir_tpu workload \
+    --scenario resource/workload/workload_smoke.properties --assert
 
 echo
 echo "ci gate: ALL CLEAN"

@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Production-shaped workload runbooks: run one canned scenario (or all
+# of them) through the seeded open-loop harness and assert its declared
+# SLO envelope.  Each run leaves one merged telemetry snapshot, one
+# Perfetto-loadable trace, and one verdict JSON under
+# resource/workload/work/<scenario>/.
+#
+# Usage: resource/workload/run.sh [scenario ...]
+#   resource/workload/run.sh                # all four canned scenarios
+#   resource/workload/run.sh flash_crowd    # just one
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+PY=${PYTHON:-python}
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+
+SCENARIOS=("$@")
+if [ ${#SCENARIOS[@]} -eq 0 ]; then
+    SCENARIOS=(flash_crowd zipf_tenant_storm poison_storm feedback_chaos)
+fi
+
+for s in "${SCENARIOS[@]}"; do
+    echo "== workload: $s =="
+    $PY -m avenir_tpu workload \
+        --scenario "resource/workload/$s.properties" --assert
+    echo
+done
+
+echo "workload runbooks: ALL ENVELOPES HELD"
+echo "verdicts:   resource/workload/work/<scenario>/verdict.json"
+echo "telemetry:  resource/workload/work/<scenario>/telemetry.json"
+echo "traces:     resource/workload/work/<scenario>/trace.json (ui.perfetto.dev)"

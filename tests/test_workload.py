@@ -1,0 +1,316 @@
+"""Workload harness (avenir_tpu/workload): seeded scenario factory,
+open-loop fleet, SLO-envelope verdicts.
+
+The load-bearing guarantees under test:
+
+- **Deterministic replay** — the schedule is a pure function of
+  (manifest, seed): byte-identical at different thread counts (the
+  fleet partitions a finished schedule; thread count is never an input
+  to generation), different under a different seed.
+- **Generator shape** — the flash-crowd step really is a rate step, the
+  Zipf head really carries ~80%+ of traffic, payloads respect the cap,
+  poison rows are scorer-valid POISON-marked rows (a garbage row would
+  be rejected upstream and never reach the PR-9 isolation path).
+- **Verdict semantics** — only declared envelope keys produce checks, a
+  violated ceiling names its phase, a declared p99 over zero samples
+  fails loudly, and the compile-flat gate compares post-warmup counts.
+- **End-to-end** — a real scenario against the real serve frontend
+  passes its envelope; tightening one ceiling flips the same run to
+  exit 1 and fires exactly one ``flight-workload-<scenario>`` dump.
+"""
+
+import glob
+import json
+import os
+import random
+
+import pytest
+
+from avenir_tpu.core import flight
+from avenir_tpu.core.config import JobConfig, parse_properties
+from avenir_tpu.workload import (PhaseStats, Scenario, arrival_offsets,
+                                 build_schedule, classify, evaluate_run,
+                                 hot_share, partition, payload_rows,
+                                 schedule_bytes, zipf_weights)
+from avenir_tpu.workload.generators import POISON_MARKER, poison_row
+from avenir_tpu.workload.runner import compile_count, run_scenario
+
+
+def _cfg(text: str) -> JobConfig:
+    return JobConfig(parse_properties(text))
+
+
+SERVE_MANIFEST = """
+workload.scenario.name=unit
+workload.seed=1234
+workload.threads={threads}
+workload.target=serve
+workload.bootstrap=none
+workload.phases=steady,crowd
+workload.phase.steady.arrival=constant
+workload.phase.steady.rate=50
+workload.phase.steady.duration.sec=2
+workload.phase.crowd.arrival=flash
+workload.phase.crowd.rate=20
+workload.phase.crowd.duration.sec=6
+workload.phase.crowd.surge.factor=10
+workload.phase.crowd.poison.fraction=0.1
+serve.models=m0
+"""
+
+STREAM_MANIFEST = """
+workload.scenario.name=unit-stream
+workload.seed=77
+workload.threads=3
+workload.target=stream
+workload.phases=chaos
+workload.phase.chaos.arrival=poisson
+workload.phase.chaos.rate=80
+workload.phase.chaos.duration.sec=4
+workload.phase.chaos.feedback.fraction=0.5
+workload.phase.chaos.feedback.dup.fraction=0.3
+workload.phase.chaos.feedback.reorder.fraction=0.2
+workload.phase.chaos.feedback.lag.ms.max=250
+stream.tenants=a,b,c
+stream.arms=x,y
+"""
+
+
+# ---------------------------------------------------------------------------
+# deterministic replay
+# ---------------------------------------------------------------------------
+
+def test_schedule_byte_identical_across_thread_counts():
+    """The satellite contract: same manifest + seed at two different
+    fleet sizes serializes to the same bytes — thread count partitions
+    a FINISHED schedule, it never feeds generation."""
+    two = build_schedule(Scenario(_cfg(SERVE_MANIFEST.format(threads=2))))
+    eight = build_schedule(Scenario(_cfg(SERVE_MANIFEST.format(threads=8))))
+    assert schedule_bytes(two) == schedule_bytes(eight)
+    assert len(two) > 100
+
+
+def test_schedule_seed_sensitivity():
+    base = SERVE_MANIFEST.format(threads=4)
+    a = build_schedule(Scenario(_cfg(base)))
+    b = build_schedule(Scenario(_cfg(base)))
+    c = build_schedule(Scenario(_cfg(base.replace(
+        "workload.seed=1234", "workload.seed=1235"))))
+    assert schedule_bytes(a) == schedule_bytes(b)
+    assert schedule_bytes(a) != schedule_bytes(c)
+
+
+def test_partition_covers_and_preserves_order():
+    events = build_schedule(Scenario(_cfg(SERVE_MANIFEST.format(threads=4))))
+    # the fleet partitions one PHASE at a time (offsets are
+    # phase-relative); round-robin slicing keeps each worker's slice
+    # time-ordered within its phase
+    for phase in ("steady", "crowd"):
+        phase_events = [e for e in events if e.phase == phase]
+        slices = partition(phase_events, 4)
+        assert sum(len(s) for s in slices) == len(phase_events)
+        for s in slices:
+            offs = [e.offset_s for e in s]
+            assert offs == sorted(offs)
+
+
+def test_stream_schedule_has_feedback_chaos():
+    events = build_schedule(Scenario(_cfg(STREAM_MANIFEST)))
+    kinds = {}
+    for e in events:
+        kinds[e.kind] = kinds.get(e.kind, 0) + 1
+    assert kinds.get("decide", 0) > 100
+    assert kinds.get("feedback", 0) > 50
+    faults = {e.fault for e in events if e.kind == "feedback"}
+    assert "dup" in faults and "reorder" in faults
+    # a duplicated reward is the SAME event bytes delivered twice
+    fb = [e.rows[0] for e in events if e.kind == "feedback"]
+    assert len(fb) > len(set(fb))
+
+
+# ---------------------------------------------------------------------------
+# generator shape
+# ---------------------------------------------------------------------------
+
+def test_flash_surge_is_a_rate_step():
+    rng = random.Random(9)
+    offs = arrival_offsets("flash", 20.0, 6.0, rng, surge_factor=10.0)
+    surge = [t for t in offs if 2.0 <= t < 4.0]     # middle third
+    outside = len(offs) - len(surge)
+    # ~200/s inside the window vs ~20/s outside
+    assert len(surge) > 350
+    assert outside < 100
+
+
+def test_zipf_head_carries_the_traffic():
+    w = zipf_weights(1000, 1.5)
+    assert abs(sum(w) - 1.0) < 1e-9
+    assert hot_share(w, 20) > 0.80
+
+
+def test_payload_rows_respect_cap():
+    rng = random.Random(3)
+    sizes = [payload_rows(rng, median=2, sigma=0.8, cap=16)
+             for _ in range(2000)]
+    assert min(sizes) >= 1 and max(sizes) <= 16
+    assert any(s > 4 for s in sizes)        # the heavy tail exists
+
+
+def test_poison_rows_are_scorer_valid_marked_rows():
+    rng = random.Random(5)
+    row = poison_row(rng, 42)
+    fields = row.split(",")
+    assert len(fields) == 8
+    assert POISON_MARKER in fields[0]
+    # every non-id field parses like a churn row: the row must survive
+    # admission so the fault-plan-driven isolation path sees it
+    for f in fields[2:7]:
+        int(f)
+
+
+# ---------------------------------------------------------------------------
+# scenario validation + verdicts
+# ---------------------------------------------------------------------------
+
+def test_scenario_rejects_unknown_target_and_missing_rate():
+    with pytest.raises(ValueError):
+        Scenario(_cfg(SERVE_MANIFEST.format(threads=2).replace(
+            "workload.target=serve", "workload.target=warp")))
+    with pytest.raises(KeyError):
+        Scenario(_cfg("""
+workload.scenario.name=x
+workload.target=serve
+workload.phases=p
+workload.phase.p.duration.sec=1
+"""))
+
+
+def _stats(name, lat_ms, outcomes=None):
+    st = PhaseStats(name)
+    st.latencies_ms = list(lat_ms)
+    st.sent = len(lat_ms)
+    for k, v in (outcomes or {}).items():
+        st.outcomes[k] = v
+    return st
+
+
+def test_verdict_pass_fail_names_phase(tmp_path):
+    cfg = _cfg(SERVE_MANIFEST.format(threads=2)
+               + "workload.phase.steady.slo.p99.ms=50\n")
+    scn = Scenario(cfg)
+    per = {"steady": _stats("steady", [5.0] * 99 + [20.0]),
+           "crowd": _stats("crowd", [4.0] * 10)}
+    v = evaluate_run(scn, per)
+    assert v["pass"] and not v["violations"]
+
+    per["steady"] = _stats("steady", [5.0] * 95 + [400.0] * 5)
+    v = evaluate_run(scn, per)
+    assert not v["pass"]
+    assert v["violations"][0]["phase"] == "steady"
+    assert v["violations"][0]["key"] == "slo.p99.ms"
+
+
+def test_verdict_declared_ceiling_over_zero_samples_fails():
+    cfg = _cfg(SERVE_MANIFEST.format(threads=2)
+               + "workload.phase.steady.slo.p99.ms=50\n")
+    v = evaluate_run(Scenario(cfg), {"steady": _stats("steady", []),
+                                     "crowd": _stats("crowd", [1.0])})
+    assert not v["pass"]
+    assert v["violations"][0]["actual"] is None
+
+
+def test_verdict_compile_flat_gate():
+    cfg = _cfg(SERVE_MANIFEST.format(threads=2)
+               + "workload.slo.compile.flat=true\n")
+    per = {"steady": _stats("steady", [1.0]), "crowd": _stats("crowd", [1.0])}
+    flat = evaluate_run(Scenario(cfg), per, 7, 7)
+    moved = evaluate_run(Scenario(cfg), per, 7, 9)
+    unknown = evaluate_run(Scenario(cfg), per, None, None)
+    assert flat["pass"]
+    assert not moved["pass"]
+    assert moved["violations"][0]["phase"] == "__run__"
+    assert not unknown["pass"]      # a gate that could not read is a fail
+
+
+def test_classify_outcomes():
+    assert classify({"output": "x"}) == "ok"
+    assert classify({"error": "q full", "shed": True}) == "shed"
+    assert classify({"error": "bad row", "poison": True}) == "poison"
+    assert classify({"error": "t", "timeout": True}) == "timeout"
+    assert classify({"error": "c", "cold_start": True,
+                     "retry_after_ms": 50}) == "deferred"
+    assert classify({"error": "boom"}) == "error"
+
+
+def test_compile_count_prefers_shared_tier():
+    with_tier = {"models": {"a": {"counters": {"Serve": {
+        "Scorer compilations": 7}}}},
+        "cache": {"compile_tier": {"compiles": 7, "hits": 400}}}
+    # per-model counters BILL tier compiles: summing both double-counts
+    assert compile_count(with_tier) == 7
+    no_tier = {"models": {
+        "a": {"counters": {"Serve": {"Scorer compilations": 3}}},
+        "b": {"counters": {"Serve": {"Scorer compilations": 4}}}}}
+    assert compile_count(no_tier) == 7
+
+
+# ---------------------------------------------------------------------------
+# end to end: real frontend, real envelope, real flight dump
+# ---------------------------------------------------------------------------
+
+E2E_MANIFEST = """
+workload.scenario.name=e2e
+workload.seed=31
+workload.threads=2
+workload.target=serve
+workload.bootstrap=churn_nb
+workload.phases=steady
+workload.phase.steady.arrival=constant
+workload.phase.steady.rate=30
+workload.phase.steady.duration.sec=1.5
+workload.phase.steady.slo.p99.ms=2000
+workload.phase.steady.slo.error.max.fraction=0.0
+workload.warmup.requests=8
+serve.warmup=true
+serve.port=0
+"""
+
+
+def test_e2e_pass_then_tightened_envelope_dumps_once(tmp_path,
+                                                     lock_sanitizer):
+    """One in-process scenario run passes its envelope and emits the
+    run artifacts; the SAME manifest with one tightened ceiling exits
+    nonzero and fires exactly one flight-workload-<scenario> dump with
+    the violating phase aboard (the --assert black-box contract)."""
+    out = str(tmp_path / "out")
+    recorder = flight.get_recorder()
+    prev_dir = recorder.dump_dir
+    base = E2E_MANIFEST + f"workload.out.dir={out}\n"
+    try:
+        cfg = _cfg(base + f"flight.dump.dir={out}\n")
+        flight.configure_from_config(cfg)
+        assert run_scenario(cfg, do_assert=True) == 0
+        verdict = json.load(open(os.path.join(out, "verdict.json")))
+        assert verdict["pass"] and verdict["scenario"] == "e2e"
+        tele = json.load(open(os.path.join(out, "telemetry.json")))
+        assert any(k.startswith("workload.latency")
+                   for k in tele.get("hists", {}))
+        assert not glob.glob(os.path.join(out, "flight-*"))
+
+        # tightened ceiling: same manifest, same artifact (the
+        # bootstrap's _SUCCESS marker makes the re-run reuse it)
+        tight = _cfg(base + f"flight.dump.dir={out}\n"
+                     + "workload.phase.steady.slo.p99.ms=0.0001\n")
+        assert run_scenario(tight, do_assert=True) == 1
+        verdict = json.load(open(os.path.join(out, "verdict.json")))
+        assert not verdict["pass"]
+        assert verdict["violations"][0]["phase"] == "steady"
+        dumps = glob.glob(os.path.join(out, "flight-workload-e2e-*.jsonl"))
+        assert len(dumps) == 1
+        payload = [json.loads(l) for l in open(dumps[0])]
+        anomaly = [r for r in payload if r.get("kind") == "anomaly"]
+        assert anomaly and anomaly[0]["reason"] == "workload-e2e"
+        assert anomaly[0]["phase"] == "steady"
+        assert anomaly[0]["violations"]
+    finally:
+        recorder.dump_dir = prev_dir
