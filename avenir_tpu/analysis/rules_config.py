@@ -48,6 +48,9 @@ NAMESPACE_GROUPS: Dict[str, str] = {
     # `serve.model.<name>.*`) and is deliberately outside governance —
     # only the scalar workload.* keys are KEY_-bound
     "workload": r"(?:workload)",
+    # the fleet observability plane (avenir_tpu/fleetobs): spool
+    # publisher + cross-process aggregator keys
+    "fleetobs": r"(?:fleetobs)",
 }
 
 _ACCESSORS = (r"\.(?:get|get_int|get_float|get_boolean|get_list|must|"

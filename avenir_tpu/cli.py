@@ -224,10 +224,16 @@ def multi_main(argv) -> int:
         config.set("checkpoint.resume", "true")
     from .core import obs, telemetry
     from .core.multiscan import run_multi
+    from .fleetobs.publisher import publisher_for_job
     obs.configure_from_config(config, force_enable=bool(trace_path))
+    # before configure_resilience: the publisher routes flight.dump.dir
+    # into the spool feed when fleetobs.spool.dir is set
+    publisher = publisher_for_job(config, role="multi")
     configure_resilience(config)
     telemetry.configure_from_config(config)
     exporter = telemetry.exporter_for_job(config, metrics_out)
+    if publisher is not None:
+        exporter = publisher.attach(exporter, config)
     flusher = telemetry.flusher_for_job(config, trace_path)
     try:
         results = run_multi(config, in_path, out_base, _job_resolver,
@@ -272,10 +278,16 @@ def dag_main(argv) -> int:
         config.set("checkpoint.resume", "true")
     from .core import obs, telemetry
     from .core.dag import run_workflow
+    from .fleetobs.publisher import publisher_for_job
     obs.configure_from_config(config, force_enable=bool(trace_path))
+    # before configure_resilience: the publisher routes flight.dump.dir
+    # into the spool feed when fleetobs.spool.dir is set
+    publisher = publisher_for_job(config, role="dag")
     configure_resilience(config)
     telemetry.configure_from_config(config)
     exporter = telemetry.exporter_for_job(config, metrics_out)
+    if publisher is not None:
+        exporter = publisher.attach(exporter, config)
     flusher = telemetry.flusher_for_job(config, trace_path)
     try:
         results = run_workflow(config, in_path, out_base, _job_resolver,
@@ -312,6 +324,10 @@ def main(argv=None) -> int:
         print("       python -m avenir_tpu stream -Dconf.path=<stream.properties> [--resume]",
               file=sys.stderr)
         print("       python -m avenir_tpu workload --scenario <scenario.properties> [--assert]",
+              file=sys.stderr)
+        print("       python -m avenir_tpu fleetobs -Dfleetobs.spool.dir=<dir> [--once]",
+              file=sys.stderr)
+        print("       python -m avenir_tpu fleetobs stitch --spool <dir> [--trace-id X] [--out f.json]",
               file=sys.stderr)
         print("       python -m avenir_tpu analyze [--strict] [--json report.json] [--rules a,b] [--list]",
               file=sys.stderr)
@@ -353,6 +369,13 @@ def main(argv=None) -> int:
         _init_runtime()
         from .workload.runner import workload_main
         return workload_main(rest)
+    if job_name == "fleetobs":
+        # fleet observability plane (avenir_tpu/fleetobs): spool
+        # aggregation, fleet SLO boards, trace stitching, incident
+        # bundles.  Deliberately NO _init_runtime(): the aggregator is
+        # jax-free by design.
+        from .fleetobs.aggregator import fleetobs_main
+        return fleetobs_main(rest)
     # --trace <out.json>: record core.obs spans for the whole job and
     # export them as Chrome/Perfetto trace_event JSON on exit
     rest, trace_path = extract_trace_flag(rest)

@@ -235,6 +235,27 @@ def set_recorder(recorder: FlightRecorder) -> FlightRecorder:
     return recorder
 
 
+def read_dump_header(path: str) -> Optional[dict]:
+    """The ``flight.header`` first line of a dump file as a dict
+    (reason, trace_id, ts, pid, ring_records), or None when the file is
+    missing, truncated, or not a flight dump.  Incident correlators
+    (``fleetobs.incidents``) key on the header's ``trace_id``, NOT the
+    filename tag — the tag doubles as a millisecond timestamp when the
+    trigger carried no trace id, so parsing it back is ambiguous."""
+    try:
+        with open(path, "r") as fh:
+            line = fh.readline()
+    except OSError:
+        return None
+    try:
+        doc = json.loads(line)
+    except ValueError:
+        return None
+    if not isinstance(doc, dict) or doc.get("kind") != "flight.header":
+        return None
+    return doc
+
+
 def sanitize_lock() -> None:
     """Re-wrap the global recorder's lock through the sanitizer.  The
     recorder is a module-import-time singleton, so its lock predates

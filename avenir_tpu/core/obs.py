@@ -421,6 +421,19 @@ class Tracer:
                     "buffered": len(self._buf),
                     "buffer_spans": self._buf.maxlen}
 
+    def wall_epoch_unix_ns(self) -> int:
+        """The tracer's perf-counter epoch expressed on the Unix wall
+        clock (ns).  Every exported ``t0_ns``/``t_ns`` is relative to
+        the construction-time ``perf_counter_ns`` epoch, which is
+        meaningless outside this process — the fleet trace stitcher
+        (``fleetobs.stitch``) offsets each process's records by its
+        published anchor to place N processes on ONE wall-clock
+        timeline.  Re-derived per call (wall clock minus elapsed
+        monotonic), so it is stable to perf-counter drift but moves
+        with NTP steps; millisecond-grade cross-process alignment is
+        the design point, the intra-process ordering stays exact."""
+        return time.time_ns() - (time.perf_counter_ns() - self._epoch_ns)
+
     # -- exporters ---------------------------------------------------------
     def record_dict(self, r) -> dict:
         """One record as the JSONL-exporter dict (shared by the one-shot

@@ -120,16 +120,25 @@ def set_metrics(m: Metrics) -> Metrics:
 # ---------------------------------------------------------------------------
 
 def build_snapshot(registry: Optional[Metrics] = None,
-                   tracer=None) -> dict:
+                   tracer=None,
+                   identity: Optional[Mapping[str, object]] = None) -> dict:
     """One timestamped, mergeable snapshot: the registry's counters +
     histogram bucket states + stamped gauges, plus the tracer's per-name
-    span summaries and breaker-visible tracer stats."""
+    span summaries and breaker-visible tracer stats.
+
+    ``identity`` (optional) stamps a process identity record — role,
+    host, pid, start-time nonce (see ``fleetobs.identity``) — so a
+    fleet aggregator can attribute the snapshot to its publishing
+    process.  Like ``pid``, the section is deliberately NOT carried
+    through ``merge_snapshots`` (SNAPSHOT_NON_MERGED)."""
     registry = registry if registry is not None else get_metrics()
     tracer = tracer if tracer is not None else obs.get_tracer()
     snap = registry.mergeable_snapshot()
     snap["v"] = SNAPSHOT_VERSION
     snap["pid"] = os.getpid()
     snap["spans"] = tracer.span_summaries()
+    if identity is not None:
+        snap["identity"] = dict(identity)
     return snap
 
 
@@ -189,6 +198,12 @@ SNAPSHOT_NON_MERGED: Dict[str, str] = {
         "process identity: a merged snapshot spans processes by "
         "definition, so carrying one pid forward would be a lie — "
         "consumers needing lineage read the per-process JSONL lines",
+    "identity":
+        "fleet process identity record (role/host/pid/start nonce): a "
+        "merged snapshot spans processes, so no single identity is "
+        "true of it — the fleet fold (fleetobs.aggregate) consumes the "
+        "record BEFORE merging (per-process gauge namespacing, feed "
+        "staleness attribution) and then drops it, exactly like pid",
 }
 
 #: every top-level section merge_snapshots knows how to carry; an input
@@ -538,21 +553,31 @@ class TelemetryExporter:
     ``providers`` are callables invoked per tick; each may return a
     partial snapshot dict (``gauges``/``hists``/``counters`` sections,
     e.g. the serve layer's per-model latency families + SLO evaluation)
-    that overlays the registry snapshot.  ``stop()`` joins the thread
-    (bounded) and takes one final tick so short jobs still export at
-    least one line; the thread is verifiably gone afterwards (asserted
-    by the shutdown lint)."""
+    that overlays the registry snapshot.  ``sinks`` are callables
+    invoked per tick with the COMPLETE snapshot (after overlays) —
+    additional export destinations beyond the JSONL series, e.g. the
+    fleet spool publisher (``fleetobs.publisher``); a raising sink is
+    swallowed exactly like a raising provider.  ``identity`` (a
+    mapping) stamps every snapshot with a process identity record (see
+    :func:`build_snapshot`).  ``stop()`` joins the thread (bounded) and
+    takes one final tick so short jobs still export at least one line;
+    the thread is verifiably gone afterwards (asserted by the shutdown
+    lint)."""
 
     def __init__(self, interval_sec: float,
                  jsonl_path: Optional[str] = None,
                  registry: Optional[Metrics] = None,
                  tracer=None,
-                 providers: Iterable[Callable[[], Optional[dict]]] = ()):
+                 providers: Iterable[Callable[[], Optional[dict]]] = (),
+                 sinks: Iterable[Callable[[dict], None]] = (),
+                 identity: Optional[Mapping[str, object]] = None):
         self.interval = float(interval_sec)
         self.jsonl_path = jsonl_path
         self.registry = registry
         self.tracer = tracer
         self.providers = list(providers)
+        self.sinks = list(sinks)
+        self.identity = dict(identity) if identity is not None else None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._lock = sanitizer.make_lock("telemetry.exporter")
@@ -564,7 +589,8 @@ class TelemetryExporter:
         serve ``metrics`` command renders THIS through
         :func:`prometheus_text`, so a scrape and a JSONL line always
         agree."""
-        snap = build_snapshot(self.registry, self.tracer)
+        snap = build_snapshot(self.registry, self.tracer,
+                              identity=self.identity)
         for provider in self.providers:
             try:
                 extra = provider()
@@ -581,13 +607,19 @@ class TelemetryExporter:
         return snap
 
     def tick(self) -> dict:
-        """One export cycle: build the snapshot, append the JSONL line."""
+        """One export cycle: build the snapshot, append the JSONL line,
+        feed every sink."""
         snap = self.snapshot()
         if self.jsonl_path:
             line = json.dumps(snap) + "\n"
             with self._lock:
                 with open(self.jsonl_path, "a") as fh:
                     fh.write(line)
+        for sink in self.sinks:
+            try:
+                sink(snap)
+            except Exception:                           # noqa: BLE001
+                continue        # a broken sink must not kill export
         # under the same lock as the file append: tick() is called by
         # the exporter thread AND by stop()/manual callers, and an
         # unlocked += is exactly the RMW race the lock-discipline rule

@@ -1,0 +1,50 @@
+"""Fleet observability plane: cross-process telemetry aggregation,
+stitched traces, and fleet-wide SLO verdicts.
+
+Every observability primitive in this repo was built mergeable on
+purpose — ``merge_snapshots`` is a certified commutative fold (PR 12),
+SLO boards diff cumulative histograms, trace ids propagate through
+batching fan-in (PR 10) — but until this package nothing ever *did* the
+merge across processes.  This is the Dapper + Monarch move (PAPERS.md,
+"Observability"): per-process collection stays local and cheap, and
+aggregation is a hierarchical fold of already-mergeable state.
+
+Three legs:
+
+- **Publisher** (:mod:`.publisher`) — with ``fleetobs.spool.dir`` set,
+  every long-running entry point (serve, stream, workload, dag, multi)
+  atomically publishes its ``TelemetryExporter`` snapshot per tick into
+  a per-process spool directory, tagged with a process identity record
+  (:mod:`.identity`: role, host, pid, start-time nonce, trace epoch
+  anchor); incremental trace JSONL and flight dumps land in the same
+  spool.
+- **Aggregator** (:mod:`.aggregator`) — ``python -m avenir_tpu
+  fleetobs`` watches N spools, folds the snapshots (per-process gauges
+  namespaced with the identity label so latest-ts-wins merging cannot
+  clobber them — :mod:`.aggregate`), drives fleet-level ``SLOBoard``s
+  from the merged per-model histograms, serves the merged Prometheus
+  exposition + ``health``/``stats`` over the existing JSON-lines
+  frontend, and turns feed staleness into a gauge plus a
+  flight-recorder anomaly.
+- **Trace stitching + flight correlation** (:mod:`.stitch`,
+  :mod:`.incidents`) — ``python -m avenir_tpu fleetobs stitch
+  --trace-id X`` merges per-process trace JSONL into ONE
+  Perfetto-loadable file with one lane per process; a flight dump in
+  any process makes the aggregator bundle sibling-spool dumps and trace
+  tails sharing the trace id into a single incident directory.
+
+The aggregator is deliberately jax-free: it imports only the core
+observability substrate, so one more aggregator process costs an OS
+process, not an accelerator runtime.
+"""
+
+from __future__ import annotations
+
+from .aggregate import FleetSLO, fleet_fold, namespace_gauges
+from .identity import ProcessIdentity, new_identity
+from .publisher import SpoolPublisher, publisher_for_job
+
+__all__ = [
+    "FleetSLO", "ProcessIdentity", "SpoolPublisher", "fleet_fold",
+    "namespace_gauges", "new_identity", "publisher_for_job",
+]

@@ -1227,8 +1227,14 @@ def serve_main(argv) -> int:
         # the server's own exporter reads the key; the flag just sets it
         config.set(telemetry.KEY_JSONL_PATH, metrics_out)
     obs.configure_from_config(config, force_enable=bool(trace_path))
+    # before configure_resilience: the fleet publisher routes
+    # flight.dump.dir into its spool feed when fleetobs.spool.dir is set
+    from ..fleetobs.publisher import publisher_for_job
+    publisher = publisher_for_job(config, role="serve")
     configure_resilience(config)
     server = PredictionServer(config)
+    if publisher is not None:
+        publisher.attach(server.telemetry)
     # started only after the server construction succeeded: a model-load
     # failure above must not leak the trace-flush thread
     flusher = telemetry.flusher_for_job(config, trace_path)

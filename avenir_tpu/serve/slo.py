@@ -43,10 +43,10 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Dict, Optional
+from typing import Dict, Mapping, Optional
 
 from ..core import flight, sanitizer
-from ..core.obs import quantile_from_counts
+from ..core.obs import LatencyHistogram, quantile_from_counts
 
 KEY_P99_MS = "serve.slo.p99.ms"
 KEY_ERROR_PCT = "serve.slo.error.pct"
@@ -195,6 +195,62 @@ class ModelSLO:
         stats["sustained"] = (self.degrade_evals > 0
                               and self.consecutive >= self.degrade_evals)
         return stats
+
+
+class _SnapshotCounters:
+    """``Counters.get``-shaped view over plain snapshot counter dicts."""
+
+    def __init__(self):
+        self.groups: Dict[str, Dict[str, int]] = {}
+
+    def get(self, group: str, name: str) -> int:
+        return int(self.groups.get(group, {}).get(name, 0))
+
+
+class SnapshotStats:
+    """A batcher-shaped facade over MERGED telemetry snapshot state —
+    the fleet-SLO seam.  :meth:`ModelSLO.observe` needs only three
+    things from its ``batcher``: ``e2e_hist`` (a stable-identity
+    :class:`LatencyHistogram`), ``counters.get(group, name)``, and
+    ``breaker`` (None here: a fleet monitor evaluates windows, it has
+    no single process's breaker to degrade).  The fleet aggregator
+    (``fleetobs.aggregate``) keeps ONE facade per monitored model and
+    loads each fresh merged cumulative state into the SAME histogram
+    object — ``ModelSLO`` keys its rolling window on ``id(hist)``, so
+    replacing the object per scrape would restart the window on every
+    evaluation and the diffed p99 would never see more than one sample.
+    """
+
+    breaker = None
+
+    def __init__(self):
+        self.e2e_hist = LatencyHistogram()
+        self.counters = _SnapshotCounters()
+
+    def update(self, hist_state: Optional[dict],
+               serve_counters: Optional[Mapping[str, int]] = None
+               ) -> "SnapshotStats":
+        """Load one merged cumulative state (a ``state_dict``-form
+        histogram + the model's ``Serve`` counter dict) in place."""
+        if hist_state is not None:
+            fresh = LatencyHistogram.from_state(hist_state)
+            if fresh.bounds != self.e2e_hist.bounds:
+                # a bucket-ladder change is a genuine discontinuity:
+                # swap the object and let the monitor restart its window
+                self.e2e_hist = fresh
+            else:
+                h = self.e2e_hist
+                with h._lock:
+                    h.counts = fresh.counts
+                    h.n = fresh.n
+                    h.total = fresh.total
+                    h.vmin = fresh.vmin
+                    h.vmax = fresh.vmax
+                    h.exemplars = fresh.exemplars
+        if serve_counters is not None:
+            self.counters.groups[SERVE_GROUP] = {
+                str(k): int(v) for k, v in serve_counters.items()}
+        return self
 
 
 class SLOBoard:

@@ -195,8 +195,14 @@ def stream_main(argv) -> int:
     if metrics_out:
         config.set(telemetry.KEY_JSONL_PATH, metrics_out)
     obs.configure_from_config(config, force_enable=bool(trace_path))
+    # before configure_resilience: the fleet publisher routes
+    # flight.dump.dir into its spool feed when fleetobs.spool.dir is set
+    from ..fleetobs.publisher import publisher_for_job
+    publisher = publisher_for_job(config, role="stream")
     configure_resilience(config)
     service = StreamDecisionService(config)
+    if publisher is not None:
+        publisher.attach(service.server.telemetry)
     flusher = telemetry.flusher_for_job(config, trace_path)
     port = service.start()
     print(f"streaming decisions for model {service.model_name!r} "

@@ -161,6 +161,36 @@ def build_target(scenario: Scenario):
 # run accounting
 # ---------------------------------------------------------------------------
 
+def run_snapshot(scenario: Scenario, exporter, fleet,
+                 publisher=None) -> Tuple[dict, Optional[List[str]]]:
+    """One merged run snapshot; returns (snapshot, contributing feeds).
+
+    Default: the in-process exporter's registry+overlay merged with the
+    fleet driver's client-side registry — one process's truth.  With
+    ``workload.fleet.snapshot=true`` the run publishes its own snapshot
+    into the fleetobs spool first, then folds EVERY feed's latest
+    snapshot (this process plus any sibling publishers pointed at the
+    same ``fleetobs.spool.dir``) and merges the client registry on top,
+    so the artifacts judge the fleet, not one process."""
+    local = exporter.snapshot()
+    client = fleet.metrics.mergeable_snapshot()
+    if publisher is None:
+        return telemetry.merge_snapshots(local, client), None
+    from ..fleetobs import fleet_fold
+    from ..fleetobs import publisher as pub
+    from ..fleetobs import stitch
+    publisher.publish(local)
+    feeds: Dict[str, dict] = {}
+    for d in stitch.feed_dirs(scenario.config.get(pub.KEY_SPOOL_DIR)):
+        try:
+            with open(os.path.join(d, pub.SNAPSHOT_FILE), "r") as fh:
+                feeds[os.path.basename(d)] = json.load(fh)["snapshot"]
+        except (OSError, ValueError, KeyError):
+            continue        # a feed mid-first-publish folds next time
+    return (telemetry.merge_snapshots(fleet_fold(feeds), client),
+            sorted(feeds))
+
+
 def compile_count(stats: dict) -> int:
     """Total scorer compilations visible in a ``stats`` response.
 
@@ -229,10 +259,22 @@ def run_scenario(config: JobConfig, do_assert: bool = False,
     """Execute one scenario; returns the process exit code."""
     scenario = Scenario(config)
     os.makedirs(scenario.out_dir, exist_ok=True)
+    publisher = None
+    if scenario.fleet_snapshot:
+        # validated before any bootstrap work: fleet mode without a
+        # spool is a manifest error, not a mid-run surprise
+        from ..fleetobs.publisher import KEY_SPOOL_DIR, publisher_for_job
+        publisher = publisher_for_job(config, role="workload")
+        if publisher is None:
+            raise KeyError(
+                f"{scn.KEY_FLEET_SNAPSHOT}=true needs {KEY_SPOOL_DIR} "
+                f"naming the fleet spool this run publishes into")
     tenants = tenant_universe(scenario)
     model_for = bootstrap_target(scenario, tenants)
     schedule = build_schedule(scenario, tenants)
     stop, host, port, exporter, stats_fn = build_target(scenario)
+    if publisher is not None:
+        publisher.attach(exporter)
     per_phase: Dict[str, PhaseStats] = {}
     phase_snapshots: Dict[str, dict] = {}
     fleet = Fleet(host, port, scenario.threads, scenario.timeout_s,
@@ -250,9 +292,8 @@ def run_scenario(config: JobConfig, do_assert: bool = False,
             stats = fleet.run_phase(spec.name, events,
                                     poison_phase=spec.poison_fraction > 0)
             per_phase[spec.name] = stats
-            phase_snapshots[spec.name] = telemetry.merge_snapshots(
-                exporter.snapshot(),
-                fleet.metrics.mergeable_snapshot())
+            phase_snapshots[spec.name], _ = run_snapshot(
+                scenario, exporter, fleet, publisher)
             s = stats.summary()
             log(f"  phase {spec.name!r}: {s['sent']} sent @ "
                 f"{s['achieved_rps']}/s, p99 {s['p99_ms']} ms, "
@@ -263,15 +304,21 @@ def run_scenario(config: JobConfig, do_assert: bool = False,
         n = obs.get_tracer().export_chrome_trace(trace_path)
         log(f"  trace: {n} events -> {trace_path}")
 
-    merged = telemetry.merge_snapshots(exporter.snapshot(),
-                                       fleet.metrics.mergeable_snapshot())
+    merged, fold_feeds = run_snapshot(scenario, exporter, fleet, publisher)
     telemetry_path = os.path.join(scenario.out_dir, "telemetry.json")
     atomic_write_text(telemetry_path, json.dumps(merged) + "\n")
-    log(f"  telemetry: merged snapshot -> {telemetry_path}")
+    log(f"  telemetry: merged snapshot -> {telemetry_path}"
+        + (f" (fleet fold over {len(fold_feeds)} feeds)"
+           if fold_feeds is not None else ""))
 
     verdict = evaluate_run(scenario, per_phase,
                            compiles_after_warmup=compiles0,
                            compiles_at_end=compiles1)
+    if fold_feeds is not None:
+        # the verdict names its evidence: which spool feeds the judged
+        # snapshots folded (the run's own feed plus any siblings)
+        verdict["fleet"] = {"feeds": fold_feeds,
+                            "source": "fleetobs-spool"}
     verdict_path = os.path.join(scenario.out_dir, "verdict.json")
     write_verdict(verdict_path, verdict)
     log(f"  verdict: {'PASS' if verdict['pass'] else 'FAIL'} "

@@ -51,6 +51,7 @@ KEY_OUT_DIR = "workload.out.dir"
 KEY_TIMEOUT_SEC = "workload.request.timeout.sec"
 KEY_WARMUP_REQUESTS = "workload.warmup.requests"
 KEY_COMPILE_FLAT = "workload.slo.compile.flat"
+KEY_FLEET_SNAPSHOT = "workload.fleet.snapshot"
 
 DEFAULT_THREADS = 4
 DEFAULT_TENANTS = 1
@@ -144,7 +145,7 @@ class Scenario:
                  "tenants", "tenants_hot", "zipf_exponent",
                  "payload_median", "payload_sigma", "payload_max",
                  "phases", "out_dir", "timeout_s", "warmup_requests",
-                 "compile_flat", "config")
+                 "compile_flat", "fleet_snapshot", "config")
 
     def __init__(self, config: JobConfig):
         self.config = config
@@ -181,6 +182,11 @@ class Scenario:
         self.warmup_requests = config.get_int(KEY_WARMUP_REQUESTS,
                                               DEFAULT_WARMUP_REQUESTS)
         self.compile_flat = config.get_boolean(KEY_COMPILE_FLAT, False)
+        # fleet-snapshot mode: phase/final snapshots fold EVERY feed in
+        # the fleetobs spool (this run publishes its own feed there),
+        # not just the in-process exporter — the verdict then judges the
+        # fleet, not one process
+        self.fleet_snapshot = config.get_boolean(KEY_FLEET_SNAPSHOT, False)
 
 
 # ---------------------------------------------------------------------------

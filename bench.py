@@ -2768,6 +2768,135 @@ def bench_trace_overhead():
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_fleetobs_publish_overhead():
+    """Fleet-publisher tax (fleetobs.SpoolPublisher): with
+    ``fleetobs.spool.dir`` set, the telemetry exporter additionally
+    writes ONE identity-tagged snapshot atomically into the spool feed
+    per tick.  The per-tick cost is deterministic (serialize + write +
+    rename on a serving-shaped snapshot), so the ASSERTED < 2% bound is
+    analytic — publish cost / tick interval, the duty cycle a process
+    spends publishing, at the same 4x-aggressive 0.25s interval
+    ``telemetry_overhead_pct`` uses; at the production default 10s the
+    figure is 40x smaller still.  An interleaved A/B on serving steady
+    state (exporter ticking on both sides, spool sink attached on one)
+    is recorded as evidence, clamped at 0 when host noise inverts it."""
+    import shutil
+    import tempfile
+    import threading
+
+    from avenir_tpu.core import JobConfig, telemetry
+    from avenir_tpu.core.io import write_output
+    from avenir_tpu.datagen import gen_telecom_churn
+    from avenir_tpu.fleetobs import SpoolPublisher, new_identity
+    from avenir_tpu.models.bayesian import BayesianDistribution
+    from avenir_tpu.serve import PredictionServer
+
+    tmp = tempfile.mkdtemp(prefix="avenir_fleetobs_bench_")
+    srv = None
+    try:
+        schema = dict(_CHURN_SCHEMA)
+        schema["fields"] = [dict(f) for f in _CHURN_SCHEMA["fields"]]
+        schema["fields"][1]["cardinality"] = ["planA", "planB"]
+        schema_path = os.path.join(tmp, "schema.json")
+        with open(schema_path, "w") as fh:
+            fh.write(json.dumps(schema))
+        rows = gen_telecom_churn(20_000, seed=17)
+        write_output(os.path.join(tmp, "train"),
+                     [",".join(r) for r in rows])
+        BayesianDistribution(JobConfig(
+            {"feature.schema.file.path": schema_path})).run(
+            os.path.join(tmp, "train"), os.path.join(tmp, "model"))
+        srv = PredictionServer(JobConfig({
+            "serve.models": "churn",
+            "serve.model.churn.kind": "naiveBayes",
+            "serve.model.churn.feature.schema.file.path": schema_path,
+            "serve.model.churn.bayesian.model.file.path":
+                os.path.join(tmp, "model"),
+            "serve.batch.max.size": "64",
+            "serve.queue.max.depth": "8192",
+            "telemetry.interval.sec": "0"}))
+        n_req = 4000
+        reqs = [json.dumps({"model": "churn",
+                            "row": ",".join(rows[i % 4096]),
+                            "request_id": str(i)})
+                for i in range(n_req)]
+
+        def fire_all():
+            done = threading.Event()
+            lock = threading.Lock()
+            left = [n_req]
+
+            def cb(_resp):
+                with lock:
+                    left[0] -= 1
+                    if left[0] == 0:
+                        done.set()
+
+            for line in reqs:
+                srv.dispatch_line(line, cb)
+            assert done.wait(180)
+
+        fire_all()          # steady state; populates the serve surfaces
+        spool = os.path.join(tmp, "spool")
+        pub = SpoolPublisher(spool, new_identity("bench"))
+        snap = srv.telemetry.snapshot()
+        pub.publish(snap)                     # warm the feed directory
+        t_pub = []
+        for _ in range(300):
+            t0 = time.perf_counter()
+            pub.publish(snap)
+            t_pub.append(time.perf_counter() - t0)
+        publish_cost = min(t_pub)
+        interval = 0.25
+        analytic = 100.0 * publish_cost / interval
+
+        def run_side(with_pub):
+            exp = telemetry.TelemetryExporter(interval)
+            if with_pub:
+                pub.attach(exp)
+            exp.start()
+            try:
+                t0 = time.perf_counter()
+                fire_all()
+                return time.perf_counter() - t0
+            finally:
+                exp.stop()
+
+        t_off, t_on = [], []
+        for rep in range(REPS):
+            sides = ((False, t_off), (True, t_on))
+            if rep % 2:
+                sides = sides[::-1]
+            for with_pub, sink in sides:
+                sink.append(run_side(with_pub))
+        measured = max(
+            0.0, 100.0 * (min(t_on) - min(t_off)) / min(t_off))
+        assert analytic < 2.0, (
+            f"fleetobs publish overhead {analytic:.3f}% >= 2% "
+            f"({publish_cost * 1e6:.0f}us per publish every "
+            f"{interval}s tick)")
+        out = {"metric": "fleetobs_publish_overhead_pct",
+               "value": round(analytic, 4),
+               "unit": "% wall time spent publishing the spool feed at a "
+                       "0.25s tick interval (analytic duty cycle: "
+                       "publish cost / interval on a serving-shaped "
+                       "snapshot; asserted < 2); interleaved serving A/B "
+                       "recorded as evidence",
+               "vs_baseline": None,
+               "publish_us": round(publish_cost * 1e6, 1),
+               "publish_us_median": round(
+                   statistics.median(t_pub) * 1e6, 1),
+               "snapshot_bytes": len(json.dumps(snap)),
+               "measured_ab_pct": round(measured, 2),
+               "off_sec": round(min(t_off), 4),
+               "on_sec": round(min(t_on), 4)}
+        return finish_metric(out, t_pub, bigger_is_better=False)
+    finally:
+        if srv is not None:
+            srv.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
     import avenir_tpu
     avenir_tpu.enable_x64()
@@ -2849,6 +2978,8 @@ def main():
                      ("obs_overhead", bench_obs_overhead),
                      ("telemetry_overhead", bench_telemetry_overhead),
                      ("trace_overhead", bench_trace_overhead),
+                     ("fleetobs_publish_overhead",
+                      bench_fleetobs_publish_overhead),
                      ("resilience_overhead", bench_resilience_overhead),
                      ("durability_overhead", bench_durability_overhead),
                      ("chaos_recovery", bench_chaos_recovery),

@@ -1,0 +1,116 @@
+"""CI smoke for the fleet observability plane (~10s, jax-free): two
+REAL publisher processes write identity-tagged snapshots into one
+spool, the REAL aggregator (`python -m avenir_tpu fleetobs`) serves
+the merged surface over TCP, and the gate asserts the fleet counter
+equals the EXACT sum of what the publishers wrote — plus health/feeds
+and per-process gauge namespacing.
+
+Usage: python resource/ci/fleetobs_smoke.py
+"""
+
+import json
+import os
+import re
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+#: one publisher process: N increments of Smoke/Widgets across a few
+#: publish rounds, a per-process gauge, then exit (feed stays fresh
+#: long enough for the stale_sec=30 aggregator to fold it)
+PUBLISHER = """
+import sys
+sys.path.insert(0, {repo!r})
+from avenir_tpu.core import obs
+from avenir_tpu.fleetobs import SpoolPublisher, new_identity
+
+spool, role, total = sys.argv[1], sys.argv[2], int(sys.argv[3])
+m = obs.Metrics()
+pub = SpoolPublisher(spool, new_identity(role))
+done = 0
+for round_total in (total // 2, total):
+    while done < round_total:
+        m.counters.incr("Smoke", "Widgets")
+        done += 1
+    m.set_gauge("smoke.queue.depth", float(done))
+    pub.publish(m.mergeable_snapshot())
+print(done)
+"""
+
+
+def main() -> int:
+    spool = tempfile.mkdtemp(prefix="fleetobs-smoke-")
+    env = dict(os.environ, PYTHONPATH=REPO)
+    agg = None
+    try:
+        totals = {"alpha": 17, "beta": 25}
+        for role, total in totals.items():
+            out = subprocess.run(
+                [sys.executable, "-c", PUBLISHER.format(repo=REPO),
+                 spool, role, str(total)],
+                env=env, capture_output=True, text=True, timeout=60)
+            if out.returncode != 0 or out.stdout.strip() != str(total):
+                raise SystemExit(f"publisher {role} failed: "
+                                 f"{out.stdout} {out.stderr}")
+
+        agg = subprocess.Popen(
+            [sys.executable, "-m", "avenir_tpu", "fleetobs",
+             "-Dfleetobs.spool.dir=" + spool, "-Dfleetobs.port=0",
+             "-Dfleetobs.poll.sec=0.2", "-Dfleetobs.stale.sec=30"],
+            env=env, stderr=subprocess.PIPE, text=True)
+        # the startup banner carries the ephemeral port
+        line = agg.stderr.readline()
+        m = re.search(r":(\d+) \(poll", line)
+        if not m:
+            raise SystemExit(f"no aggregator banner: {line!r}")
+        port = int(m.group(1))
+
+        from avenir_tpu.serve.server import request, request_text
+        deadline = time.monotonic() + 30
+        while True:
+            health = request("127.0.0.1", port, {"cmd": "health"})
+            if health.get("feeds") == 2:
+                break
+            if time.monotonic() > deadline:
+                raise SystemExit(f"feeds never folded: {health}")
+            time.sleep(0.2)
+        if not health["ok"]:
+            raise SystemExit(f"fleet unhealthy: {health}")
+
+        text = request_text("127.0.0.1", port, {"cmd": "metrics"})
+        got = re.search(r'^avenir_counter_total\{group="Smoke",'
+                        r'name="Widgets"\} (\d+)', text, re.MULTILINE)
+        want = sum(totals.values())
+        if not got or int(got.group(1)) != want:
+            raise SystemExit(f"fleet counter != sum: want {want}, "
+                             f"scrape line {got and got.group(0)!r}")
+        # gauges must be namespaced per process, one line per publisher
+        depth_lines = re.findall(
+            r'^avenir_smoke_queue_depth\{proc="[^"]+"\} '
+            r'(\d+(?:\.\d+)?)', text, re.MULTILINE)
+        if sorted(float(v) for v in depth_lines) != sorted(
+                float(v) for v in totals.values()):
+            raise SystemExit(f"per-proc gauges wrong: {depth_lines}")
+        print(f"fleetobs smoke: fleet Widgets={want} == "
+              f"{'+'.join(str(v) for v in totals.values())} (exact), "
+              f"2 feeds healthy, gauges proc-namespaced")
+        return 0
+    finally:
+        if agg is not None:
+            agg.send_signal(signal.SIGTERM)
+            try:
+                agg.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                agg.kill()
+        shutil.rmtree(spool, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,84 @@
+"""Fleet runbook client: drive decisions across THREE processes, fan
+one logical request out under a single shared trace id, then prove the
+aggregator's merged Prometheus scrape equals the SUM of the
+per-process scrapes — fleet == Σ processes, exact, not approximate.
+
+Usage: client.py <host> <base_port> <agg_port> <shared_trace_id>
+
+Ports base_port+1 .. base_port+3 must be the three decision services;
+agg_port is the fleetobs aggregator's JSON-lines frontend.
+"""
+
+import sys
+import re
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 3)[0])
+from avenir_tpu.serve.server import request, request_text  # noqa: E402
+
+#: the per-model request counter in Prometheus exposition — counters
+#: are NEVER proc-namespaced by the fold (they sum exactly), so the
+#: same regex reads both a per-process scrape and the fleet scrape
+REQUESTS = re.compile(
+    r'^avenir_counter_total\{group="Serve\.decisions",name="Requests"\}'
+    r' (\d+)', re.MULTILINE)
+
+
+def decide(host, port, event, trace_id):
+    resp = request(host, port, {"model": "decisions",
+                                "decide": f"{event},shop-a",
+                                "trace_id": trace_id})
+    if "output" not in resp:
+        raise SystemExit(f"decide failed on :{port}: {resp}")
+
+
+def requests_total(host, port):
+    m = REQUESTS.search(request_text(host, port, {"cmd": "metrics"}))
+    return int(m.group(1)) if m else 0
+
+
+def main():
+    host, base, agg = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    shared = sys.argv[4]
+    ports = [base + i for i in (1, 2, 3)]
+
+    # 20 decisions per process, each with its own trace id ...
+    for pi, port in enumerate(ports):
+        for i in range(20):
+            decide(host, port, f"ev{pi}-{i:04d}", f"{pi:02x}{i:010x}")
+    # ... plus ONE logical request fanned across ALL THREE processes
+    # under a single shared trace id — the stitch target
+    for pi, port in enumerate(ports):
+        decide(host, port, f"fanout-{pi}", shared)
+
+    # the fleet scrape lags each publish interval; once traffic stops
+    # it must CONVERGE to the exact sum of the per-process scrapes
+    expect = sum(requests_total(host, p) for p in ports)
+    deadline = time.monotonic() + 30
+    while True:
+        fleet = requests_total(host, agg)
+        if fleet == expect:
+            break
+        if time.monotonic() > deadline:
+            raise SystemExit(f"fleet scrape never converged: "
+                             f"fleet={fleet} sum-of-processes={expect}")
+        time.sleep(0.3)
+
+    per_proc = [requests_total(host, p) for p in ports]
+    if sum(per_proc) != fleet:
+        raise SystemExit(f"fleet != sum: {per_proc} vs {fleet}")
+    print(f"   per-process Requests: {per_proc}  fleet: {fleet} (exact)")
+
+    health = request(host, agg, {"cmd": "health"})
+    if not health["ok"] or health["feeds"] != 3:
+        raise SystemExit(f"unexpected fleet health: {health}")
+    slo = health.get("slo") or {}
+    win = slo.get("decisions")
+    if not win:
+        raise SystemExit(f"no fleet SLO window for 'decisions': {slo}")
+    print(f"   fleet SLO window: n={win.get('n')} "
+          f"p99={win.get('p99_ms')}ms violation={win.get('violation')}")
+
+
+if __name__ == "__main__":
+    main()

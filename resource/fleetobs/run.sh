@@ -1,0 +1,101 @@
+#!/usr/bin/env bash
+# Fleet observability runbook (README "Fleet observability"): start
+# THREE streaming decision services, each publishing telemetry
+# snapshots + trace JSONL into one fleetobs spool; start the
+# aggregator over that spool; prove the merged Prometheus scrape
+# equals the SUM of the per-process scrapes (fleet == Σ processes,
+# exact); stitch one request's cross-process trace into a single
+# Perfetto timeline; then SIGKILL one service and watch the
+# aggregator turn feed staleness into a gauge, a flight-recorder
+# anomaly dump, and a correlated incident bundle.
+set -euo pipefail
+cd "$(dirname "$0")"
+PY=${PYTHON:-python}
+BASE_PORT=${BASE_PORT:-8741}
+AGG_PORT=${AGG_PORT:-8750}
+TRACE_ID=fleetfanout0001
+rm -rf work && mkdir -p work
+
+PIDS=()
+trap 'kill "${PIDS[@]}" 2>/dev/null || true' EXIT
+
+echo "== start 3 decision services publishing into one spool"
+for i in 1 2 3; do
+  $PY -m avenir_tpu stream -Dconf.path=fleet.properties \
+      -Dserve.port=$((BASE_PORT + i)) \
+      -Dfleetobs.role=decider$i \
+      -Dcheckpoint.path=work/decider$i.ckpt \
+      >work/decider$i.log 2>&1 &
+  PIDS+=($!)
+done
+for i in 1 2 3; do
+  for _ in $(seq 1 100); do
+    grep -q "streaming decisions" work/decider$i.log && break
+    kill -0 "${PIDS[$((i-1))]}" || { cat work/decider$i.log; exit 1; }
+    sleep 0.2
+  done
+done
+
+echo "== start the aggregator over the spool"
+$PY -m avenir_tpu fleetobs -Dfleetobs.spool.dir=work/spool \
+    -Dfleetobs.port=$AGG_PORT -Dfleetobs.poll.sec=0.3 \
+    -Dfleetobs.stale.sec=3 -Dserve.slo.p99.ms=250 \
+    >work/agg.log 2>&1 &
+AGG_PID=$!
+PIDS+=($AGG_PID)
+for _ in $(seq 1 100); do
+  grep -q "fleetobs: aggregating" work/agg.log && break
+  kill -0 $AGG_PID || { cat work/agg.log; exit 1; }
+  sleep 0.2
+done
+
+echo "== drive 63 decisions (21/process; 3 share ONE trace id), then"
+echo "   assert the merged scrape == sum of per-process scrapes"
+$PY client.py 127.0.0.1 $BASE_PORT $AGG_PORT $TRACE_ID
+
+echo "== stitch the fanned-out request: one Perfetto file, one lane"
+echo "   per process, every span under the shared trace id"
+$PY -m avenir_tpu fleetobs stitch --spool work/spool \
+    --trace-id $TRACE_ID --out work/fleet-trace.json
+$PY - <<'EOF'
+import json
+doc = json.load(open("work/fleet-trace.json"))
+ev = doc["traceEvents"] if isinstance(doc, dict) else doc
+lanes = {e["pid"] for e in ev if e.get("ph") == "X"}
+assert len(lanes) >= 2, f"stitched trace spans {len(lanes)} process(es)"
+print(f"   stitched spans cover {len(lanes)} process lanes")
+EOF
+
+echo "== SIGKILL decider3: staleness must become a gauge, a black-box"
+echo "   dump in the aggregator's reserved spool entry, and an incident"
+kill -9 "${PIDS[2]}"
+$PY - "$AGG_PORT" <<'EOF'
+import sys, time
+sys.path.insert(0, "../..")
+from avenir_tpu.serve.server import request
+
+deadline = time.monotonic() + 30
+while True:
+    h = request("127.0.0.1", int(sys.argv[1]), {"cmd": "health"})
+    if not h["ok"] and any(s.startswith("decider3-") for s in h["stale"]):
+        break
+    if time.monotonic() > deadline:
+        raise SystemExit(f"feed never went stale: {h}")
+    time.sleep(0.3)
+print(f"   health: ok={h['ok']} stale={h['stale']}")
+EOF
+for _ in $(seq 1 100); do
+  compgen -G "work/spool/_aggregator/flight/flight-fleet_feed_stale-*" \
+      >/dev/null && break
+  sleep 0.2
+done
+ls work/spool/_aggregator/flight/flight-fleet_feed_stale-* >/dev/null
+for _ in $(seq 1 100); do
+  compgen -G "work/spool/_incidents/incident-*fleet_feed_stale*" \
+      >/dev/null && break
+  sleep 0.2
+done
+ls -d work/spool/_incidents/incident-*fleet_feed_stale* >/dev/null
+echo "   anomaly dump + incident bundle present"
+
+echo "== fleet observability runbook: ALL CLEAN"

@@ -1,0 +1,532 @@
+"""Fleet observability plane (avenir_tpu/fleetobs): spool publisher,
+cross-process fold, fleet SLO, trace stitching, incident correlation.
+
+The load-bearing guarantees under test:
+
+- **Fleet == Σ processes, exactly** — the fold of N publishers'
+  snapshots reproduces every counter and histogram as the exact sum of
+  the per-process values, under randomized publish interleavings (the
+  fold is over ATOMIC whole snapshots, so interleaving order can never
+  tear a feed).
+- **Gauges never lie across processes** — per-process gauges survive
+  the fold side by side under ``proc="<label>"`` namespacing, while
+  single-process ``merge_snapshots`` behavior stays byte-identical
+  (namespacing happens only at the fleet boundary).
+- **Identity is consumed, not merged** — ``build_snapshot(identity=…)``
+  stamps the process identity section; the fold reads it and drops it
+  (``SNAPSHOT_NON_MERGED``), like ``pid``.
+- **Staleness is an anomaly** — a feed that stops publishing flips a
+  gauge AND fires exactly one edge-triggered flight dump.
+- **One trace, one file** — per-process trace JSONL stitches into a
+  single Perfetto trace with one process lane per feed, aligned on the
+  published wall-clock anchors.
+- **One anomaly, one incident** — dumps sharing a trace id across
+  feeds bundle into one incident directory with per-feed trace tails.
+"""
+
+import json
+import os
+import random
+import re
+import subprocess
+import sys
+import time
+
+import pytest
+
+from avenir_tpu.core import flight, obs, telemetry
+from avenir_tpu.core.config import JobConfig, parse_properties
+from avenir_tpu.core.io import atomic_write_text
+from avenir_tpu.core.obs import quantile_from_counts
+from avenir_tpu.fleetobs import (fleet_fold, namespace_gauges, new_identity,
+                                 publisher_for_job)
+from avenir_tpu.fleetobs.aggregate import FleetSLO, parse_labels
+from avenir_tpu.fleetobs.aggregator import FleetAggregator
+from avenir_tpu.fleetobs.identity import ProcessIdentity
+from avenir_tpu.fleetobs.incidents import IncidentCorrelator
+from avenir_tpu.fleetobs.publisher import (FLIGHT_SUBDIR, IDENTITY_FILE,
+                                           SNAPSHOT_FILE, TRACE_FILE,
+                                           SpoolPublisher)
+from avenir_tpu.fleetobs.stitch import feed_dirs, stitch_traces, trace_tail
+
+
+def _identity(role: str, i: int) -> ProcessIdentity:
+    return ProcessIdentity(role=role, host="testhost", pid=1000 + i,
+                           start_ns=i + 1,
+                           trace_epoch_unix_ns=1_000_000_000 + i)
+
+
+def _read_feeds(spool):
+    feeds = {}
+    for d in feed_dirs(spool):
+        with open(os.path.join(d, SNAPSHOT_FILE)) as fh:
+            feeds[os.path.basename(d)] = json.load(fh)["snapshot"]
+    return feeds
+
+
+# ---------------------------------------------------------------------------
+# the fold: fleet == sum of processes
+# ---------------------------------------------------------------------------
+
+def test_fleet_fold_is_exact_sum_under_interleaving(tmp_path):
+    """3 publishers, randomized publish interleavings: every counter and
+    histogram in the fold equals the exact per-process sum (atomic
+    whole-snapshot publishes can never tear), and every process's gauge
+    survives under its own proc label."""
+    rng = random.Random(20260806)
+    spool = str(tmp_path)
+    hist_name = telemetry.labeled("serve.e2e.latency", model="m")
+    pubs, regs, want = [], [], {}
+    for i in range(3):
+        ident = _identity(f"r{i}", i)
+        pubs.append(SpoolPublisher(spool, ident, tracer=obs.Tracer()))
+        regs.append(obs.Metrics())
+        want[ident.label] = {"requests": 0, "n": 0}
+    for _round in range(12):
+        order = list(range(3))
+        rng.shuffle(order)
+        for i in order:
+            ident = pubs[i].identity
+            k = rng.randrange(1, 7)
+            regs[i].counters.incr("Serve.m", "Requests", k)
+            want[ident.label]["requests"] += k
+            for _ in range(rng.randrange(0, 4)):
+                regs[i].histogram(hist_name).record(rng.random() * 0.1)
+                want[ident.label]["n"] += 1
+            regs[i].set_gauge("proc.queue.depth", i * 10 + _round)
+            pubs[i].publish(telemetry.build_snapshot(
+                registry=regs[i], identity=ident.to_dict()))
+    feeds = _read_feeds(spool)
+    assert sorted(feeds) == sorted(p.identity.label for p in pubs)
+    merged = fleet_fold(feeds)
+    assert merged["counters"]["Serve.m"]["Requests"] == sum(
+        w["requests"] for w in want.values())
+    assert merged["hists"][hist_name]["n"] == sum(
+        w["n"] for w in want.values())
+    # per-process gauges all survive, namespaced — latest-ts-wins never
+    # collapsed two processes' like-named series
+    for label in want:
+        assert f'proc.queue.depth{{proc="{label}"}}' in merged["gauges"]
+    # identity consumed, never merged
+    assert "identity" not in merged and "pid" not in merged
+
+
+def test_single_process_merge_stays_byte_identical():
+    """Gauge namespacing happens ONLY at the fleet boundary: plain
+    merge_snapshots output is unchanged by this PR (no proc labels, and
+    an identity section is dropped like pid)."""
+    reg = obs.Metrics()
+    reg.counters.incr("G", "n", 2)
+    reg.set_gauge("queue.depth", 5)
+    a = telemetry.build_snapshot(registry=reg)
+    b = telemetry.build_snapshot(registry=reg)
+    merged = telemetry.merge_snapshots(a, b)
+    assert "queue.depth" in merged["gauges"]
+    assert not any("proc=" in name for name in merged["gauges"])
+    # with identity stamped, the merge still succeeds and drops it
+    ai = telemetry.build_snapshot(registry=reg,
+                                  identity=_identity("serve", 0).to_dict())
+    assert ai["identity"]["role"] == "serve"
+    merged2 = telemetry.merge_snapshots(ai, b)
+    assert "identity" not in merged2
+    assert merged2["counters"] == merged["counters"]
+
+
+def test_namespace_gauges_label_forms():
+    snap = {"gauges": {"plain": {"value": 1.0, "ts": 1.0},
+                       'lab{model="m"}': {"value": 2.0, "ts": 1.0}},
+            "counters": {"G": {"n": 1}}, "hists": {}, "spans": {}}
+    out = namespace_gauges(snap, "p-1")
+    assert 'plain{proc="p-1"}' in out["gauges"]
+    assert 'lab{model="m",proc="p-1"}' in out["gauges"]
+    # counters untouched: summing across processes is the point
+    assert out["counters"] == snap["counters"]
+
+
+def test_parse_labels_inverts_escaping():
+    name = telemetry.labeled("g", model='we"ird\\name', zone="a")
+    m = telemetry._LABELED_RE.match(name)
+    assert parse_labels(m.group(2)) == {"model": 'we"ird\\name',
+                                        "zone": "a"}
+
+
+def test_fleet_slo_p99_matches_merged_hist(tmp_path):
+    """The fleet SLO board's windowed p99 is computed from the MERGED
+    histogram: with a zero base window it must equal the quantile of
+    the summed bucket counts."""
+    spool = str(tmp_path)
+    hist_name = telemetry.labeled("serve.e2e.latency", model="churn")
+    rng = random.Random(7)
+    for i in range(2):
+        ident = _identity(f"s{i}", i)
+        p = SpoolPublisher(spool, ident, tracer=obs.Tracer())
+        reg = obs.Metrics()
+        reg.counters.incr("Serve.churn", "Requests", 50)
+        for _ in range(50):
+            reg.histogram(hist_name).record(0.001 + rng.random() * 0.2)
+        p.publish(telemetry.build_snapshot(registry=reg,
+                                           identity=ident.to_dict()))
+    merged = fleet_fold(_read_feeds(spool))
+    st = merged["hists"][hist_name]
+    assert st["n"] == 100
+    fleet = FleetSLO(JobConfig({"serve.slo.p99.ms": "1000"}))
+    out = fleet.observe(merged)
+    h = obs.LatencyHistogram.from_state(st)
+    # the monitor windows DIFFED counts, so extrema come from the
+    # occupied buckets' edges — mirror exactly what it computes
+    expected = quantile_from_counts(h.bounds, h.counts, 0.99)
+    assert out["churn"]["n"] == 100
+    assert out["churn"]["p99_ms"] == pytest.approx(expected * 1000.0,
+                                                   abs=1e-3)
+    assert fleet.section()["churn"]["target_p99_ms"] == 1000.0
+
+
+# ---------------------------------------------------------------------------
+# the aggregator: staleness, reserved entries, the JSON-lines surface
+# ---------------------------------------------------------------------------
+
+def _plant_feed(spool, ident: ProcessIdentity, snapshot,
+                published_unix: float, seq: int = 1) -> str:
+    d = os.path.join(spool, ident.label)
+    os.makedirs(d, exist_ok=True)
+    atomic_write_text(os.path.join(d, IDENTITY_FILE),
+                      json.dumps(ident.to_dict()))
+    atomic_write_text(os.path.join(d, SNAPSHOT_FILE), json.dumps(
+        {"seq": seq, "published_unix": published_unix,
+         "label": ident.label, "snapshot": snapshot}))
+    return d
+
+
+def test_staleness_is_a_gauge_and_an_edge_triggered_anomaly(tmp_path):
+    spool = str(tmp_path / "spool")
+    reg = obs.Metrics()
+    reg.counters.incr("G", "n", 1)
+    now = time.time()
+    fresh_i = _identity("fresh", 0)
+    stale_i = _identity("dead", 1)
+    _plant_feed(spool, fresh_i, telemetry.build_snapshot(registry=reg), now)
+    _plant_feed(spool, stale_i, telemetry.build_snapshot(registry=reg),
+                now - 60.0)
+    prev = flight.get_recorder()
+    rec = flight.set_recorder(flight.FlightRecorder(
+        dump_dir=str(tmp_path / "dumps"), min_interval_sec=0.0,
+        snapshot_interval_sec=0))
+    try:
+        agg = FleetAggregator(spool, JobConfig(
+            {"fleetobs.stale.sec": "10"}))
+        merged = agg.scan(now=now)
+        g = merged["gauges"]
+        assert g["fleetobs.feeds"]["value"] == 2
+        assert g["fleetobs.feeds.stale"]["value"] == 1
+        assert g[f'fleetobs.feed.stale{{proc="{stale_i.label}"}}'][
+            "value"] == 1
+        assert g[f'fleetobs.feed.stale{{proc="{fresh_i.label}"}}'][
+            "value"] == 0
+        dumps = os.listdir(str(tmp_path / "dumps"))
+        assert len(dumps) == 1 and "fleet_feed_stale" in dumps[0]
+        # edge-triggered: a still-stale feed fires no second dump
+        agg.scan(now=now + 1)
+        assert len(os.listdir(str(tmp_path / "dumps"))) == 1
+        health = {}
+        agg.dispatch_line(json.dumps({"cmd": "health"}), health.update)
+        assert health["ok"] is False
+        assert health["stale"] == [stale_i.label]
+    finally:
+        flight.set_recorder(prev)
+        assert rec.triggers == 1
+
+
+def test_reserved_spool_entries_are_not_feeds(tmp_path):
+    spool = str(tmp_path)
+    ident = _identity("only", 0)
+    reg = obs.Metrics()
+    _plant_feed(spool, ident, telemetry.build_snapshot(registry=reg),
+                time.time())
+    os.makedirs(os.path.join(spool, "_incidents"), exist_ok=True)
+    os.makedirs(os.path.join(spool, "_aggregator", FLIGHT_SUBDIR),
+                exist_ok=True)
+    assert [os.path.basename(d) for d in feed_dirs(spool)] == [ident.label]
+
+
+def test_aggregator_counters_equal_sum_of_scrapes(tmp_path):
+    """The merged Prometheus exposition's counters equal the sum of the
+    per-process snapshots' counters, exactly."""
+    spool = str(tmp_path)
+    reg_values = []
+    now = time.time()
+    for i in range(3):
+        ident = _identity(f"w{i}", i)
+        reg = obs.Metrics()
+        reg.counters.incr("Serve.m", "Requests", 11 * (i + 1))
+        reg_values.append(11 * (i + 1))
+        _plant_feed(spool, ident, telemetry.build_snapshot(registry=reg),
+                    now)
+    agg = FleetAggregator(spool, JobConfig({}))
+    agg.scan(now=now)
+    out = {}
+    agg.dispatch_line(json.dumps({"cmd": "metrics"}), out.update)
+    m = re.search(r'avenir_counter_total\{group="Serve.m",'
+                  r'name="Requests"\} (\d+)', out["_text"])
+    assert int(m.group(1)) == sum(reg_values)
+    stats = {}
+    agg.dispatch_line(json.dumps({"cmd": "stats"}), stats.update)
+    assert len(stats["feeds"]) == 3
+    assert all(v["role"].startswith("w") for v in stats["feeds"].values())
+
+
+# ---------------------------------------------------------------------------
+# stitching + incident correlation
+# ---------------------------------------------------------------------------
+
+TRACE_ID = "cafe0123deadbeef"
+
+
+def _plant_trace_feed(spool, ident: ProcessIdentity, spans) -> str:
+    d = os.path.join(spool, ident.label)
+    os.makedirs(os.path.join(d, FLIGHT_SUBDIR), exist_ok=True)
+    atomic_write_text(os.path.join(d, IDENTITY_FILE),
+                      json.dumps(ident.to_dict()))
+    with open(os.path.join(d, TRACE_FILE), "w") as fh:
+        for s in spans:
+            fh.write(json.dumps(s) + "\n")
+    return d
+
+
+def _span(name, sid, parent, t0_ns, dur_ns, trace=TRACE_ID):
+    return {"type": "span", "name": name, "id": sid, "parent": parent,
+            "thread": "t0", "t0_ns": t0_ns, "dur_ns": dur_ns,
+            "attrs": {"trace": trace}}
+
+
+def test_stitch_golden_two_process_connected_trace(tmp_path):
+    """Two feeds sharing one trace id stitch into ONE Perfetto file:
+    one process lane per feed, parent/child ids intact, and the second
+    process's spans shifted by the wall-clock epoch delta."""
+    spool = str(tmp_path)
+    a = _identity("gateway", 0)     # epoch 1_000_000_000
+    b = ProcessIdentity(role="scorer", host="testhost", pid=1001,
+                        start_ns=2,
+                        trace_epoch_unix_ns=1_000_000_000 + 500_000)
+    _plant_trace_feed(spool, a, [
+        _span("serve.request", 1, None, 100_000, 900_000),
+        _span("noise", 9, None, 0, 1, trace="other"),
+    ])
+    _plant_trace_feed(spool, b, [
+        _span("serve.score", 2, 1, 50_000, 200_000),
+    ])
+    out = str(tmp_path / "fleet-trace.json")
+    n, labels = stitch_traces(spool, trace_id=TRACE_ID, out_path=out)
+    assert sorted(labels) == sorted([a.label, b.label])
+    doc = json.load(open(out))
+    xs = {e["args"]["id"]: e for e in doc["traceEvents"]
+          if e["ph"] == "X"}
+    assert sorted(xs) == [1, 2]                 # the "other" span filtered
+    assert xs[2]["args"]["parent"] == 1         # connected across processes
+    assert xs[1]["pid"] != xs[2]["pid"]         # one lane per process
+    # wall-clock alignment: b's epoch is 500us after a's, so span 2
+    # lands at 500 + 50 = 550us on the fleet timeline (a's span: 100us)
+    assert xs[1]["ts"] == pytest.approx(100.0)
+    assert xs[2]["ts"] == pytest.approx(550.0)
+    lanes = {e["pid"]: e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert sorted(lanes.values()) == sorted([a.label, b.label])
+
+
+def test_stitch_no_trace_filter_takes_everything(tmp_path):
+    spool = str(tmp_path)
+    a = _identity("p", 0)
+    _plant_trace_feed(spool, a, [
+        _span("x", 1, None, 0, 10),
+        _span("y", 2, None, 20, 10, trace="other"),
+        {"type": "gauge", "name": "q", "t_ns": 5, "value": 3},
+    ])
+    n, labels = stitch_traces(spool, trace_id=None,
+                              out_path=str(tmp_path / "t.json"))
+    doc = json.load(open(str(tmp_path / "t.json")))
+    assert sum(1 for e in doc["traceEvents"] if e["ph"] == "X") == 2
+    assert sum(1 for e in doc["traceEvents"] if e["ph"] == "C") == 1
+
+
+def _plant_dump(feed_dir, reason, trace_id):
+    tag = trace_id or "1234567"
+    p = os.path.join(feed_dir, FLIGHT_SUBDIR,
+                     f"flight-{reason}-{tag}.jsonl")
+    with open(p, "w") as fh:
+        fh.write(json.dumps({"kind": "flight.header", "reason": reason,
+                             "trace_id": trace_id, "ts": time.time(),
+                             "pid": 1, "ring_records": 0}) + "\n")
+        fh.write(json.dumps({"t": 0.0, "kind": "anomaly",
+                             "reason": reason}) + "\n")
+    return p
+
+
+def test_incident_bundle_correlates_by_header_trace_id(tmp_path):
+    """Dumps in DIFFERENT processes sharing a trace id land in ONE
+    incident directory, with each feed's trace tail; a second scan
+    re-bundles nothing."""
+    spool = str(tmp_path / "spool")
+    a = _identity("gw", 0)
+    b = _identity("sc", 1)
+    da = _plant_trace_feed(spool, a, [_span("req", 1, None, 0, 10)])
+    db = _plant_trace_feed(spool, b, [_span("score", 2, 1, 5, 3)])
+    _plant_dump(da, "deadline", TRACE_ID)
+    _plant_dump(db, "breaker_open", TRACE_ID)
+    _plant_dump(db, "unrelated", None)
+    corr = IncidentCorrelator(str(tmp_path / "incidents"))
+    made = corr.scan({a.label: da, b.label: db})
+    assert len(made) == 2       # the correlated pair + the untraced one
+    traced = [d for d in made if TRACE_ID[:8] in os.path.basename(d)]
+    assert len(traced) == 1
+    man = json.load(open(os.path.join(traced[0], "manifest.json")))
+    assert man["trace_id"] == TRACE_ID
+    dump_feeds = {m["feed"] for m in man["members"] if "dump" in m}
+    assert dump_feeds == {a.label, b.label}
+    tails = [m for m in man["members"] if "trace_tail" in m]
+    assert {m["feed"] for m in tails} == {a.label, b.label}
+    assert corr.scan({a.label: da, b.label: db}) == []
+
+
+def test_trace_tail_filters_by_trace_id(tmp_path):
+    spool = str(tmp_path)
+    a = _identity("p", 0)
+    d = _plant_trace_feed(spool, a, [
+        _span("x", 1, None, 0, 10),
+        _span("y", 2, None, 20, 10, trace="other"),
+    ])
+    tail = trace_tail(d, TRACE_ID)
+    assert [r["id"] for r in tail] == [1]
+
+
+# ---------------------------------------------------------------------------
+# publisher <-> exporter integration, identity, flight routing
+# ---------------------------------------------------------------------------
+
+def test_publisher_rides_exporter_tick(tmp_path):
+    spool = str(tmp_path)
+    config = JobConfig({"fleetobs.spool.dir": spool,
+                        "fleetobs.role": "unit"})
+    pub = publisher_for_job(config, role="fallback")
+    assert pub is not None and pub.identity.role == "unit"
+    # flight dumps route into the feed's spool unless configured away
+    assert config.get(flight.KEY_DUMP_DIR) == pub.flight_dir
+    exporter = telemetry.TelemetryExporter(interval_sec=3600.0)
+    exporter = pub.attach(exporter, config)
+    exporter.tick()
+    doc = json.load(open(pub.snapshot_path))
+    assert doc["seq"] == 1
+    assert doc["snapshot"]["identity"]["role"] == "unit"
+    exporter.tick()
+    assert json.load(open(pub.snapshot_path))["seq"] == 2
+    assert json.load(open(
+        os.path.join(pub.dir, IDENTITY_FILE)))["label"] == pub.identity.label
+
+
+def test_publisher_for_job_none_without_spool():
+    assert publisher_for_job(JobConfig({}), role="serve") is None
+
+
+def test_identity_label_is_filesystem_and_label_safe():
+    ident = ProcessIdentity(role='we"ird/role', host="h ost", pid=1,
+                            start_ns=7, trace_epoch_unix_ns=1)
+    assert re.fullmatch(r"[A-Za-z0-9._-]+", ident.label)
+    rt = ProcessIdentity.from_dict(ident.to_dict())
+    assert rt.label == ident.label and rt.pid == ident.pid
+
+
+def test_new_identity_anchors_to_tracer_epoch():
+    tr = obs.Tracer()
+    ident = new_identity("serve", tracer=tr)
+    # the anchor is the tracer's wall-clock epoch, good to ~ms
+    assert abs(ident.trace_epoch_unix_ns
+               - tr.wall_epoch_unix_ns()) < 50_000_000
+
+
+def test_read_dump_header(tmp_path):
+    d = str(tmp_path)
+    os.makedirs(os.path.join(d, FLIGHT_SUBDIR))
+    p = _plant_dump(d, "r", TRACE_ID)
+    h = flight.read_dump_header(p)
+    assert h["reason"] == "r" and h["trace_id"] == TRACE_ID
+    bad = os.path.join(d, "not-a-dump.jsonl")
+    open(bad, "w").write("{}\n")
+    assert flight.read_dump_header(bad) is None
+    assert flight.read_dump_header(os.path.join(d, "missing")) is None
+
+
+# ---------------------------------------------------------------------------
+# workload fleet-snapshot mode: the 2-process smoke
+# ---------------------------------------------------------------------------
+
+_SIBLING_SCRIPT = """
+import json, sys
+from avenir_tpu.core import obs, telemetry
+from avenir_tpu.core.config import JobConfig
+from avenir_tpu.fleetobs import publisher_for_job
+config = JobConfig({"fleetobs.spool.dir": sys.argv[1],
+                    "fleetobs.role": "sibling"})
+pub = publisher_for_job(config, role="sibling")
+reg = obs.Metrics()
+reg.counters.incr("Sibling", "Widgets", 7)
+pub.publish(telemetry.build_snapshot(registry=reg,
+                                     identity=pub.identity.to_dict()))
+print(pub.identity.label)
+"""
+
+WL_FLEET_MANIFEST = """
+workload.scenario.name=fleetsmoke
+workload.seed=99
+workload.threads=2
+workload.target=serve
+workload.bootstrap=churn_nb
+workload.warmup.requests=4
+workload.fleet.snapshot=true
+workload.phases=only
+workload.phase.only.arrival=constant
+workload.phase.only.rate=30
+workload.phase.only.duration.sec=0.6
+workload.phase.only.slo.error.max.fraction=0.0
+serve.warmup=true
+serve.port=0
+"""
+
+
+def test_workload_fleet_snapshot_two_process(tmp_path):
+    """``workload.fleet.snapshot=true``: the run's phase/final snapshots
+    fold the whole spool — a second process's published feed shows up
+    in the verdict's fleet section and in telemetry.json."""
+    from avenir_tpu.workload.runner import run_scenario
+
+    import avenir_tpu
+
+    spool = str(tmp_path / "spool")
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(avenir_tpu.__file__)))
+    sib = subprocess.run(
+        [sys.executable, "-c", _SIBLING_SCRIPT, spool],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": repo_root + os.pathsep
+             + os.environ.get("PYTHONPATH", "")})
+    assert sib.returncode == 0, sib.stderr
+    sib_label = sib.stdout.strip()
+
+    config = JobConfig(parse_properties(WL_FLEET_MANIFEST))
+    config.set("workload.out.dir", str(tmp_path / "out"))
+    config.set("fleetobs.spool.dir", spool)
+    config.set("fleetobs.role", "wl")
+    assert run_scenario(config, do_assert=True) == 0
+    merged = json.load(open(str(tmp_path / "out" / "telemetry.json")))
+    assert merged["counters"]["Sibling"]["Widgets"] == 7
+    verdict = json.load(open(str(tmp_path / "out" / "verdict.json")))
+    assert verdict["fleet"]["source"] == "fleetobs-spool"
+    assert sib_label in verdict["fleet"]["feeds"]
+    assert len(verdict["fleet"]["feeds"]) == 2
+
+
+def test_workload_fleet_snapshot_requires_spool(tmp_path):
+    from avenir_tpu.workload.runner import run_scenario
+
+    config = JobConfig(parse_properties(WL_FLEET_MANIFEST))
+    config.set("workload.out.dir", str(tmp_path / "out"))
+    with pytest.raises(KeyError, match="fleetobs.spool.dir"):
+        run_scenario(config)
