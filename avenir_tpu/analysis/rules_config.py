@@ -51,6 +51,10 @@ NAMESPACE_GROUPS: Dict[str, str] = {
     # the fleet observability plane (avenir_tpu/fleetobs): spool
     # publisher + cross-process aggregator keys
     "fleetobs": r"(?:fleetobs)",
+    # the pod-scale fleet router (serve/fleet): dispatch, feed-watch,
+    # autoscale/residency control keys.  Anchored `router` — distinct
+    # from the in-process variant router's serve.router.* family
+    "router": r"(?:router)",
 }
 
 _ACCESSORS = (r"\.(?:get|get_int|get_float|get_boolean|get_list|must|"

@@ -329,6 +329,8 @@ def main(argv=None) -> int:
               file=sys.stderr)
         print("       python -m avenir_tpu fleetobs stitch --spool <dir> [--trace-id X] [--out f.json]",
               file=sys.stderr)
+        print("       python -m avenir_tpu router -Drouter.backends=host:p1,host:p2 [-Drouter.port=N]",
+              file=sys.stderr)
         print("       python -m avenir_tpu analyze [--strict] [--json report.json] [--rules a,b] [--list]",
               file=sys.stderr)
         print("                                    [--dynamic] [--seeds N] [--baseline findings.json] [--update-baseline] [--no-cache]",
@@ -376,6 +378,14 @@ def main(argv=None) -> int:
         # jax-free by design.
         from .fleetobs.aggregator import fleetobs_main
         return fleetobs_main(rest)
+    if job_name == "router":
+        # fleet router tier (avenir_tpu/serve/fleet): SLO-fed
+        # least-loaded dispatch over N backend serving processes, with
+        # failover, autoscaling, and residency coordination.  NO
+        # _init_runtime(): the router is jax-free by design — it moves
+        # bytes and reads feeds, it never scores.
+        from .serve.fleet.router import router_main
+        return router_main(rest)
     # --trace <out.json>: record core.obs spans for the whole job and
     # export them as Chrome/Perfetto trace_event JSON on exit
     rest, trace_path = extract_trace_flag(rest)

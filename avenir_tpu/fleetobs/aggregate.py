@@ -114,3 +114,24 @@ class FleetSLO:
 
     def section(self) -> Dict[str, dict]:
         return self.board.section()
+
+    def verdicts(self) -> Dict[str, dict]:
+        """Machine-readable per-model SLO verdict from the LAST
+        evaluation — the router tier's routing input and the aggregator
+        ``stats`` surface, so runbooks stop recomputing it from merged
+        histograms.  ``ok`` is the dispatch-grade bit: False the moment
+        the window violates (the sustained bit additionally marks the
+        degrade-grade signal)."""
+        out: Dict[str, dict] = {}
+        for model, stats in self.board.section().items():
+            out[model] = {
+                "ok": not (stats.get("violation")
+                           or stats.get("sustained")),
+                "p99_ms": stats.get("p99_ms"),
+                "target_p99_ms": stats.get("target_p99_ms"),
+                "error_pct": stats.get("error_pct"),
+                "violation": bool(stats.get("violation")),
+                "sustained": bool(stats.get("sustained")),
+                "n": stats.get("n", 0),
+            }
+        return out

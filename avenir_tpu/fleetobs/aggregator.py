@@ -83,7 +83,13 @@ class FleetAggregator:
         self.stale_sec = config.get_float(KEY_STALE_SEC, DEFAULT_STALE_SEC)
         incident_dir = (config.get(KEY_INCIDENT_DIR)
                         or os.path.join(spool_dir, "_incidents"))
+        self.config = config
         self.fleet_slo = FleetSLO(config)
+        # per-feed SLO boards: the same rolling-window evaluation the
+        # fleet board runs on the merged snapshot, applied to each RAW
+        # feed — machine-readable per-feed, per-model verdicts in
+        # ``stats`` so routers and runbooks stop recomputing them
+        self._feed_slo: Dict[str, FleetSLO] = {}
         self.incidents = IncidentCorrelator(incident_dir)
         self._feeds: Dict[str, _Feed] = {}
         self._lock = sanitizer.make_lock("fleetobs.aggregator")
@@ -121,6 +127,16 @@ class FleetAggregator:
                         stale_sec=self.stale_sec)
             merged = self._fleet_snapshot(now)
             self.scans += 1
+            snapshots = [(f.label, f.snapshot)
+                         for f in self._feeds.values()
+                         if f.snapshot is not None]
+        for label, snap in snapshots:
+            with self._lock:
+                slo = self._feed_slo.get(label)
+                if slo is None:
+                    slo = self._feed_slo[label] = FleetSLO(self.config)
+            # fold OFF the lock: window math must not block dispatch
+            slo.observe(snap)
         dirs = {f.label: f.dir for f in self._feeds.values()}
         # the aggregator's own black box (feed-stale anomalies land in
         # the reserved _aggregator spool entry) correlates too — a feed
@@ -223,11 +239,14 @@ class FleetAggregator:
                 "age_sec": (round(now - f.published_unix, 3)
                             if f.published_unix else None),
                 "stale": f.stale,
+                "slo": (self._feed_slo[f.label].verdicts()
+                        if f.label in self._feed_slo else {}),
             } for f in sorted(self._feeds.values(),
                               key=lambda f: f.label)}
             scans = self.scans
         return {"feeds": feeds, "scans": scans,
                 "slo": self.fleet_slo.section(),
+                "slo_verdicts": self.fleet_slo.verdicts(),
                 "incidents": self.incidents.bundled,
                 "flight": flight.get_recorder().stats()}
 

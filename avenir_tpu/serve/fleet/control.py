@@ -1,0 +1,199 @@
+"""The router's coordination loops: replica autoscaling + residency.
+
+Both loops run on one ``avenir-fleet-control`` thread (joined on stop)
+because they act on the same signals and must not fight each other.
+
+**Autoscaling** (INFaaS-style, PAPERS.md): per control tick the router
+computes each model's observed fleet arrival rate (its own forwarded
+counters diffed over the tick — the router sees every request, so no
+feed lag) and targets ``ceil(rate / router.autoscale.qps.per.replica)``
+replicas per backend, clamped to
+``router.autoscale.{min,max}.replicas``.  Scale commands ride the
+backend's ``{"cmd": "scale"}`` verb, whose grow path is the pre-swap
+replica build — nothing observable changes on the backend until the new
+replicas fully exist.  Decisions are deliberately sluggish: at most one
+scale action per model per ``router.autoscale.hold.sec``, and a DOWN
+decision must persist for a full hold window before it fires (scale-up
+hysteresis is asymmetric on purpose — adding capacity late costs p99,
+removing it late costs only memory).
+
+**Residency coordination** (PR 14 tenants): with
+``router.residency.replicas=k`` configured, the loop watches the feed
+residency view and promote-nudges a model seen in traffic onto the
+least-loaded backends until exactly k hold it resident — instead of all
+N backends independently promoting the same hot tenant.  Dispatch
+prefers resident backends on its own (the SLO verdicts and cold-start
+flags already demote non-resident ones); the loop only fixes the
+steady-state shape.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ...core import flight, sanitizer
+from .backend import BackendLink
+
+KEY_AUTOSCALE = "router.autoscale.enable"
+KEY_QPS_PER_REPLICA = "router.autoscale.qps.per.replica"
+KEY_MIN_REPLICAS = "router.autoscale.min.replicas"
+KEY_MAX_REPLICAS = "router.autoscale.max.replicas"
+KEY_HOLD_SEC = "router.autoscale.hold.sec"
+KEY_RESIDENCY_K = "router.residency.replicas"
+KEY_CONTROL_SEC = "router.control.interval.sec"
+
+DEFAULT_QPS_PER_REPLICA = 50.0
+DEFAULT_MIN_REPLICAS = 1
+DEFAULT_MAX_REPLICAS = 4
+DEFAULT_HOLD_SEC = 10.0
+DEFAULT_CONTROL_SEC = 2.0
+
+COMMAND_TIMEOUT_SEC = 15.0
+
+THREAD_NAME = "avenir-fleet-control"
+
+
+class ControlLoop:
+    """Rate-limited, hysteretic fleet control over the backend links."""
+
+    def __init__(self, config, links: List[BackendLink], watch,
+                 rates_fn: Callable[[], Dict[str, float]]):
+        self.links = links
+        self.watch = watch          # Optional[FeedWatch]
+        self.rates_fn = rates_fn
+        self.autoscale = config.get_boolean(KEY_AUTOSCALE, False)
+        self.qps_per_replica = config.get_float(KEY_QPS_PER_REPLICA,
+                                                DEFAULT_QPS_PER_REPLICA)
+        self.min_replicas = config.get_int(KEY_MIN_REPLICAS,
+                                           DEFAULT_MIN_REPLICAS)
+        self.max_replicas = config.get_int(KEY_MAX_REPLICAS,
+                                           DEFAULT_MAX_REPLICAS)
+        self.hold_sec = config.get_float(KEY_HOLD_SEC, DEFAULT_HOLD_SEC)
+        self.residency_k = config.get_int(KEY_RESIDENCY_K, 0)
+        self.interval = config.get_float(KEY_CONTROL_SEC,
+                                         DEFAULT_CONTROL_SEC)
+        self._lock = sanitizer.make_lock("fleet.control")
+        self._issued: Dict[str, int] = {}       # model -> last scale sent
+        self._last_scale: Dict[str, float] = {}
+        self._down_since: Dict[str, float] = {}
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.promotes = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- one tick ----------------------------------------------------------
+    def step(self, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else float(now)
+        rates = self.rates_fn()
+        if self.autoscale and self.qps_per_replica > 0:
+            for model in sorted(rates):
+                self._autoscale_model(model, rates[model], now)
+        if self.residency_k > 0 and self.watch is not None:
+            for model in sorted(rates):
+                self._nudge_residency(model)
+
+    def _current_replicas(self, model: str) -> int:
+        with self._lock:
+            issued = self._issued.get(model)
+        if issued is not None:
+            return issued
+        if self.watch is not None:
+            observed = self.watch.replicas(model)
+            if observed:
+                return max(observed.values())
+        return self.min_replicas
+
+    def _autoscale_model(self, model: str, rate: float,
+                         now: float) -> None:
+        desired = min(self.max_replicas,
+                      max(self.min_replicas,
+                          int(math.ceil(rate / self.qps_per_replica)))
+                      if rate > 0 else self.min_replicas)
+        current = self._current_replicas(model)
+        with self._lock:
+            last = self._last_scale.get(model, -self.hold_sec)
+            if desired == current:
+                self._down_since.pop(model, None)
+                return
+            if desired > current:
+                self._down_since.pop(model, None)
+                if now - last < self.hold_sec:
+                    return
+            else:
+                t0 = self._down_since.setdefault(model, now)
+                if now - t0 < self.hold_sec or now - last < self.hold_sec:
+                    return
+                self._down_since.pop(model, None)
+            self._last_scale[model] = now
+            self._issued[model] = desired
+            if desired > current:
+                self.scale_ups += 1
+            else:
+                self.scale_downs += 1
+        # fan out OFF the lock: scale commands block on replica builds
+        acks = 0
+        for link in self.links:
+            resp = link.command(
+                {"cmd": "scale", "model": model, "replicas": desired},
+                COMMAND_TIMEOUT_SEC)
+            if resp is not None and resp.get("ok"):
+                acks += 1
+        flight.record("fleet.autoscale", model=model, rate=round(rate, 2),
+                      replicas=desired, previous=current, acks=acks)
+
+    def _nudge_residency(self, model: str) -> None:
+        resident = set(self.watch.residency(model))
+        missing = self.residency_k - len(resident)
+        if missing <= 0:
+            return
+        candidates = sorted(
+            (link for link in self.links
+             if link.name not in resident and link.alive()),
+            key=lambda link: link.inflight())
+        for link in candidates[:missing]:
+            resp = link.command(
+                {"cmd": "promote", "model": model, "wait": False},
+                COMMAND_TIMEOUT_SEC)
+            # backends without a model cache answer with an error —
+            # residency nudging simply does not apply to them
+            if resp is not None and "error" not in resp:
+                with self._lock:
+                    self.promotes += 1
+
+    def section(self) -> dict:
+        with self._lock:
+            return {"autoscale": self.autoscale,
+                    "residency_replicas": self.residency_k,
+                    "scale_ups": self.scale_ups,
+                    "scale_downs": self.scale_downs,
+                    "promotes": self.promotes,
+                    "issued": dict(self._issued)}
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "ControlLoop":
+        enabled = self.autoscale or self.residency_k > 0
+        if not enabled or self.interval <= 0 or self._thread is not None:
+            return self
+
+        def run():
+            while not self._stop.wait(self.interval):
+                try:
+                    self.step()
+                except Exception:                       # noqa: BLE001
+                    pass        # one bad tick must not kill control
+
+        self._thread = threading.Thread(target=run, name=THREAD_NAME,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=10)
+            self._thread = None

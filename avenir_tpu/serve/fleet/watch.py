@@ -1,0 +1,245 @@
+"""Feed watch: the router's SLO-and-residency eyes on the spool.
+
+The fleetobs plane (PR 18) already makes every backend publish an
+atomic ``snapshot.json`` into its spool feed; this module consumes
+those feeds AS A LIBRARY — no aggregator process required — and folds
+each backend's RAW per-process snapshot into a rolling per-backend
+:class:`~avenir_tpu.fleetobs.aggregate.FleetSLO` view.  Per poll tick,
+for every backend the watch knows:
+
+- **binding**: which feed belongs to which configured backend, matched
+  through the ``serve.frontend.port`` gauge each serving process
+  publishes (labels carry host+pid, but the port is what the router
+  dials);
+- **staleness**: feed age vs ``router.feed.stale.sec`` — a dead or
+  wedged backend stops publishing before it stops accepting, so
+  staleness demotes it in the dispatch ladder ahead of request
+  failures;
+- **per-model SLO verdicts**: the same rolling-window code that
+  watches a single process, evaluated per backend, plus the backend's
+  own soft-degrade gauges;
+- **residency + replica count**: which models the backend currently
+  serves (``serve.e2e.latency{model=}`` histogram presence) and at how
+  many replicas (``serve.replica.worker.alive`` gauges) — the
+  residency-coordination and autoscale inputs.
+
+The poll thread is named ``avenir-fleet-watch`` and joined on stop.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ...core import sanitizer, telemetry
+from ...fleetobs.aggregate import E2E_FAMILY, FleetSLO, parse_labels
+from ...fleetobs.publisher import SNAPSHOT_FILE
+from ...fleetobs.stitch import feed_dirs
+
+KEY_POLL_SEC = "router.poll.sec"
+KEY_FEED_STALE_SEC = "router.feed.stale.sec"
+
+DEFAULT_POLL_SEC = 1.0
+DEFAULT_FEED_STALE_SEC = 10.0
+
+#: the binding gauge a serving process publishes (serve/server.py)
+PORT_GAUGE = "serve.frontend.port"
+DEGRADED_GAUGE = "serve.breaker.soft.degraded"
+REPLICA_GAUGE = "serve.replica.worker.alive"
+
+THREAD_NAME = "avenir-fleet-watch"
+
+
+class BackendView:
+    """One backend's last-observed feed state."""
+
+    __slots__ = ("name", "label", "published_unix", "seq", "stale",
+                 "resident", "degraded", "replicas", "verdicts")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.label: Optional[str] = None
+        self.published_unix = 0.0
+        self.seq = 0
+        self.stale = False
+        self.resident: set = set()
+        self.degraded: set = set()
+        self.replicas: Dict[str, int] = {}
+        self.verdicts: Dict[str, dict] = {}
+
+    def section(self) -> dict:
+        return {"label": self.label, "seq": self.seq,
+                "stale": self.stale,
+                "resident": sorted(self.resident),
+                "degraded": sorted(self.degraded),
+                "replicas": dict(self.replicas),
+                "slo": self.verdicts}
+
+
+def _parse_snapshot(snap: dict) -> dict:
+    """Pull the routing-relevant facts out of one RAW feed snapshot."""
+    gauges = snap.get("gauges") or {}
+    port = None
+    degraded = set()
+    replicas: Dict[str, set] = {}
+    for name, g in gauges.items():
+        m = telemetry._LABELED_RE.match(name)
+        family = m.group(1) if m else name
+        labels = parse_labels(m.group(2)) if m else {}
+        try:
+            value = float((g or {}).get("value", 0.0))
+        except (TypeError, ValueError):
+            continue
+        if family == PORT_GAUGE:
+            port = int(value)
+        elif family == DEGRADED_GAUGE and value >= 1.0:
+            model = labels.get("model")
+            if model:
+                degraded.add(model)
+        elif family == REPLICA_GAUGE:
+            model = labels.get("model")
+            if model:
+                replicas.setdefault(model, set()).add(
+                    labels.get("replica", "0"))
+    resident = set()
+    for name in (snap.get("hists") or {}):
+        m = telemetry._LABELED_RE.match(name)
+        if m and m.group(1) == E2E_FAMILY:
+            model = parse_labels(m.group(2)).get("model")
+            if model:
+                resident.add(model)
+    return {"port": port, "degraded": degraded, "resident": resident,
+            "replicas": {k: len(v) for k, v in replicas.items()}}
+
+
+class FeedWatch:
+    """Poll thread mapping spool feeds onto configured backends."""
+
+    def __init__(self, config, spool_dir: str, backend_names: List[str]):
+        self.config = config
+        self.spool_dir = spool_dir
+        self.poll_sec = config.get_float(KEY_POLL_SEC, DEFAULT_POLL_SEC)
+        self.stale_sec = config.get_float(KEY_FEED_STALE_SEC,
+                                          DEFAULT_FEED_STALE_SEC)
+        self._port_to_name = {int(n.rsplit(":", 1)[1]): n
+                              for n in backend_names}
+        self._views: Dict[str, BackendView] = {
+            n: BackendView(n) for n in backend_names}
+        self._slo: Dict[str, FleetSLO] = {}
+        self._lock = sanitizer.make_lock("fleet.watch")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.scans = 0
+
+    # -- polling -----------------------------------------------------------
+    def scan(self, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else float(now)
+        observed = []          # (name, snapshot) to evaluate off-lock
+        with self._lock:
+            for d in feed_dirs(self.spool_dir):
+                try:
+                    with open(os.path.join(d, SNAPSHOT_FILE)) as fh:
+                        doc = json.load(fh)
+                except (OSError, ValueError):
+                    continue    # not yet published / torn on a weird fs
+                snap = doc.get("snapshot")
+                if not isinstance(snap, dict):
+                    continue
+                facts = _parse_snapshot(snap)
+                name = self._port_to_name.get(facts["port"] or -1)
+                if name is None:
+                    continue    # a feed of some other process (router,
+                                # workload, a backend not ours)
+                view = self._views[name]
+                view.label = str(doc.get("label") or "")
+                view.seq = int(doc.get("seq", 0))
+                view.published_unix = float(
+                    doc.get("published_unix", 0.0))
+                view.resident = facts["resident"]
+                view.degraded = facts["degraded"]
+                view.replicas = facts["replicas"]
+                observed.append((name, snap))
+            for view in self._views.values():
+                view.stale = (view.published_unix > 0
+                              and now - view.published_unix
+                              > self.stale_sec)
+            self.scans += 1
+        for name, snap in observed:
+            with self._lock:
+                slo = self._slo.get(name)
+                if slo is None:
+                    slo = self._slo[name] = FleetSLO(self.config)
+            # fold OFF the lock: window math must not block healthy()
+            slo.observe(snap)
+            verdicts = slo.verdicts()
+            with self._lock:
+                self._views[name].verdicts = verdicts
+
+    # -- the router's read surface ----------------------------------------
+    def healthy(self, name: str, model: Optional[str] = None) -> bool:
+        """Dispatch-grade health: the backend's feed is fresh, the model
+        is not soft-degraded there, and its rolling window is not in
+        violation.  A backend never observed yet is OPTIMISTICALLY
+        healthy — feeds lag process start, and a cold fleet must still
+        route (mirrors the variant router's no-data optimism)."""
+        with self._lock:
+            view = self._views.get(name)
+            if view is None or view.published_unix == 0:
+                return True
+            if view.stale:
+                return False
+            if model is not None:
+                if model in view.degraded:
+                    return False
+                verdict = view.verdicts.get(model)
+                if verdict is not None and not verdict.get("ok", True):
+                    return False
+            return True
+
+    def residency(self, model: str) -> List[str]:
+        """Backends whose feed shows the model resident, fresh feeds
+        first (a stale feed's residency claim is history, not state)."""
+        with self._lock:
+            fresh = [v.name for v in self._views.values()
+                     if not v.stale and model in v.resident]
+            return sorted(fresh)
+
+    def replicas(self, model: str) -> Dict[str, int]:
+        with self._lock:
+            return {v.name: v.replicas.get(model, 0)
+                    for v in self._views.values()
+                    if model in v.replicas}
+
+    def section(self) -> dict:
+        with self._lock:
+            return {"scans": self.scans,
+                    "stale_sec": self.stale_sec,
+                    "backends": {n: v.section()
+                                 for n, v in sorted(self._views.items())}}
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "FeedWatch":
+        if self.poll_sec <= 0 or self._thread is not None:
+            return self
+
+        def run():
+            while not self._stop.wait(self.poll_sec):
+                try:
+                    self.scan()
+                except Exception:                       # noqa: BLE001
+                    pass        # one bad pass must not blind the router
+
+        self._thread = threading.Thread(target=run, name=THREAD_NAME,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=10)
+            self._thread = None

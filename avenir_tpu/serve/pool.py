@@ -674,6 +674,59 @@ class ScorerPool:
         self.registry.adopt(head)
         return primary if primary is not None else head
 
+    def scale(self, model: str, replicas: int,
+              variant: Optional[str] = None) -> dict:
+        """Grow or shrink a model's replica sets IN PLACE (the fleet
+        router's autoscale command).  Growth rides the pre-swap build
+        discipline: every new replica is fully built before any group's
+        replica list changes, so a build failure leaves the old shape
+        serving untouched.  Shrink retires the TAIL replicas with a
+        draining close (queued requests complete on the retiring
+        batcher).  The new count is persisted as the model's
+        ``serve.model.<name>.pool.replicas`` override so later reloads
+        rebuild at the scaled size."""
+        n = int(replicas)
+        if n < 1:
+            raise ValueError(f"replicas must be >= 1: {replicas}")
+        groups = {g.variant: g for g in self.variant_groups(model)}
+        if variant is not None and variant not in groups:
+            raise KeyError(f"model {model!r} has no variant {variant!r}")
+        scope = [g for v, g in groups.items()
+                 if variant is None or v == variant]
+        before = max(len(g.replicas) for g in scope)
+        devices = _devices_for(n)
+        plans = []          # (group, new_reps, retired)
+        built: List[Replica] = []
+        try:
+            for g in scope:
+                cur = list(g.replicas)
+                if n > len(cur):
+                    fresh = [self._build_replica(model, g.variant, i,
+                                                 devices[i])
+                             for i in range(len(cur), n)]
+                    built.extend(fresh)
+                    plans.append((g, cur + fresh, []))
+                elif n < len(cur):
+                    plans.append((g, cur[:n], cur[n:]))
+        except BaseException:
+            for rep in built:
+                rep.batcher.close(drain=False)
+            raise
+        for g, new_reps, retired in plans:
+            # swap first, drain after — same ordering as reload; growth
+            # keeps the existing replicas' batchers (and their windows'
+            # source hists) but the facade identity still changes so the
+            # variant's SLO window restarts at the new aggregate shape
+            g.replicas = new_reps
+            g.stats_facade = _GroupStats(g)
+            g.set_soft_degraded(False)
+            for rep in retired:
+                rep.batcher.close(drain=True)
+        if variant is None and plans:
+            self.config.set(f"serve.model.{model}.pool.replicas", str(n))
+        return {"model": model, "replicas": n, "previous": before,
+                "scaled_groups": len(plans)}
+
     def close(self, drain: bool = False) -> None:
         with self._lock:
             groups = [g for gs in self.groups.values()

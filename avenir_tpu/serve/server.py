@@ -375,6 +375,9 @@ class PredictionServer:
                 g("serve.poison.quarantine.size", q.size(), model=name)
         if self._frontend is not None:
             g("serve.frontend.connections", self._frontend.connections())
+            # the fleet router binds spool feeds to its configured
+            # backends by matching this gauge against host:port targets
+            g("serve.frontend.port", self._frontend.port)
         if self.cache is not None:
             # managed-cache surface: residency/eviction/promote gauges +
             # the cold-start histogram (request-arrival -> resident, ms
@@ -530,6 +533,17 @@ class PredictionServer:
                 return {"error": 'promote needs "model" (string)'}
             ok = self.cache.promote(model, wait=bool(obj.get("wait", True)))
             return {"ok": ok, "model": model, "resident": ok}
+        if cmd == "scale":
+            # the fleet router's autoscale verb: resize a model's replica
+            # pools in place (pre-swap grow / draining-tail shrink)
+            model = obj.get("model") or self._default_model()
+            try:
+                n = int(obj.get("replicas"))
+            except (TypeError, ValueError):
+                return {"error": 'scale needs "replicas" (int >= 1)'}
+            out = self.pool.scale(model, n, variant=obj.get("variant"))
+            out["ok"] = True
+            return out
         if cmd == "demote":
             if self.cache is None:
                 return {"error": "no model cache configured "

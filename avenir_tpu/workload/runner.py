@@ -135,6 +135,21 @@ def build_target(scenario: Scenario):
     """Construct + start the in-process system under test; returns
     (stop fn, host, port, telemetry exporter, stats fn)."""
     config = scenario.config
+    if scenario.target_port:
+        # external target: the system under test is already running
+        # (e.g. a fleet router fronting N backends) — nothing to build,
+        # nothing to stop; stats go over the wire like any client, and
+        # a local exporter still collects THIS process's driver-side
+        # registry for the snapshot merge
+        from ..serve.server import request
+        host, port = scenario.target_host, scenario.target_port
+        exporter = telemetry.TelemetryExporter(0.0)
+
+        def stats_fn():
+            return request(host, port, {"cmd": "stats"},
+                           timeout=scenario.timeout_s)
+
+        return ((lambda: None), host, port, exporter, stats_fn)
     if config.get("serve.port") is None:
         config.set("serve.port", "0")
     host = config.get("serve.host", "127.0.0.1")
@@ -289,8 +304,9 @@ def run_scenario(config: JobConfig, do_assert: bool = False,
         compiles0 = _quiesce_compiles(stats_fn)
         for spec in scenario.phases:
             events = [e for e in schedule if e.phase == spec.name]
-            stats = fleet.run_phase(spec.name, events,
-                                    poison_phase=spec.poison_fraction > 0)
+            stats = fleet.run_phase(
+                spec.name, events,
+                poison_phase=spec.poison_fraction > 0 or spec.chaos)
             per_phase[spec.name] = stats
             phase_snapshots[spec.name], _ = run_snapshot(
                 scenario, exporter, fleet, publisher)

@@ -39,6 +39,8 @@ KEY_NAME = "workload.scenario.name"
 KEY_SEED = "workload.seed"
 KEY_THREADS = "workload.threads"
 KEY_TARGET = "workload.target"
+KEY_TARGET_HOST = "workload.target.host"
+KEY_TARGET_PORT = "workload.target.port"
 KEY_BOOTSTRAP = "workload.bootstrap"
 KEY_TENANTS = "workload.tenants"
 KEY_TENANTS_HOT = "workload.tenants.hot"
@@ -100,7 +102,7 @@ class PhaseSpec:
                  "surge_start_s", "surge_duration_s", "period_s",
                  "amplitude", "poison_fraction", "feedback_fraction",
                  "feedback_dup_fraction", "feedback_reorder_fraction",
-                 "feedback_lag_ms_max", "envelope")
+                 "feedback_lag_ms_max", "chaos", "envelope")
 
     def __init__(self, name: str, config: JobConfig):
         self.name = name
@@ -125,6 +127,11 @@ class PhaseSpec:
         self.period_s = gf("diurnal.period.sec")
         self.amplitude = gf("diurnal.amplitude", 0.5)
         self.poison_fraction = gf("poison.fraction", 0.0)
+        # chaos phases expect EXTERNAL failure injection (a harness
+        # killing a backend mid-phase); the flag arms the same
+        # dropped-innocents accounting poison phases get, so the
+        # envelope can declare "zero innocents dropped under the kill"
+        self.chaos = config.get_boolean(_phase_key(name, "chaos"), False)
         self.feedback_fraction = gf("feedback.fraction", 0.0)
         self.feedback_dup_fraction = gf("feedback.dup.fraction", 0.0)
         self.feedback_reorder_fraction = gf("feedback.reorder.fraction", 0.0)
@@ -141,11 +148,12 @@ class PhaseSpec:
 class Scenario:
     """A parsed scenario manifest: fleet shape + ordered phases."""
 
-    __slots__ = ("name", "seed", "threads", "target", "bootstrap",
-                 "tenants", "tenants_hot", "zipf_exponent",
-                 "payload_median", "payload_sigma", "payload_max",
-                 "phases", "out_dir", "timeout_s", "warmup_requests",
-                 "compile_flat", "fleet_snapshot", "config")
+    __slots__ = ("name", "seed", "threads", "target", "target_host",
+                 "target_port", "bootstrap", "tenants", "tenants_hot",
+                 "zipf_exponent", "payload_median", "payload_sigma",
+                 "payload_max", "phases", "out_dir", "timeout_s",
+                 "warmup_requests", "compile_flat", "fleet_snapshot",
+                 "config")
 
     def __init__(self, config: JobConfig):
         self.config = config
@@ -157,8 +165,15 @@ class Scenario:
             raise ValueError(
                 f"{KEY_TARGET} must be one of {TARGETS}, got "
                 f"{self.target!r}")
+        # external target: point the fleet at an ALREADY-RUNNING wire
+        # endpoint (a fleet router, a remote serve process) instead of
+        # building one in-process — port 0 means in-process (default)
+        self.target_host = config.get(KEY_TARGET_HOST, "127.0.0.1")
+        self.target_port = config.get_int(KEY_TARGET_PORT, 0)
         self.bootstrap = config.get(
-            KEY_BOOTSTRAP, "churn_nb" if self.target == "serve" else "none")
+            KEY_BOOTSTRAP,
+            "churn_nb" if self.target == "serve" and not self.target_port
+            else "none")
         if self.bootstrap not in BOOTSTRAPS:
             raise ValueError(
                 f"{KEY_BOOTSTRAP} must be one of {BOOTSTRAPS}, got "

@@ -1,0 +1,233 @@
+"""CI smoke for the pod-scale fleet router (~40s): two REAL serving
+processes behind the REAL jax-free router (`python -m avenir_tpu
+router`) over TCP, all publishing into one fleetobs spool.  The gate
+asserts the tentpole promises:
+
+- **byte parity** — a response through the router is byte-identical to
+  a direct backend connection;
+- **zero dropped innocents** — one backend is SIGKILLed mid-storm and
+  every innocent request still answers ok (retry-on-sibling);
+- **fleet-shaped stats** — the router's merged `stats` sums backend
+  counters;
+- **incident bundle** — the aggregator turns the killed backend's
+  stale feed into an incident bundle under `<spool>/_incidents/`.
+
+Usage: python resource/ci/router_smoke.py
+"""
+
+import json
+import os
+import re
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+STORM_REQUESTS = 240
+STORM_THREADS = 8
+KILL_AFTER = 60         # storm requests completed before the SIGKILL
+
+
+def _train(boot_dir):
+    """The workload harness's bootstrap artifact, reused verbatim."""
+    from avenir_tpu.core.config import JobConfig
+    from avenir_tpu.core.io import atomic_write_text, write_output
+    from avenir_tpu.datagen import gen_telecom_churn
+    from avenir_tpu.models.bayesian import BayesianDistribution
+    from avenir_tpu.workload.runner import (BOOTSTRAP_TRAIN_ROWS,
+                                            CHURN_SCHEMA)
+    schema_path = os.path.join(boot_dir, "teleComChurn.json")
+    model_path = os.path.join(boot_dir, "nb_model")
+    atomic_write_text(schema_path, json.dumps(CHURN_SCHEMA))
+    train_dir = os.path.join(boot_dir, "train")
+    rows = gen_telecom_churn(BOOTSTRAP_TRAIN_ROWS, seed=11)
+    write_output(train_dir, [",".join(r) for r in rows])
+    BayesianDistribution(JobConfig(
+        {"feature.schema.file.path": schema_path})).run(
+        train_dir, model_path)
+    return schema_path, model_path
+
+
+def _spawn_banner(args, env, pattern):
+    """Start a subprocess and parse its stderr banner for the port."""
+    proc = subprocess.Popen(args, env=env, stderr=subprocess.PIPE,
+                            text=True)
+    deadline = time.monotonic() + 120
+    while True:
+        line = proc.stderr.readline()
+        if not line and proc.poll() is not None:
+            raise SystemExit(f"process died before banner: {args}")
+        m = re.search(pattern, line or "")
+        if m:
+            # stop consuming stderr so the pipe can't block the child
+            threading.Thread(target=proc.stderr.read,
+                             daemon=True).start()
+            return proc, int(m.group(1))
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise SystemExit(f"no banner within 120s: {args}")
+
+
+def _raw_request(port, payload):
+    with socket.create_connection(("127.0.0.1", port), timeout=15) as s:
+        s.sendall(payload)
+        buf = b""
+        while not buf.endswith(b"\n"):
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    return buf
+
+
+def main() -> int:
+    work = tempfile.mkdtemp(prefix="router-smoke-")
+    spool = os.path.join(work, "spool")
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    procs = []
+    try:
+        schema_path, model_path = _train(os.path.join(work, "boot"))
+        serve_defs = [
+            "-Dserve.models=churn",
+            "-Dserve.model.churn.kind=naiveBayes",
+            f"-Dserve.model.churn.feature.schema.file.path={schema_path}",
+            f"-Dserve.model.churn.bayesian.model.file.path={model_path}",
+            "-Dserve.port=0", "-Dserve.warmup=false",
+            "-Dtelemetry.interval.sec=0.5",
+            f"-Dfleetobs.spool.dir={spool}"]
+        backends = []
+        for i in range(2):
+            proc, port = _spawn_banner(
+                [sys.executable, "-m", "avenir_tpu", "serve"]
+                + serve_defs, env, r"serving .* on 127\.0\.0\.1:(\d+)")
+            procs.append(proc)
+            backends.append((proc, port))
+        ports = [p for _, p in backends]
+
+        router_proc, router_port = _spawn_banner(
+            [sys.executable, "-m", "avenir_tpu", "router",
+             "-Drouter.backends=" + ",".join(str(p) for p in ports),
+             "-Drouter.port=0", "-Drouter.poll.sec=0.5",
+             "-Drouter.feed.stale.sec=3",
+             f"-Dfleetobs.spool.dir={spool}",
+             "-Dtelemetry.interval.sec=0.5"],
+            env, r"router: fronting .* on 127\.0\.0\.1:(\d+)")
+        procs.append(router_proc)
+
+        agg_proc, agg_port = _spawn_banner(
+            [sys.executable, "-m", "avenir_tpu", "fleetobs",
+             f"-Dfleetobs.spool.dir={spool}", "-Dfleetobs.port=0",
+             "-Dfleetobs.poll.sec=0.5", "-Dfleetobs.stale.sec=3"],
+            env, r":(\d+) \(poll")
+        procs.append(agg_proc)
+
+        from avenir_tpu.serve.server import request
+        from avenir_tpu.workload.generators import churn_row
+        import random
+        rng = random.Random(17)
+
+        # -- byte parity: router response == direct backend response --
+        row = churn_row(rng, 1)
+        payload = (json.dumps({"model": "churn", "row": row,
+                               "request_id": "parity-1"}) + "\n").encode()
+        direct = _raw_request(ports[0], payload)
+        routed = _raw_request(router_port, payload)
+        if routed != direct or b'"error"' in routed:
+            raise SystemExit(f"byte parity broken:\n direct={direct!r}\n"
+                             f" routed={routed!r}")
+
+        # -- storm + SIGKILL one backend: zero dropped innocents --
+        rows = [churn_row(rng, i) for i in range(STORM_REQUESTS)]
+        results = [None] * STORM_REQUESTS
+        done = threading.Semaphore(0)
+        idx_lock = threading.Lock()
+        state = {"next": 0, "finished": 0}
+
+        def worker():
+            while True:
+                with idx_lock:
+                    i = state["next"]
+                    if i >= STORM_REQUESTS:
+                        return
+                    state["next"] = i + 1
+                try:
+                    results[i] = request(
+                        "127.0.0.1", router_port,
+                        {"model": "churn", "row": rows[i],
+                         "request_id": f"storm-{i}"}, timeout=15)
+                except OSError as exc:
+                    results[i] = {"error": f"transport: {exc}"}
+                with idx_lock:
+                    state["finished"] += 1
+                done.release()
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(STORM_THREADS)]
+        for t in threads:
+            t.start()
+        for _ in range(KILL_AFTER):
+            done.acquire()
+        victim_proc, victim_port = backends[0]
+        victim_proc.send_signal(signal.SIGKILL)
+        for t in threads:
+            t.join(timeout=120)
+        dropped = [i for i, r in enumerate(results)
+                   if not r or "error" in r]
+        if dropped:
+            raise SystemExit(
+                f"{len(dropped)} innocents dropped through the kill "
+                f"(first: {results[dropped[0]]})")
+
+        # -- fleet-shaped stats through the router --
+        stats = request("127.0.0.1", router_port, {"cmd": "stats"},
+                        timeout=15)
+        rt = stats.get("router") or {}
+        counters = rt.get("counters") or {}
+        if counters.get("Forwarded", 0) < STORM_REQUESTS:
+            raise SystemExit(f"router under-counted forwards: {counters}")
+        if "churn" not in (stats.get("models") or {}):
+            raise SystemExit(f"merged stats missing model: "
+                             f"{sorted(stats.get('models') or {})}")
+
+        # -- the killed backend's stale feed becomes an incident --
+        incident_dir = os.path.join(spool, "_incidents")
+        deadline = time.monotonic() + 30
+        while True:
+            bundles = (os.listdir(incident_dir)
+                       if os.path.isdir(incident_dir) else [])
+            if bundles:
+                break
+            if time.monotonic() > deadline:
+                raise SystemExit("no incident bundle for the killed "
+                                 "backend's stale feed")
+            time.sleep(0.5)
+
+        retries = counters.get("Retries", 0)
+        print(f"router smoke: byte parity ok, {STORM_REQUESTS} storm "
+              f"requests with backend :{victim_port} SIGKILLed "
+              f"mid-storm, 0 dropped ({retries} sibling retries), "
+              f"fleet stats merged, incident bundle {bundles[0]!r}")
+        return 0
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for proc in procs:
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,158 @@
+#!/usr/bin/env bash
+# Pod-scale serving runbook (README "Pod-scale serving"): two REAL
+# serving processes behind the jax-free fleet router, all publishing
+# into one fleetobs spool watched by both the router (SLO-fed dispatch)
+# and the aggregator (incident plane).  The script:
+#
+# 1. trains the shared churn artifact and starts 2 backends + router +
+#    aggregator;
+# 2. fans a `scale` command through the router (both backends resize
+#    their replica pools live);
+# 3. runs the router_fleet workload scenario against the ROUTER with
+#    --assert: steady phase, flash-crowd surge (p99 must stay flat),
+#    then a chaos phase during which this script SIGKILLs backend 1 —
+#    the envelope holds dropped innocents at ZERO (retry-on-sibling);
+# 4. stitches a traced request into one Perfetto timeline spanning
+#    router + backend lanes, and checks the killed backend's stale
+#    feed became an incident bundle.
+set -euo pipefail
+cd "$(dirname "$0")"
+PY=${PYTHON:-python}
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+export PYTHONPATH="$(cd ../.. && pwd)${PYTHONPATH:+:$PYTHONPATH}"
+BASE_PORT=${BASE_PORT:-8761}
+ROUTER_PORT=${ROUTER_PORT:-8760}
+AGG_PORT=${AGG_PORT:-8770}
+TRACE_ID=fleetroute0001
+rm -rf work && mkdir -p work
+
+PIDS=()
+trap 'kill "${PIDS[@]}" 2>/dev/null || true' EXIT
+
+echo "== train the shared churn artifact"
+$PY train.py work/boot
+
+echo "== start 2 serving backends publishing into one spool"
+for i in 1 2; do
+  $PY -m avenir_tpu serve \
+      -Dserve.models=churn \
+      -Dserve.model.churn.kind=naiveBayes \
+      -Dserve.model.churn.feature.schema.file.path=work/boot/teleComChurn.json \
+      -Dserve.model.churn.bayesian.model.file.path=work/boot/nb_model \
+      -Dserve.port=$((BASE_PORT + i)) -Dserve.warmup=true \
+      -Dtelemetry.interval.sec=0.5 -Dobs.trace.enable=true \
+      -Dobs.sample.rate=0.02 \
+      -Dfleetobs.spool.dir=work/spool -Dfleetobs.role=backend$i \
+      >work/backend$i.log 2>&1 &
+  PIDS+=($!)
+done
+for i in 1 2; do
+  for _ in $(seq 1 300); do
+    grep -q "serving churn" work/backend$i.log && break
+    kill -0 "${PIDS[$((i-1))]}" || { cat work/backend$i.log; exit 1; }
+    sleep 0.2
+  done
+done
+
+echo "== start the router in front of both (feeds on, retry 1)"
+$PY -m avenir_tpu router \
+    -Drouter.backends=$((BASE_PORT + 1)),$((BASE_PORT + 2)) \
+    -Drouter.port=$ROUTER_PORT -Drouter.poll.sec=0.5 \
+    -Drouter.feed.stale.sec=3 \
+    -Dfleetobs.spool.dir=work/spool -Dfleetobs.role=router \
+    -Dtelemetry.interval.sec=0.5 -Dobs.trace.enable=true \
+    -Dobs.sample.rate=0.02 \
+    >work/router.log 2>&1 &
+ROUTER_PID=$!
+PIDS+=($ROUTER_PID)
+for _ in $(seq 1 100); do
+  grep -q "router: fronting" work/router.log && break
+  kill -0 $ROUTER_PID || { cat work/router.log; exit 1; }
+  sleep 0.2
+done
+
+echo "== start the aggregator over the same spool"
+$PY -m avenir_tpu fleetobs -Dfleetobs.spool.dir=work/spool \
+    -Dfleetobs.port=$AGG_PORT -Dfleetobs.poll.sec=0.3 \
+    -Dfleetobs.stale.sec=3 >work/agg.log 2>&1 &
+AGG_PID=$!
+PIDS+=($AGG_PID)
+for _ in $(seq 1 100); do
+  grep -q "fleetobs: aggregating" work/agg.log && break
+  kill -0 $AGG_PID || { cat work/agg.log; exit 1; }
+  sleep 0.2
+done
+
+echo "== fan a scale command through the router: both backends resize"
+$PY - "$ROUTER_PORT" <<'EOF'
+import sys
+sys.path.insert(0, "../..")
+from avenir_tpu.serve.server import request
+
+resp = request("127.0.0.1", int(sys.argv[1]),
+               {"cmd": "scale", "model": "churn", "replicas": 2},
+               timeout=60)
+assert resp.get("ok"), resp
+backends = resp["backends"]
+assert len(backends) == 2, backends
+for name, r in backends.items():
+    assert r and r.get("replicas") == 2, (name, r)
+print(f"   scaled churn to 2 replicas on {len(backends)} backends")
+EOF
+
+echo "== run the router_fleet scenario AGAINST THE ROUTER (--assert);"
+echo "   SIGKILL backend1 when the chaos phase starts"
+$PY -m avenir_tpu workload \
+    --scenario ../workload/router_fleet.properties \
+    -Dworkload.target.port=$ROUTER_PORT \
+    -Dworkload.out.dir=work/run --assert \
+    >work/workload.log 2>&1 &
+WL_PID=$!
+for _ in $(seq 1 600); do
+  grep -q "phase 'crowd'" work/workload.log && break
+  kill -0 $WL_PID || { cat work/workload.log; exit 1; }
+  sleep 0.2
+done
+sleep 1
+kill -9 "${PIDS[0]}"
+echo "   backend1 SIGKILLed mid-chaos"
+wait $WL_PID || { cat work/workload.log; exit 1; }
+grep "verdict: PASS" work/workload.log
+grep "phase 'chaos'" work/workload.log
+
+echo "== trace one request through router -> surviving backend, then"
+echo "   stitch the cross-process Perfetto timeline"
+$PY - "$ROUTER_PORT" "$TRACE_ID" <<'EOF'
+import random, sys
+sys.path.insert(0, "../..")
+from avenir_tpu.serve.server import request
+from avenir_tpu.workload.generators import churn_row
+
+resp = request("127.0.0.1", int(sys.argv[1]),
+               {"model": "churn", "row": churn_row(random.Random(3), 7),
+                "trace_id": sys.argv[2]}, timeout=30)
+assert "error" not in resp, resp
+print("   traced request ok")
+EOF
+sleep 2          # let the publish tick flush trace JSONL to the feeds
+$PY -m avenir_tpu fleetobs stitch --spool work/spool \
+    --trace-id $TRACE_ID --out work/fleet-trace.json
+$PY - <<'EOF'
+import json
+doc = json.load(open("work/fleet-trace.json"))
+ev = doc["traceEvents"] if isinstance(doc, dict) else doc
+lanes = {e["pid"] for e in ev if e.get("ph") == "X"}
+assert len(lanes) >= 2, f"stitched trace spans {len(lanes)} process(es)"
+print(f"   stitched spans cover {len(lanes)} process lanes")
+EOF
+
+echo "== the killed backend's stale feed must be an incident by now"
+for _ in $(seq 1 100); do
+  compgen -G "work/spool/_incidents/incident-*fleet_feed_stale*" \
+      >/dev/null && break
+  sleep 0.2
+done
+ls -d work/spool/_incidents/incident-*fleet_feed_stale* >/dev/null
+echo "   incident bundle present"
+
+echo "== pod-scale serving runbook: ALL CLEAN"

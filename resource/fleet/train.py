@@ -1,0 +1,37 @@
+"""Train the runbook's shared Naive Bayes artifact (the same churn
+bootstrap the workload harness uses).  Usage: python train.py <dir>"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+from avenir_tpu.core.config import JobConfig                  # noqa: E402
+from avenir_tpu.core.io import atomic_write_text, write_output  # noqa: E402
+from avenir_tpu.datagen import gen_telecom_churn              # noqa: E402
+from avenir_tpu.models.bayesian import BayesianDistribution   # noqa: E402
+from avenir_tpu.workload.runner import (BOOTSTRAP_TRAIN_ROWS,  # noqa: E402
+                                        CHURN_SCHEMA)
+
+
+def main() -> int:
+    boot_dir = sys.argv[1]
+    os.makedirs(boot_dir, exist_ok=True)
+    schema_path = os.path.join(boot_dir, "teleComChurn.json")
+    model_path = os.path.join(boot_dir, "nb_model")
+    if not os.path.exists(os.path.join(model_path, "_SUCCESS")):
+        atomic_write_text(schema_path, json.dumps(CHURN_SCHEMA))
+        train_dir = os.path.join(boot_dir, "train")
+        rows = gen_telecom_churn(BOOTSTRAP_TRAIN_ROWS, seed=11)
+        write_output(train_dir, [",".join(r) for r in rows])
+        BayesianDistribution(JobConfig(
+            {"feature.schema.file.path": schema_path})).run(
+            train_dir, model_path)
+    print(f"trained {model_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

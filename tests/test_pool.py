@@ -836,3 +836,51 @@ def test_fault_plan_tag_qualifier_targets_one_call_site():
     fi2 = FaultInjector(parse_plan("scorer@0"))
     with pytest.raises(RuntimeError):
         fi2.fire("scorer", tag="f32")
+
+
+# ---------------------------------------------------------------------------
+# runtime replica scaling (the fleet router's autoscale verb)
+# ---------------------------------------------------------------------------
+
+def test_scale_grows_and_shrinks_replicas_live(artifacts):
+    srv = PredictionServer(_config(artifacts,
+                                   **{"serve.pool.replicas": "1"}))
+    port = srv.start()
+    try:
+        grow = request("127.0.0.1", port,
+                       {"cmd": "scale", "model": "churn", "replicas": 2})
+        assert grow["ok"] and grow["replicas"] == 2 and grow["previous"] == 1
+        group = srv.pool.variant_groups("churn")[0]
+        assert len(group.replicas) == 2
+        # the new capacity serves immediately and correctly
+        outs = [request("127.0.0.1", port,
+                        {"model": "churn", "row": l})["output"]
+                for l in artifacts["nb_test_lines"][:6]]
+        assert outs == artifacts["nb_batch"]["f32"][:6]
+        # persisted per-model so a later reload rebuilds at the new size
+        assert srv.pool.config.get(
+            "serve.model.churn.pool.replicas") == "2"
+        shrink = request("127.0.0.1", port,
+                         {"cmd": "scale", "model": "churn",
+                          "replicas": 1})
+        assert shrink["ok"] and shrink["previous"] == 2
+        group = srv.pool.variant_groups("churn")[0]
+        assert len(group.replicas) == 1
+        out = request("127.0.0.1", port, {
+            "model": "churn", "row": artifacts["nb_test_lines"][0]})
+        assert out["output"] == artifacts["nb_batch"]["f32"][0]
+    finally:
+        srv.stop()
+
+
+def test_scale_rejects_bad_replica_counts(artifacts):
+    srv = PredictionServer(_config(artifacts))
+    port = srv.start()
+    try:
+        assert "error" in request("127.0.0.1", port,
+                                  {"cmd": "scale", "model": "churn"})
+        assert "error" in request(
+            "127.0.0.1", port,
+            {"cmd": "scale", "model": "churn", "replicas": "nope"})
+    finally:
+        srv.stop()
