@@ -28,7 +28,8 @@ from .engine import Corpus, Finding, rule
 NAMESPACE_GROUPS: Dict[str, str] = {
     "durability": r"(?:checkpoint|io|serve\.poison)",
     "telemetry": (r"(?:telemetry|serve\.slo|serve\.pool|serve\.router|"
-                  r"serve\.frontend|serve\.drain|obs\.sample|flight)"),
+                  r"serve\.frontend|serve\.drain|serve\.breaker|"
+                  r"obs\.sample|flight)"),
     "workflow": r"(?:workflow|dag)",
     "sanitizer": r"(?:sanitize)",
     # the streaming decision service (avenir_tpu/stream); the literal

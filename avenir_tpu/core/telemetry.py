@@ -210,7 +210,32 @@ SNAPSHOT_NON_MERGED: Dict[str, str] = {
 #: section outside this set (and SNAPSHOT_NON_MERGED) raises so schema
 #: growth is loud at the merge point too, not only in static analysis
 SNAPSHOT_SECTIONS = frozenset(
-    {"v", "ts", "mono", "counters", "gauges", "hists", "spans"})
+    {"v", "ts", "mono", "counters", "gauges", "hists", "spans",
+     "resilience"})
+
+
+def merge_resilience(a: Optional[dict], b: Optional[dict]) -> dict:
+    """Max-fold of two ``resilience`` sections — the serve layer's
+    breaker state codes (``{"breakers": {model: 0/1/2}}``) and
+    quarantined poison-row signatures
+    (``{"quarantine": {model: {sig: offenses}}}``).
+
+    Both halves fold by per-key ``max``: a breaker tripped ANYWHERE in
+    the fleet must survive the fold (the router pre-demotes on it), and
+    a signature's offense count only ever grows, so max is the honest
+    union.  Max over non-negative ints with identity 0 is a commutative
+    monoid, keeping ``merge_snapshots`` certified-commutative."""
+    out = {"breakers": dict((a or {}).get("breakers") or {}),
+           "quarantine": {m: dict(sigs or {}) for m, sigs in
+                          ((a or {}).get("quarantine") or {}).items()}}
+    for model, code in ((b or {}).get("breakers") or {}).items():
+        out["breakers"][model] = max(int(out["breakers"].get(model, 0)),
+                                     int(code or 0))
+    for model, sigs in ((b or {}).get("quarantine") or {}).items():
+        dst = out["quarantine"].setdefault(model, {})
+        for sig, n in (sigs or {}).items():
+            dst[sig] = max(int(dst.get(sig, 0)), int(n or 0))
+    return out
 
 
 def merge_snapshots(a: dict, b: dict) -> dict:
@@ -267,11 +292,18 @@ def merge_snapshots(a: dict, b: dict) -> dict:
             cur["mean_ms"] = (cur["total_ms"] / cur["count"]
                               if cur["count"] else 0.0)
 
-    return {"v": SNAPSHOT_VERSION,
-            "ts": max(a.get("ts", 0.0), b.get("ts", 0.0)),
-            "mono": max(a.get("mono", 0.0), b.get("mono", 0.0)),
-            "counters": counters, "gauges": gauges, "hists": hists,
-            "spans": spans}
+    out = {"v": SNAPSHOT_VERSION,
+           "ts": max(a.get("ts", 0.0), b.get("ts", 0.0)),
+           "mono": max(a.get("mono", 0.0), b.get("mono", 0.0)),
+           "counters": counters, "gauges": gauges, "hists": hists,
+           "spans": spans}
+    if "resilience" in a or "resilience" in b:
+        # present only when an input carried it: batch jobs and routers
+        # never export the section, and their merged snapshots must stay
+        # byte-identical to the pre-section shape
+        out["resilience"] = merge_resilience(a.get("resilience"),
+                                             b.get("resilience"))
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -604,6 +636,9 @@ class TelemetryExporter:
             for g, names in (extra.get("counters") or {}).items():
                 dst = snap.setdefault("counters", {}).setdefault(g, {})
                 dst.update(names)
+            if "resilience" in extra:
+                snap["resilience"] = merge_resilience(
+                    snap.get("resilience"), extra["resilience"])
         return snap
 
     def tick(self) -> dict:

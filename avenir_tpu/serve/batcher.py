@@ -167,6 +167,31 @@ class PoisonQuarantine:
         with self._lock:
             return len(self._counts)
 
+    def export(self) -> dict:
+        """The QUARANTINED signatures (offense count at/over threshold)
+        with their counts — the fleet-propagation payload the serve
+        telemetry overlay ships in the snapshot's ``resilience``
+        section.  Sub-threshold offenders stay local: a sibling only
+        needs the verdicts, not the evidence in progress."""
+        with self._lock:
+            return {sig: n for sig, n in self._counts.items()
+                    if n >= self.threshold}
+
+    def seed(self, sig: str, offenses: int) -> bool:
+        """Install a sibling-observed signature at
+        ``max(local, offenses)`` offenses — idempotent (re-seeding never
+        lowers a count), so the router may re-push after a restart.
+        Returns True when the signature newly crossed the quarantine
+        threshold HERE — the propagation counters' input."""
+        n = max(1, int(offenses))
+        with self._lock:
+            cur = self._counts.pop(sig, 0)
+            new = max(cur, n)
+            self._counts[sig] = new
+            while len(self._counts) > self.cap:
+                self._counts.popitem(last=False)
+            return cur < self.threshold <= new
+
     def clear(self) -> None:
         """Forget every offense (a model reload may have repaired the
         scorer-side cause, so quarantined rows deserve a fresh trial)."""

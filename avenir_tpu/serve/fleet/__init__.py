@@ -10,15 +10,20 @@ coordination over the backends' ``scale``/``promote`` verbs.
 - ``backend``  — persistent pooled pipelined connections per backend,
   with fail-fast orphan callbacks when a backend dies mid-request.
 - ``watch``    — spool-feed consumption as a library: per-backend SLO
-  boards, staleness, residency, and replica-count views.
-- ``control``  — the autoscale + residency coordination loops.
+  boards, staleness, residency, replica-count, and breaker/quarantine
+  (``resilience`` section) views.
+- ``control``  — the autoscale + residency coordination loops
+  (leader-only) and the fleet quarantine-propagation pump.
+- ``lease``    — file-atomic lease electing the ONE control leader
+  among N replicated routers sharing a spool.
 - ``router``   — the dispatch surface + ``python -m avenir_tpu router``.
 """
 
 from .backend import BackendLink, parse_backends        # noqa: F401
 from .control import ControlLoop                        # noqa: F401
+from .lease import RouterLease                          # noqa: F401
 from .router import FleetRouter, router_main            # noqa: F401
 from .watch import FeedWatch                            # noqa: F401
 
 __all__ = ["BackendLink", "ControlLoop", "FeedWatch", "FleetRouter",
-           "parse_backends", "router_main"]
+           "RouterLease", "parse_backends", "router_main"]

@@ -25,6 +25,16 @@ N backends independently promoting the same hot tenant.  Dispatch
 prefers resident backends on its own (the SLO verdicts and cold-start
 flags already demote non-resident ones); the loop only fixes the
 steady-state shape.
+
+**Replicated routers** (lease.py): with N routers over one spool, only
+the lease HOLDER runs the two loops above — followers dispatch only,
+and every scale command the leader issues carries the lease generation
+so a deposed leader's in-flight decision is refused by the backend
+pool.  **Quarantine propagation** runs on EVERY router regardless of
+leadership (``serve.breaker.propagate``): seeding a sibling's
+quarantined poison signatures is idempotent (the backend folds by
+max), and a propagation gap during a leadership hand-off would be
+exactly the window a poison storm exploits.
 """
 
 from __future__ import annotations
@@ -44,6 +54,7 @@ KEY_MAX_REPLICAS = "router.autoscale.max.replicas"
 KEY_HOLD_SEC = "router.autoscale.hold.sec"
 KEY_RESIDENCY_K = "router.residency.replicas"
 KEY_CONTROL_SEC = "router.control.interval.sec"
+KEY_PROPAGATE = "serve.breaker.propagate"
 
 DEFAULT_QPS_PER_REPLICA = 50.0
 DEFAULT_MIN_REPLICAS = 1
@@ -60,10 +71,13 @@ class ControlLoop:
     """Rate-limited, hysteretic fleet control over the backend links."""
 
     def __init__(self, config, links: List[BackendLink], watch,
-                 rates_fn: Callable[[], Dict[str, float]]):
+                 rates_fn: Callable[[], Dict[str, float]],
+                 lease=None):
         self.links = links
         self.watch = watch          # Optional[FeedWatch]
         self.rates_fn = rates_fn
+        self.lease = lease          # Optional[RouterLease]
+        self.propagate = config.get_boolean(KEY_PROPAGATE, True)
         self.autoscale = config.get_boolean(KEY_AUTOSCALE, False)
         self.qps_per_replica = config.get_float(KEY_QPS_PER_REPLICA,
                                                 DEFAULT_QPS_PER_REPLICA)
@@ -79,22 +93,35 @@ class ControlLoop:
         self._issued: Dict[str, int] = {}       # model -> last scale sent
         self._last_scale: Dict[str, float] = {}
         self._down_since: Dict[str, float] = {}
+        # backend -> model -> signatures already pushed: bounds
+        # steady-state propagation chatter (the verb itself is
+        # idempotent, so losing this ledger on restart is harmless)
+        self._seeded: Dict[str, Dict[str, set]] = {}
         self.scale_ups = 0
         self.scale_downs = 0
         self.promotes = 0
+        self.quarantine_pushes = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def _is_leader(self) -> bool:
+        """Leadership gate: with no lease configured (a single router,
+        or no spool) this router IS the leader."""
+        return self.lease is None or self.lease.is_leader()
 
     # -- one tick ----------------------------------------------------------
     def step(self, now: Optional[float] = None) -> None:
         now = time.monotonic() if now is None else float(now)
         rates = self.rates_fn()
-        if self.autoscale and self.qps_per_replica > 0:
-            for model in sorted(rates):
-                self._autoscale_model(model, rates[model], now)
-        if self.residency_k > 0 and self.watch is not None:
-            for model in sorted(rates):
-                self._nudge_residency(model)
+        if self._is_leader():
+            if self.autoscale and self.qps_per_replica > 0:
+                for model in sorted(rates):
+                    self._autoscale_model(model, rates[model], now)
+            if self.residency_k > 0 and self.watch is not None:
+                for model in sorted(rates):
+                    self._nudge_residency(model)
+        if self.propagate and self.watch is not None:
+            self._propagate_quarantine()
 
     def _current_replicas(self, model: str) -> int:
         with self._lock:
@@ -134,16 +161,20 @@ class ControlLoop:
                 self.scale_ups += 1
             else:
                 self.scale_downs += 1
-        # fan out OFF the lock: scale commands block on replica builds
+        # fan out OFF the lock: scale commands block on replica builds.
+        # The lease generation rides every command — the pool-side
+        # fence against a deposed leader's in-flight decision
+        cmd = {"cmd": "scale", "model": model, "replicas": desired}
+        if self.lease is not None:
+            cmd["generation"] = self.lease.generation()
         acks = 0
         for link in self.links:
-            resp = link.command(
-                {"cmd": "scale", "model": model, "replicas": desired},
-                COMMAND_TIMEOUT_SEC)
+            resp = link.command(dict(cmd), COMMAND_TIMEOUT_SEC)
             if resp is not None and resp.get("ok"):
                 acks += 1
         flight.record("fleet.autoscale", model=model, rate=round(rate, 2),
-                      replicas=desired, previous=current, acks=acks)
+                      replicas=desired, previous=current, acks=acks,
+                      generation=cmd.get("generation"))
 
     def _nudge_residency(self, model: str) -> None:
         resident = set(self.watch.residency(model))
@@ -164,18 +195,61 @@ class ControlLoop:
                 with self._lock:
                     self.promotes += 1
 
+    def _propagate_quarantine(self) -> None:
+        """Push fleet-sighted quarantined poison signatures to every
+        backend whose own feed has not shown them: a row one backend
+        quarantined is refused at submit by every sibling BEFORE its
+        first scorer failure there.  Seeding folds by max on the
+        backend (idempotent), so the only cost of over-pushing is
+        chatter — bounded by the _seeded ledger."""
+        sightings = self.watch.quarantine_sightings()
+        if not sightings:
+            return
+        for link in self.links:
+            have = self.watch.backend_quarantine(link.name)
+            to_push = []
+            with self._lock:
+                ledger = self._seeded.setdefault(link.name, {})
+                for model, sigs in sightings.items():
+                    known = have.get(model, {})
+                    pushed = ledger.setdefault(model, set())
+                    fresh = {sig: n for sig, n in sigs.items()
+                             if sig not in known and sig not in pushed}
+                    if fresh:
+                        # remembered even if the push fails: a backend
+                        # without the model (or with quarantine off)
+                        # answers with an error, and one sick backend
+                        # must not make every tick re-knock on it
+                        pushed.update(fresh)
+                        to_push.append((model, fresh))
+            # commands OFF the lock: they block on the backend
+            for model, fresh in to_push:
+                resp = link.command(
+                    {"cmd": "quarantine", "model": model,
+                     "signatures": fresh}, COMMAND_TIMEOUT_SEC)
+                if resp is not None and "error" not in resp:
+                    with self._lock:
+                        self.quarantine_pushes += 1
+                    flight.record("fleet.quarantine_propagated",
+                                  backend=link.name, model=model,
+                                  signatures=len(fresh))
+
     def section(self) -> dict:
         with self._lock:
             return {"autoscale": self.autoscale,
+                    "leader": self._is_leader(),
+                    "propagate": self.propagate,
                     "residency_replicas": self.residency_k,
                     "scale_ups": self.scale_ups,
                     "scale_downs": self.scale_downs,
                     "promotes": self.promotes,
+                    "quarantine_pushes": self.quarantine_pushes,
                     "issued": dict(self._issued)}
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "ControlLoop":
-        enabled = self.autoscale or self.residency_k > 0
+        enabled = (self.autoscale or self.residency_k > 0
+                   or (self.propagate and self.watch is not None))
         if not enabled or self.interval <= 0 or self._thread is not None:
             return self
 

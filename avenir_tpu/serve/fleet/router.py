@@ -51,6 +51,7 @@ from .backend import (DEFAULT_CONNECTIONS, DEFAULT_REQUEST_TIMEOUT,
                       KEY_BACKENDS, KEY_CONNECTIONS, KEY_REQUEST_TIMEOUT,
                       BackendLink, parse_backends)
 from .control import ControlLoop
+from .lease import RouterLease
 from .watch import FeedWatch
 
 KEY_HOST = "router.host"
@@ -63,8 +64,10 @@ DEFAULT_DRAIN_TIMEOUT = 5.0
 
 ROUTER_GROUP = "Router"
 
-#: commands the router fans out to EVERY backend
-FANOUT_CMDS = ("reload", "promote", "demote", "scale")
+#: commands the router fans out to EVERY backend (all idempotent to
+#: fan, though never to RETRY — quarantine seeding folds by max, so
+#: fanning it wide is exactly its propagation semantics)
+FANOUT_CMDS = ("reload", "promote", "demote", "scale", "quarantine")
 
 
 class FleetRouter:
@@ -73,7 +76,7 @@ class FleetRouter:
 
     max_line_bytes = 1 << 20
 
-    def __init__(self, config):
+    def __init__(self, config, identity_label: Optional[str] = None):
         backends = parse_backends(config.get(KEY_BACKENDS))
         if not backends:
             raise ValueError(
@@ -90,8 +93,16 @@ class FleetRouter:
         self.watch: Optional[FeedWatch] = (
             FeedWatch(config, spool, [link.name for link in self.links])
             if spool else None)
+        # replicated routers share the spool: a lease file elects the
+        # ONE autoscale/residency leader (followers dispatch only).
+        # Without a spool — or without a fleetobs identity to hold the
+        # lease under — there is nothing to share, so this router is
+        # leader by construction (lease None => ControlLoop leads)
+        self.lease: Optional[RouterLease] = (
+            RouterLease(config, spool, identity_label)
+            if spool and identity_label else None)
         self.control = ControlLoop(config, self.links, self.watch,
-                                   self._take_rates)
+                                   self._take_rates, lease=self.lease)
         self._lock = sanitizer.make_lock("fleet.router")
         self._counts: Dict[str, int] = {}       # model -> forwards ever
         self._rate_base: Dict[str, int] = {}
@@ -352,6 +363,8 @@ class FleetRouter:
                             for link in self.links},
                "counters": counters,
                "control": self.control.section()}
+        if self.lease is not None:
+            sec["lease"] = self.lease.section()
         if self.watch is not None:
             sec["watch"] = self.watch.section()
         if self.frontend is not None:
@@ -381,6 +394,10 @@ class FleetRouter:
             for name, view in wsec["backends"].items():
                 g("router.feed.stale", 1 if view["stale"] else 0,
                   backend=name)
+        if self.lease is not None:
+            lsec = self.lease.section()
+            g("router.lease.leader", 1 if lsec["leader"] else 0)
+            g("router.lease.generation", lsec["generation"])
         if self.frontend is not None:
             g("router.frontend.connections",
               self.frontend.connections())
@@ -395,11 +412,20 @@ class FleetRouter:
     def start(self) -> "FleetRouter":
         if self.watch is not None:
             self.watch.start()
+        if self.lease is not None:
+            # the lease settles BEFORE the first control tick, so a
+            # follower never runs one leaderly tick at startup
+            self.lease.start()
         self.control.start()
         return self
 
     def stop(self) -> None:
         self.control.stop()
+        if self.lease is not None:
+            # after control (no tick may re-assert leadership), before
+            # the watch dies: release() expires our lease in place so a
+            # follower promotes on its next tick instead of waiting TTL
+            self.lease.stop()
         if self.watch is not None:
             self.watch.stop()
         self._cmd_pool.shutdown(wait=True)
@@ -433,7 +459,10 @@ def router_main(argv) -> int:
     configure_resilience(config)
     telemetry.configure_from_config(config)
 
-    router = FleetRouter(config)
+    router = FleetRouter(
+        config,
+        identity_label=publisher.identity.label
+        if publisher is not None else None)
     exporter = telemetry.TelemetryExporter(
         config.get_float(telemetry.KEY_INTERVAL,
                          telemetry.DEFAULT_INTERVAL_SEC),
@@ -456,7 +485,8 @@ def router_main(argv) -> int:
     print(f"router: fronting {len(router.links)} backend(s) [{names}] "
           f"on {config.get(KEY_HOST, '127.0.0.1')}:{frontend.port} "
           f"(retry {router.retry_max}, "
-          f"feeds {'on' if router.watch else 'off'})",
+          f"feeds {'on' if router.watch else 'off'}, "
+          f"lease {'on' if router.lease else 'off'})",
           file=sys.stderr, flush=True)
 
     stop_evt = threading.Event()

@@ -57,7 +57,8 @@ class BackendView:
     """One backend's last-observed feed state."""
 
     __slots__ = ("name", "label", "published_unix", "seq", "stale",
-                 "resident", "degraded", "replicas", "verdicts")
+                 "resident", "degraded", "replicas", "verdicts",
+                 "tripped", "quarantine")
 
     def __init__(self, name: str):
         self.name = name
@@ -69,6 +70,10 @@ class BackendView:
         self.degraded: set = set()
         self.replicas: Dict[str, int] = {}
         self.verdicts: Dict[str, dict] = {}
+        # from the snapshot's `resilience` section: models whose breaker
+        # is OPEN on this backend, and its quarantined poison signatures
+        self.tripped: set = set()
+        self.quarantine: Dict[str, Dict[str, int]] = {}
 
     def section(self) -> dict:
         return {"label": self.label, "seq": self.seq,
@@ -76,6 +81,9 @@ class BackendView:
                 "resident": sorted(self.resident),
                 "degraded": sorted(self.degraded),
                 "replicas": dict(self.replicas),
+                "tripped": sorted(self.tripped),
+                "quarantined": {m: len(s)
+                                for m, s in self.quarantine.items()},
                 "slo": self.verdicts}
 
 
@@ -111,8 +119,15 @@ def _parse_snapshot(snap: dict) -> dict:
             model = parse_labels(m.group(2)).get("model")
             if model:
                 resident.add(model)
+    res = snap.get("resilience") or {}
+    tripped = {m for m, code in (res.get("breakers") or {}).items()
+               if int(code or 0) >= 2}        # 2 = OPEN (breaker.py)
+    quarantine = {m: {str(s): int(n or 0) for s, n in (sigs or {}).items()}
+                  for m, sigs in (res.get("quarantine") or {}).items()
+                  if sigs}
     return {"port": port, "degraded": degraded, "resident": resident,
-            "replicas": {k: len(v) for k, v in replicas.items()}}
+            "replicas": {k: len(v) for k, v in replicas.items()},
+            "tripped": tripped, "quarantine": quarantine}
 
 
 class FeedWatch:
@@ -129,6 +144,7 @@ class FeedWatch:
         self._views: Dict[str, BackendView] = {
             n: BackendView(n) for n in backend_names}
         self._slo: Dict[str, FleetSLO] = {}
+        self._fleet_tripped: set = set()
         self._lock = sanitizer.make_lock("fleet.watch")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -161,11 +177,21 @@ class FeedWatch:
                 view.resident = facts["resident"]
                 view.degraded = facts["degraded"]
                 view.replicas = facts["replicas"]
+                view.tripped = facts["tripped"]
+                view.quarantine = facts["quarantine"]
                 observed.append((name, snap))
             for view in self._views.values():
                 view.stale = (view.published_unix > 0
                               and now - view.published_unix
                               > self.stale_sec)
+            # the fleet-wide pre-demote set: a model whose breaker is
+            # OPEN on ANY fresh sibling — the trip is likely systemic
+            # (a poisoned artifact trips everywhere it lands), so the
+            # healthy rung stops vouching for the model ANYWHERE before
+            # the other backends fail their own way into it
+            self._fleet_tripped = {
+                m for v in self._views.values()
+                if not v.stale for m in v.tripped}
             self.scans += 1
         for name, snap in observed:
             with self._lock:
@@ -181,18 +207,24 @@ class FeedWatch:
     # -- the router's read surface ----------------------------------------
     def healthy(self, name: str, model: Optional[str] = None) -> bool:
         """Dispatch-grade health: the backend's feed is fresh, the model
-        is not soft-degraded there, and its rolling window is not in
-        violation.  A backend never observed yet is OPTIMISTICALLY
-        healthy — feeds lag process start, and a cold fleet must still
-        route (mirrors the variant router's no-data optimism)."""
+        is not soft-degraded (or breaker-tripped) there, and its rolling
+        window is not in violation.  A backend never observed yet is
+        OPTIMISTICALLY healthy — feeds lag process start, and a cold
+        fleet must still route (mirrors the variant router's no-data
+        optimism).  A model breaker-tripped on ANY fresh sibling is
+        pre-demoted FLEET-WIDE (the healthy rung empties for it, so the
+        ladder falls to the connected rung rather than keep vouching
+        for a likely-systemic failure)."""
         with self._lock:
+            if model is not None and model in self._fleet_tripped:
+                return False
             view = self._views.get(name)
             if view is None or view.published_unix == 0:
                 return True
             if view.stale:
                 return False
             if model is not None:
-                if model in view.degraded:
+                if model in view.degraded or model in view.tripped:
                     return False
                 verdict = view.verdicts.get(model)
                 if verdict is not None and not verdict.get("ok", True):
@@ -213,10 +245,43 @@ class FeedWatch:
                     for v in self._views.values()
                     if model in v.replicas}
 
+    def fleet_tripped(self, model: str) -> bool:
+        """True when ANY fresh sibling's feed shows the model's breaker
+        open — the fleet-wide pre-demote bit."""
+        with self._lock:
+            return model in self._fleet_tripped
+
+    def quarantine_sightings(self) -> Dict[str, Dict[str, int]]:
+        """Fleet union of quarantined poison signatures across FRESH
+        feeds (per model, per signature, max offenses) — the
+        propagation pump's input (control.py): what any one backend
+        quarantined, every sibling should refuse at submit."""
+        with self._lock:
+            out: Dict[str, Dict[str, int]] = {}
+            for v in self._views.values():
+                if v.stale:
+                    continue
+                for model, sigs in v.quarantine.items():
+                    dst = out.setdefault(model, {})
+                    for sig, n in sigs.items():
+                        dst[sig] = max(dst.get(sig, 0), n)
+            return out
+
+    def backend_quarantine(self, name: str) -> Dict[str, Dict[str, int]]:
+        """One backend's own quarantined signatures as its feed last
+        showed them — what the propagation pump diffs against so it
+        only pushes signatures the backend demonstrably lacks."""
+        with self._lock:
+            view = self._views.get(name)
+            if view is None:
+                return {}
+            return {m: dict(s) for m, s in view.quarantine.items()}
+
     def section(self) -> dict:
         with self._lock:
             return {"scans": self.scans,
                     "stale_sec": self.stale_sec,
+                    "fleet_tripped": sorted(self._fleet_tripped),
                     "backends": {n: v.section()
                                  for n, v in sorted(self._views.items())}}
 

@@ -362,6 +362,10 @@ class ScorerPool:
         # so a poison client bouncing between replicas still accumulates
         self.poison_isolate = config.get_boolean(KEY_POISON_ISOLATE, False)
         self.quarantines: Dict[str, Optional[PoisonQuarantine]] = {}
+        # model -> highest router-lease generation applied by scale():
+        # the idempotence fence that keeps a deposed leader's in-flight
+        # scale from fighting the new leader's (fleet/lease.py)
+        self._scale_gen: Dict[str, int] = {}
         try:
             for name in registry.model_names():
                 self._load_model(name)
@@ -675,7 +679,8 @@ class ScorerPool:
         return primary if primary is not None else head
 
     def scale(self, model: str, replicas: int,
-              variant: Optional[str] = None) -> dict:
+              variant: Optional[str] = None,
+              generation: Optional[int] = None) -> dict:
         """Grow or shrink a model's replica sets IN PLACE (the fleet
         router's autoscale command).  Growth rides the pre-swap build
         discipline: every new replica is fully built before any group's
@@ -684,10 +689,27 @@ class ScorerPool:
         draining close (queued requests complete on the retiring
         batcher).  The new count is persisted as the model's
         ``serve.model.<name>.pool.replicas`` override so later reloads
-        rebuild at the scaled size."""
+        rebuild at the scaled size.
+
+        ``generation`` (optional) is the issuing router leader's lease
+        generation (fleet/lease.py): a command below the highest
+        generation this pool has applied for the model is refused — a
+        deposed leader's in-flight decision cannot override the new
+        leader's.  Equal generations pass (the same leader re-deciding);
+        ungenerated commands (operator CLI) never fence."""
         n = int(replicas)
         if n < 1:
             raise ValueError(f"replicas must be >= 1: {replicas}")
+        if generation is not None:
+            gen = int(generation)
+            with self._lock:
+                last = self._scale_gen.get(model)
+                if last is not None and gen < last:
+                    raise ValueError(
+                        f"stale scale for model {model!r}: generation "
+                        f"{gen} < {last} (a newer router leader has "
+                        f"already scaled this model)")
+                self._scale_gen[model] = gen
         groups = {g.variant: g for g in self.variant_groups(model)}
         if variant is not None and variant not in groups:
             raise KeyError(f"model {model!r} has no variant {variant!r}")
@@ -726,6 +748,26 @@ class ScorerPool:
             self.config.set(f"serve.model.{model}.pool.replicas", str(n))
         return {"model": model, "replicas": n, "previous": before,
                 "scaled_groups": len(plans)}
+
+    def seed_quarantine(self, model: str, signatures: Dict[str, int]) -> dict:
+        """Install sibling-quarantined poison signatures into the
+        model's shared quarantine (the fleet router's ``quarantine``
+        propagation verb).  Folds by max per signature (idempotent — a
+        router re-pushing after restart is harmless); rows matching a
+        seeded signature are refused AT SUBMIT, before this process's
+        scorer ever sees them."""
+        if model not in self.model_names():
+            raise KeyError(f"unknown model {model!r}")
+        q = self._ensure_quarantine(model)
+        if q is None:
+            raise ValueError(
+                "poison quarantine disabled (serve.poison.isolate off "
+                "or serve.poison.quarantine.threshold=0)")
+        seeded = 0
+        for sig, n in signatures.items():
+            if q.seed(str(sig), n):
+                seeded += 1
+        return {"seeded": seeded, "size": q.size()}
 
     def close(self, drain: bool = False) -> None:
         with self._lock:

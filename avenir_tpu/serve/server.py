@@ -293,6 +293,11 @@ class PredictionServer:
         gauges: Dict[str, dict] = {}
         hists: Dict[str, dict] = {}
         counters: Dict[str, dict] = {}
+        # the mergeable `resilience` section (core/telemetry.py): worst
+        # breaker state code per model + quarantined poison signatures —
+        # what sibling routers fold fleet-wide (pre-demote, propagation)
+        res_breakers: Dict[str, int] = {}
+        res_quarantine: Dict[str, dict] = {}
 
         def g(name, value, **labels):
             gauges[telemetry.labeled(name, **labels)] = {
@@ -373,6 +378,13 @@ class PredictionServer:
             q = self.pool.quarantines.get(name)
             if q is not None:
                 g("serve.poison.quarantine.size", q.size(), model=name)
+                sigs = q.export()
+                if sigs:
+                    res_quarantine[name] = sigs
+            res_breakers[name] = max(
+                (r.batcher.breaker.state_code()
+                 for r in all_replicas if r.batcher.breaker is not None),
+                default=0)
         if self._frontend is not None:
             g("serve.frontend.connections", self._frontend.connections())
             # the fleet router binds spool feeds to its configured
@@ -402,7 +414,11 @@ class PredictionServer:
             hists["serve.cache.coldstart"] = \
                 self.cache.coldstart_hist.state_dict()
             counters["Cache"] = dict(cc)
-        return {"gauges": gauges, "hists": hists, "counters": counters}
+        out = {"gauges": gauges, "hists": hists, "counters": counters}
+        if res_breakers or res_quarantine:
+            out["resilience"] = {"breakers": res_breakers,
+                                 "quarantine": res_quarantine}
+        return out
 
     def metrics_text(self) -> str:
         """The Prometheus text exposition of the current combined
@@ -535,14 +551,46 @@ class PredictionServer:
             return {"ok": ok, "model": model, "resident": ok}
         if cmd == "scale":
             # the fleet router's autoscale verb: resize a model's replica
-            # pools in place (pre-swap grow / draining-tail shrink)
+            # pools in place (pre-swap grow / draining-tail shrink).  A
+            # scale racing the graceful drain window is REJECTED cleanly
+            # (the pool is about to close; resizing it would race the
+            # drain of in-flight requests), and a command carrying a
+            # router-lease generation below the highest applied is
+            # refused by the pool (stale-leader fence)
+            if self._stopped:
+                return {"error": "server draining: scale rejected",
+                        "draining": True}
             model = obj.get("model") or self._default_model()
             try:
                 n = int(obj.get("replicas"))
             except (TypeError, ValueError):
                 return {"error": 'scale needs "replicas" (int >= 1)'}
-            out = self.pool.scale(model, n, variant=obj.get("variant"))
+            gen = obj.get("generation")
+            if gen is not None:
+                try:
+                    gen = int(gen)
+                except (TypeError, ValueError):
+                    return {"error": 'scale "generation" must be an int'}
+            out = self.pool.scale(model, n, variant=obj.get("variant"),
+                                  generation=gen)
             out["ok"] = True
+            if gen is not None:
+                out["generation"] = gen
+            return out
+        if cmd == "quarantine":
+            # fleet poison propagation (idempotent): seed signatures a
+            # sibling backend already quarantined, so matching rows are
+            # refused at submit BEFORE this process's first scorer
+            # failure on them
+            model = obj.get("model")
+            if not isinstance(model, str):
+                return {"error": 'quarantine needs "model" (string)'}
+            sigs = obj.get("signatures")
+            if not isinstance(sigs, dict) or not sigs:
+                return {"error": 'quarantine needs "signatures" '
+                                 '({signature: offenses})'}
+            out = self.pool.seed_quarantine(model, sigs)
+            out.update({"ok": True, "model": model})
             return out
         if cmd == "demote":
             if self.cache is None:

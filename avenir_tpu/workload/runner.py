@@ -226,6 +226,37 @@ def compile_count(stats: dict) -> int:
     return total
 
 
+def process_usage() -> dict:
+    """This process's resource footprint for the soak gates:
+    ``{"fds": open-fd count, "rss_mb": resident set in MB}``, each None
+    where the platform offers no ``/proc/self`` view (the verdict then
+    fails a DECLARED gate loudly instead of passing it vacuously)."""
+    fds = None
+    try:
+        fds = len(os.listdir("/proc/self/fd"))
+    except OSError:
+        pass
+    rss_mb = None
+    try:
+        with open("/proc/self/statm", "r") as fh:
+            pages = int(fh.read().split()[1])
+        rss_mb = pages * (os.sysconf("SC_PAGE_SIZE") / (1024.0 * 1024.0))
+    except (OSError, ValueError, IndexError):
+        pass
+    return {"fds": fds, "rss_mb": rss_mb}
+
+
+def demote_cycles(stats: dict) -> int:
+    """Cumulative promote/demote cycles visible in a ``stats``
+    response.  A residency cycle a completed promote opened closes one
+    of two ways — LRU eviction by a later promote (``Evictions``) or an
+    operator demote (``Demotes``) — so the cycle count is their sum.
+    Zero without the cache — single-model targets have no residency
+    churn to count."""
+    c = ((stats.get("cache") or {}).get("counters") or {})
+    return c.get("Evictions", 0) + c.get("Demotes", 0)
+
+
 def _warmup(scenario: Scenario, fleet: Fleet, tenants: List[str]) -> None:
     """Pre-phase warmup (uncounted): touch the hot head of the tenant
     ranking so steady-state phases measure serving, not first-compile —
@@ -302,6 +333,8 @@ def run_scenario(config: JobConfig, do_assert: bool = False,
             f"{scenario.threads} client threads, seed={scenario.seed}")
         _warmup(scenario, fleet, tenants)
         compiles0 = _quiesce_compiles(stats_fn)
+        usage0 = process_usage()
+        cycles0 = demote_cycles(stats_fn())
         for spec in scenario.phases:
             events = [e for e in schedule if e.phase == spec.name]
             stats = fleet.run_phase(
@@ -314,7 +347,16 @@ def run_scenario(config: JobConfig, do_assert: bool = False,
             log(f"  phase {spec.name!r}: {s['sent']} sent @ "
                 f"{s['achieved_rps']}/s, p99 {s['p99_ms']} ms, "
                 f"outcomes {s['outcomes']}")
-        compiles1 = compile_count(stats_fn())
+        final_stats = stats_fn()
+        compiles1 = compile_count(final_stats)
+        cycles1 = demote_cycles(final_stats)
+        usage1 = process_usage()
+        if scenario.soak_cycles_min is not None:
+            log(f"  soak: {cycles1 - cycles0} promote/demote cycles, "
+                f"fd {usage0['fds']} -> {usage1['fds']}, "
+                f"rss {usage0['rss_mb'] and round(usage0['rss_mb'], 1)}"
+                f" -> {usage1['rss_mb'] and round(usage1['rss_mb'], 1)}"
+                f" MB")
     finally:
         stop()
         n = obs.get_tracer().export_chrome_trace(trace_path)
@@ -329,7 +371,11 @@ def run_scenario(config: JobConfig, do_assert: bool = False,
 
     verdict = evaluate_run(scenario, per_phase,
                            compiles_after_warmup=compiles0,
-                           compiles_at_end=compiles1)
+                           compiles_at_end=compiles1,
+                           usage_after_warmup=usage0,
+                           usage_at_end=usage1,
+                           cycles_after_warmup=cycles0,
+                           cycles_at_end=cycles1)
     if fold_feeds is not None:
         # the verdict names its evidence: which spool feeds the judged
         # snapshots folded (the run's own feed plus any siblings)

@@ -53,6 +53,9 @@ KEY_OUT_DIR = "workload.out.dir"
 KEY_TIMEOUT_SEC = "workload.request.timeout.sec"
 KEY_WARMUP_REQUESTS = "workload.warmup.requests"
 KEY_COMPILE_FLAT = "workload.slo.compile.flat"
+KEY_FD_GROWTH_MAX = "workload.slo.fd.growth.max"
+KEY_RSS_GROWTH_MAX_MB = "workload.slo.rss.growth.max.mb"
+KEY_SOAK_CYCLES_MIN = "workload.soak.cycles.min"
 KEY_FLEET_SNAPSHOT = "workload.fleet.snapshot"
 
 DEFAULT_THREADS = 4
@@ -152,7 +155,8 @@ class Scenario:
                  "target_port", "bootstrap", "tenants", "tenants_hot",
                  "zipf_exponent", "payload_median", "payload_sigma",
                  "payload_max", "phases", "out_dir", "timeout_s",
-                 "warmup_requests", "compile_flat", "fleet_snapshot",
+                 "warmup_requests", "compile_flat", "fd_growth_max",
+                 "rss_growth_max_mb", "soak_cycles_min", "fleet_snapshot",
                  "config")
 
     def __init__(self, config: JobConfig):
@@ -197,6 +201,13 @@ class Scenario:
         self.warmup_requests = config.get_int(KEY_WARMUP_REQUESTS,
                                               DEFAULT_WARMUP_REQUESTS)
         self.compile_flat = config.get_boolean(KEY_COMPILE_FLAT, False)
+        # run-level resource-leak gates (soak profiles): net fd-count /
+        # RSS growth ceilings between the post-warmup baseline and run
+        # end, and a promote/demote cycle FLOOR so a flatness verdict
+        # cannot pass vacuously on a run that never actually churned
+        self.fd_growth_max = config.get_int(KEY_FD_GROWTH_MAX)
+        self.rss_growth_max_mb = config.get_float(KEY_RSS_GROWTH_MAX_MB)
+        self.soak_cycles_min = config.get_int(KEY_SOAK_CYCLES_MIN)
         # fleet-snapshot mode: phase/final snapshots fold EVERY feed in
         # the fleetobs spool (this run publishes its own feed there),
         # not just the in-process exporter — the verdict then judges the

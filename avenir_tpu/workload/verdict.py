@@ -88,9 +88,21 @@ def evaluate_phase(scenario: Scenario, phase_name: str,
 
 def evaluate_run(scenario: Scenario, per_phase: Dict[str, PhaseStats],
                  compiles_after_warmup: Optional[int] = None,
-                 compiles_at_end: Optional[int] = None) -> dict:
+                 compiles_at_end: Optional[int] = None,
+                 usage_after_warmup: Optional[dict] = None,
+                 usage_at_end: Optional[dict] = None,
+                 cycles_after_warmup: Optional[int] = None,
+                 cycles_at_end: Optional[int] = None) -> dict:
     """The whole run's verdict document: per-phase summaries + checks,
-    the run-level compile-flatness gate, and the overall pass flag."""
+    the run-level compile-flatness and resource-leak gates, and the
+    overall pass flag.
+
+    ``usage_*`` are :func:`~avenir_tpu.workload.runner.process_usage`
+    samples (``{"fds": int|None, "rss_mb": float|None}``) and
+    ``cycles_*`` the model cache's cumulative demote count — soak
+    profiles gate on their growth between the post-warmup baseline and
+    run end.  A declared ceiling the platform cannot measure fails
+    loudly (same contract as a p99 ceiling over zero samples)."""
     phases = []
     violations: List[dict] = []
     for spec in scenario.phases:
@@ -109,8 +121,36 @@ def evaluate_run(scenario: Scenario, per_phase: Dict[str, PhaseStats],
         delta = (compiles_at_end - compiles_after_warmup) if known else None
         run_checks.append(Check("slo.compile.flat", 0, delta,
                                 known and delta == 0))
-        violations.extend({"phase": "__run__", **c.as_dict()}
-                          for c in run_checks if not c.ok)
+
+    def _growth(field):
+        a = (usage_after_warmup or {}).get(field)
+        b = (usage_at_end or {}).get(field)
+        return (b - a) if (a is not None and b is not None) else None
+
+    if scenario.fd_growth_max is not None:
+        d = _growth("fds")
+        run_checks.append(Check("slo.fd.growth.max",
+                                scenario.fd_growth_max, d,
+                                d is not None
+                                and d <= scenario.fd_growth_max))
+    if scenario.rss_growth_max_mb is not None:
+        d = _growth("rss_mb")
+        run_checks.append(Check(
+            "slo.rss.growth.max.mb", scenario.rss_growth_max_mb,
+            round(d, 2) if d is not None else None,
+            d is not None and d <= scenario.rss_growth_max_mb))
+    if scenario.soak_cycles_min is not None:
+        known = (cycles_after_warmup is not None
+                 and cycles_at_end is not None)
+        d = (cycles_at_end - cycles_after_warmup) if known else None
+        # a FLOOR, not a ceiling: the run must have driven at least
+        # this many promote/demote cycles for its flatness gates to
+        # have judged real churn
+        run_checks.append(Check("soak.cycles.min",
+                                scenario.soak_cycles_min, d,
+                                known and d >= scenario.soak_cycles_min))
+    violations.extend({"phase": "__run__", **c.as_dict()}
+                      for c in run_checks if not c.ok)
     return {
         "v": VERDICT_VERSION,
         "scenario": scenario.name,
@@ -123,6 +163,10 @@ def evaluate_run(scenario: Scenario, per_phase: Dict[str, PhaseStats],
         "violations": violations,
         "compiles": {"after_warmup": compiles_after_warmup,
                      "at_end": compiles_at_end},
+        "resources": {"after_warmup": usage_after_warmup,
+                      "at_end": usage_at_end,
+                      "cycles_after_warmup": cycles_after_warmup,
+                      "cycles_at_end": cycles_at_end},
     }
 
 

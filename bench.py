@@ -3156,6 +3156,16 @@ def bench_fleet_scaling():
                "vs_baseline": round(scaling, 3),
                "scaling_2_over_1": round(scaling, 3),
                "host_cores": host_cores,
+               # the scaling gate applies only when the host can run
+               # two jax backend processes in PARALLEL; on a 1-core
+               # host the ratio measures context-switch tax and the
+               # fleet-scaling property is gated functionally by CI
+               # gates 5/6 instead (BASELINE.md round-21/22 notes)
+               "scaling_gate": {
+                   "threshold": 1.7,
+                   "applicable": host_cores >= 2,
+                   "pass": (scaling >= 1.7) if host_cores >= 2
+                   else None},
                "router_1_backend_rows_per_sec": round(router1_rate),
                "direct_1_backend_rows_per_sec": round(direct_rate),
                "router_p99_overhead_pct": round(overhead_pct, 1),
@@ -3163,7 +3173,11 @@ def bench_fleet_scaling():
                "matched_direct_p99_ms": direct_p99,
                "matched_routed_p99_ms": routed_p99,
                "cells": cells}
-        return finish_metric(out)
+        out = finish_metric(out)
+        gate = out["scaling_gate"]
+        if gate["applicable"] and not gate["pass"]:
+            out["regression"] = True
+        return out
     finally:
         for proc in procs:
             if proc.poll() is None:

@@ -8,8 +8,10 @@
 # fleetobs smoke (real publisher processes, real aggregator over TCP,
 # fleet scrape == exact sum) + the router smoke (two real backends
 # behind the jax-free fleet router: byte parity, SIGKILL one backend
-# mid-storm with zero dropped innocents, incident bundle).  Exit
-# nonzero on ANY failure.
+# mid-storm with zero dropped innocents, incident bundle) + the router
+# HA smoke (two replicated routers, SIGKILL the lease-holding LEADER
+# mid-storm: zero dropped, exactly one leadership transfer, quarantine
+# propagated to the sibling backend).  Exit nonzero on ANY failure.
 #
 # Usage: resource/ci/check.sh [extra pytest args...]
 set -euo pipefail
@@ -17,27 +19,31 @@ cd "$(dirname "$0")/../.."
 PY=${PYTHON:-python}
 export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
 
-echo "== gate 1/5: analyze --strict (incremental; sidecar .avenir-analyze/) =="
+echo "== gate 1/6: analyze --strict (incremental; sidecar .avenir-analyze/) =="
 mkdir -p .avenir-analyze
 $PY -m avenir_tpu analyze --strict --json .avenir-analyze/ci-report.json
 
 echo
-echo "== gate 2/5: tier-1 pytest (lock sanitizer rides the chaos/hammer fixtures) =="
+echo "== gate 2/6: tier-1 pytest (lock sanitizer rides the chaos/hammer fixtures) =="
 $PY -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@"
 
 echo
-echo "== gate 3/5: workload smoke (strict SLO envelope, --assert) =="
+echo "== gate 3/6: workload smoke (strict SLO envelope, --assert) =="
 $PY -m avenir_tpu workload \
     --scenario resource/workload/workload_smoke.properties --assert
 
 echo
-echo "== gate 4/5: fleetobs smoke (cross-process fold == exact sum over TCP) =="
+echo "== gate 4/6: fleetobs smoke (cross-process fold == exact sum over TCP) =="
 $PY resource/ci/fleetobs_smoke.py
 
 echo
-echo "== gate 5/5: router smoke (2 backends + jax-free router; kill one, 0 dropped) =="
+echo "== gate 5/6: router smoke (2 backends + jax-free router; kill one, 0 dropped) =="
 $PY resource/ci/router_smoke.py
+
+echo
+echo "== gate 6/6: router HA smoke (2 routers; SIGKILL the leader, 0 dropped, 1 transfer) =="
+$PY resource/ci/router_ha_smoke.py
 
 echo
 echo "ci gate: ALL CLEAN"

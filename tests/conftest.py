@@ -21,6 +21,13 @@ import avenir_tpu  # noqa: E402
 avenir_tpu.enable_x64()
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running soak/chaos suites, excluded from the tier-1 "
+        "run (-m 'not slow')")
+
+
 @pytest.fixture(scope="session")
 def mesh8():
     from avenir_tpu.parallel import make_mesh

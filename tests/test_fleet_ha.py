@@ -1,0 +1,811 @@
+"""No single point of failure (serve/fleet HA): lease-based router
+leadership, generation-fenced scale, fleet-propagated breaker/quarantine
+state, and the any-process kill chaos storm.
+
+The load-bearing guarantees under test:
+
+- **Lease protocol** — exactly one of N routers sharing a spool holds
+  the ``_router_lease``; a SIGKILLed holder is replaced within one TTL
+  (generation bumped exactly once), a cleanly stopping holder hands off
+  immediately via ``release()``, and a deposed holder steps down the
+  moment it reads a foreign nonce.
+- **Generation fencing** — a ``scale`` command stamped with a lease
+  generation below the highest the pool has applied per model is
+  refused (a deposed leader's in-flight decision cannot fight the new
+  leader's); equal generations pass; ungenerated (operator) commands
+  never fence.
+- **Resilience propagation** — breaker state codes and quarantined
+  poison signatures export as a mergeable ``resilience`` snapshot
+  section; a model breaker-OPEN on any fresh sibling is pre-demoted
+  FLEET-WIDE; a signature quarantined on one backend is seeded into
+  every sibling, which refuses matching rows AT SUBMIT — before its
+  own scorer ever sees one.
+- **Scale vs drain (PR 8 discipline)** — a ``scale`` racing graceful
+  drain is rejected with a structured error while in-flight requests
+  keep answering; it is never half-applied.
+- **Chaos storm** — each process class (backend, follower router,
+  leader router, aggregator) killed abruptly mid-storm drops zero
+  idempotent requests; leadership hands off exactly once.
+
+The in-process kills here tear sockets down exactly as a SIGKILL does;
+``resource/ci/router_ha_smoke.py`` (CI gate 6) and the slow-marked
+subprocess test replay the leader-kill with real processes and real
+signals.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from avenir_tpu.core import telemetry
+from avenir_tpu.core.config import JobConfig
+from avenir_tpu.core.io import atomic_write_text, write_output
+from avenir_tpu.core.obs import LatencyHistogram, Metrics
+from avenir_tpu.datagen.generators import gen_telecom_churn
+from avenir_tpu.fleetobs.stitch import feed_dirs
+from avenir_tpu.models.bayesian import BayesianDistribution
+from avenir_tpu.serve import PredictionServer
+from avenir_tpu.serve.batcher import PoisonQuarantine
+from avenir_tpu.serve.fleet.control import ControlLoop
+from avenir_tpu.serve.fleet.lease import LEASE_FILE, RouterLease
+from avenir_tpu.serve.fleet.router import FleetRouter
+from avenir_tpu.serve.fleet.watch import FeedWatch
+from avenir_tpu.serve.frontend import EventLoopFrontend
+from avenir_tpu.serve.server import TruncatedResponseError, request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# lease protocol
+# ---------------------------------------------------------------------------
+
+def _lease(spool, label, ttl=1.0):
+    return RouterLease(JobConfig({"router.lease.ttl.sec": str(ttl)}),
+                       str(spool), label)
+
+
+def test_first_contender_acquires_generation_one(tmp_path):
+    a = _lease(tmp_path, "router-a")
+    assert a.tick(now=100.0) is True
+    assert a.is_leader() and a.generation() == 1
+    sec = a.section()
+    assert sec["holder"] == "router-a" and sec["acquisitions"] == 1
+    doc = json.loads((tmp_path / LEASE_FILE).read_text())
+    assert doc["holder"] == "router-a" and doc["generation"] == 1
+
+
+def test_lease_file_is_invisible_to_feed_scanners(tmp_path):
+    _lease(tmp_path, "router-a").tick(now=100.0)
+    os.makedirs(tmp_path / "serve-a")
+    (tmp_path / "serve-a" / "identity.json").write_text("{}")
+    assert [os.path.basename(d) for d in feed_dirs(str(tmp_path))] == \
+        ["serve-a"]
+
+
+def test_live_foreign_lease_is_followed(tmp_path):
+    a, b = _lease(tmp_path, "router-a"), _lease(tmp_path, "router-b")
+    a.tick(now=100.0)
+    assert b.tick(now=100.3) is False
+    assert not b.is_leader()
+    # the follower tracks the live lease's generation, so a later
+    # promotion starts fencing from the right floor
+    assert b.generation() == 1
+    assert b.section()["holder"] == "router-a"
+
+
+def test_expired_lease_promotes_follower_with_generation_bump(tmp_path):
+    a, b = _lease(tmp_path, "router-a"), _lease(tmp_path, "router-b")
+    a.tick(now=100.0)
+    b.tick(now=100.3)                   # follower while the lease lives
+    # the holder goes silent (SIGKILL): past TTL the follower contends
+    assert b.tick(now=102.0) is True
+    assert b.is_leader() and b.generation() == 2
+    assert b.section()["acquisitions"] == 1
+    # the zombie holder reads a foreign nonce and steps down at once
+    assert a.tick(now=102.1) is False
+    assert not a.is_leader() and a.generation() == 2
+    assert a.section()["step_downs"] == 1
+
+
+def test_release_hands_off_without_waiting_out_ttl(tmp_path):
+    a, b = _lease(tmp_path, "router-a"), _lease(tmp_path, "router-b")
+    a.tick(now=100.0)
+    a.release()                         # clean SIGTERM path
+    assert not a.is_leader()
+    # the released lease is expired in place: no TTL wait needed
+    assert b.tick(now=100.1) is True
+    assert b.generation() == 2
+
+
+def test_generation_is_monotonic_across_handoffs(tmp_path):
+    a, b = _lease(tmp_path, "router-a"), _lease(tmp_path, "router-b")
+    seen = []
+    now = 100.0
+    for i in range(4):
+        holder, other = (a, b) if i % 2 == 0 else (b, a)
+        assert holder.tick(now=now) is True
+        seen.append(holder.generation())
+        now += holder.ttl + 1.0         # holder goes silent; flip roles
+    assert seen == sorted(seen) and len(set(seen)) == 4
+
+
+# ---------------------------------------------------------------------------
+# the mergeable `resilience` snapshot section
+# ---------------------------------------------------------------------------
+
+def test_merge_resilience_folds_by_max_and_commutes():
+    a = {"breakers": {"m": 2, "n": 0},
+         "quarantine": {"m": {"s1": 3, "s2": 1}}}
+    b = {"breakers": {"m": 1, "o": 2},
+         "quarantine": {"m": {"s1": 1, "s3": 4}, "n": {"s9": 2}}}
+    ab = telemetry.merge_resilience(a, b)
+    assert ab["breakers"] == {"m": 2, "n": 0, "o": 2}
+    assert ab["quarantine"]["m"] == {"s1": 3, "s2": 1, "s3": 4}
+    assert ab["quarantine"]["n"] == {"s9": 2}
+    assert telemetry.merge_resilience(b, a) == ab
+    # identity: the empty section is a no-op on either side
+    assert telemetry.merge_resilience(a, None) == \
+        telemetry.merge_resilience(None, a)
+
+
+def test_merge_snapshots_carries_resilience_only_when_present():
+    base = {"counters": {"G": {"n": 1}}}
+    res = {"counters": {"G": {"n": 2}},
+           "resilience": {"breakers": {"m": 2}, "quarantine": {}}}
+    merged = telemetry.merge_snapshots(dict(base), dict(base))
+    # no input carried the section: merged output stays byte-stable for
+    # non-serving processes (batch jobs, routers without trips)
+    assert "resilience" not in merged
+    merged = telemetry.merge_snapshots(dict(base), dict(res))
+    assert merged["resilience"]["breakers"] == {"m": 2}
+    assert merged["counters"]["G"]["n"] == 3
+    assert "resilience" in telemetry.SNAPSHOT_SECTIONS
+
+
+def test_exporter_provider_fold_carries_resilience():
+    def provider():
+        return {"gauges": {},
+                "resilience": {"breakers": {"churn": 2},
+                               "quarantine": {"churn": {"ab12": 3}}}}
+
+    exp = telemetry.TelemetryExporter(0.0, registry=Metrics(),
+                                      providers=[provider])
+    snap = exp.snapshot()
+    assert snap["resilience"]["breakers"] == {"churn": 2}
+    assert snap["resilience"]["quarantine"]["churn"] == {"ab12": 3}
+
+
+# ---------------------------------------------------------------------------
+# quarantine export / seed (the propagation payload)
+# ---------------------------------------------------------------------------
+
+def test_quarantine_export_only_threshold_crossed():
+    q = PoisonQuarantine(threshold=3, cap=16)
+    for _ in range(3):
+        q.record("row-hot")
+    q.record("row-warm")
+    assert q.export() == {PoisonQuarantine.signature("row-hot"): 3}
+
+
+def test_quarantine_seed_folds_by_max_and_reports_crossings():
+    q = PoisonQuarantine(threshold=3, cap=16)
+    sig = PoisonQuarantine.signature("row-x")
+    assert q.seed(sig, 1) is False          # below threshold: counted,
+    assert not q.quarantined("row-x")       # not yet refused
+    assert q.seed(sig, 5) is True           # newly crossed
+    assert q.quarantined("row-x")
+    assert q.seed(sig, 2) is False          # max-fold: 5 stands,
+    assert q.export()[sig] == 5             # re-seeding is idempotent
+
+
+# ---------------------------------------------------------------------------
+# feed watch: fleet-wide pre-demote + quarantine sightings
+# ---------------------------------------------------------------------------
+
+def _write_feed(spool, label, port, published_unix, resilience=None,
+                seq=1):
+    d = os.path.join(spool, label)
+    os.makedirs(d, exist_ok=True)
+    atomic_write_text(os.path.join(d, "identity.json"), json.dumps(
+        {"label": label, "role": "serve", "pid": 1,
+         "trace_epoch_unix_ns": 1}) + "\n")
+    h = LatencyHistogram()
+    h.record(0.001)
+    snap = {"gauges": {telemetry.labeled("serve.frontend.port"):
+                       {"value": float(port), "ts": published_unix}},
+            "hists": {telemetry.labeled("serve.e2e.latency", model="m"):
+                      h.state_dict()},
+            "counters": {"Serve.m": {"Requests": 1}}}
+    if resilience is not None:
+        snap["resilience"] = resilience
+    atomic_write_text(os.path.join(d, "snapshot.json"), json.dumps(
+        {"seq": seq, "published_unix": published_unix, "label": label,
+         "snapshot": snap}) + "\n")
+
+
+def test_breaker_open_on_one_sibling_predemotes_fleet_wide(tmp_path):
+    spool = str(tmp_path)
+    now = time.time()
+    _write_feed(spool, "serve-a", 9001, now,
+                resilience={"breakers": {"m": 2}, "quarantine": {}})
+    _write_feed(spool, "serve-b", 9002, now)
+    watch = FeedWatch(JobConfig({"router.poll.sec": "0"}), spool,
+                      ["127.0.0.1:9001", "127.0.0.1:9002"])
+    watch.scan(now=now)
+    assert watch.fleet_tripped("m")
+    # the healthy rung empties for the model EVERYWHERE — including the
+    # sibling whose own breaker is still closed
+    assert not watch.healthy("127.0.0.1:9001", "m")
+    assert not watch.healthy("127.0.0.1:9002", "m")
+    # per-model: an unrelated model still routes anywhere
+    assert watch.healthy("127.0.0.1:9002", "other")
+    assert watch.section()["fleet_tripped"] == ["m"]
+
+
+def test_half_open_or_stale_trip_does_not_predemote(tmp_path):
+    spool = str(tmp_path)
+    now = time.time()
+    # half-open (code 1) is recovery probing, not an open breaker
+    _write_feed(spool, "serve-a", 9001, now,
+                resilience={"breakers": {"m": 1}, "quarantine": {}})
+    # an OPEN breaker on a STALE feed is history, not state
+    _write_feed(spool, "serve-b", 9002, now - 60,
+                resilience={"breakers": {"m": 2}, "quarantine": {}})
+    watch = FeedWatch(JobConfig({"router.poll.sec": "0",
+                                 "router.feed.stale.sec": "10"}), spool,
+                      ["127.0.0.1:9001", "127.0.0.1:9002"])
+    watch.scan(now=now)
+    assert not watch.fleet_tripped("m")
+    assert watch.healthy("127.0.0.1:9001", "m")
+
+
+def test_quarantine_sightings_union_fresh_feeds_by_max(tmp_path):
+    spool = str(tmp_path)
+    now = time.time()
+    _write_feed(spool, "serve-a", 9001, now, resilience={
+        "breakers": {}, "quarantine": {"m": {"s1": 3, "s2": 2}}})
+    _write_feed(spool, "serve-b", 9002, now, resilience={
+        "breakers": {}, "quarantine": {"m": {"s1": 5}}})
+    _write_feed(spool, "serve-c", 9003, now - 60, resilience={
+        "breakers": {}, "quarantine": {"m": {"s-stale": 9}}})
+    watch = FeedWatch(JobConfig({"router.poll.sec": "0",
+                                 "router.feed.stale.sec": "10"}), spool,
+                      ["127.0.0.1:9001", "127.0.0.1:9002",
+                       "127.0.0.1:9003"])
+    watch.scan(now=now)
+    assert watch.quarantine_sightings() == {"m": {"s1": 5, "s2": 2}}
+    assert watch.backend_quarantine("127.0.0.1:9002") == \
+        {"m": {"s1": 5}}
+    assert watch.backend_quarantine("127.0.0.1:9001") == \
+        {"m": {"s1": 3, "s2": 2}}
+
+
+# ---------------------------------------------------------------------------
+# control loop: leader gating + the propagation pump
+# ---------------------------------------------------------------------------
+
+class _FakeLease:
+    def __init__(self, leader, gen=1):
+        self.leader = leader
+        self.gen = gen
+
+    def is_leader(self):
+        return self.leader
+
+    def generation(self):
+        return self.gen
+
+
+class _CmdRecorder:
+    def __init__(self, name):
+        self.name = name
+        self.sent = []
+
+    def alive(self):
+        return True
+
+    def inflight(self):
+        return 0
+
+    def command(self, obj, timeout):
+        self.sent.append(obj)
+        return {"ok": True}
+
+
+def _autoscale_config():
+    return JobConfig({"router.autoscale.enable": "true",
+                      "router.autoscale.qps.per.replica": "10",
+                      "router.control.interval.sec": "0"})
+
+
+def test_follower_never_issues_scale_commands():
+    link = _CmdRecorder("127.0.0.1:9001")
+    loop = ControlLoop(_autoscale_config(), [link], None,
+                       lambda: {"m": 99.0}, lease=_FakeLease(False))
+    loop.step(now=100.0)
+    assert link.sent == []
+    assert loop.section()["leader"] is False
+
+
+def test_leader_scale_commands_carry_lease_generation():
+    link = _CmdRecorder("127.0.0.1:9001")
+    loop = ControlLoop(_autoscale_config(), [link], None,
+                       lambda: {"m": 99.0}, lease=_FakeLease(True, gen=7))
+    loop.step(now=100.0)
+    assert [c["cmd"] for c in link.sent] == ["scale"]
+    assert link.sent[0]["generation"] == 7
+
+
+def test_propagation_runs_on_followers_and_ledger_bounds_chatter(
+        tmp_path):
+    spool = str(tmp_path)
+    now = time.time()
+    sigs = {"s1": 3}
+    _write_feed(spool, "serve-a", 9001, now, resilience={
+        "breakers": {}, "quarantine": {"m": dict(sigs)}})
+    _write_feed(spool, "serve-b", 9002, now)
+    watch = FeedWatch(JobConfig({"router.poll.sec": "0"}), spool,
+                      ["127.0.0.1:9001", "127.0.0.1:9002"])
+    watch.scan(now=now)
+    links = [_CmdRecorder("127.0.0.1:9001"), _CmdRecorder("127.0.0.1:9002")]
+    # a FOLLOWER still pumps propagation: a hand-off gap must not be a
+    # poison window
+    loop = ControlLoop(JobConfig({"router.control.interval.sec": "0"}),
+                       links, watch, lambda: {}, lease=_FakeLease(False))
+    loop.step(now=100.0)
+    # the backend whose own feed already shows the signature is skipped
+    assert links[0].sent == []
+    assert [c["cmd"] for c in links[1].sent] == ["quarantine"]
+    assert links[1].sent[0] == {"cmd": "quarantine", "model": "m",
+                                "signatures": sigs}
+    assert loop.section()["quarantine_pushes"] == 1
+    # steady state: the _seeded ledger stops the re-push
+    loop.step(now=101.0)
+    assert len(links[1].sent) == 1
+
+
+def test_propagation_disabled_by_config(tmp_path):
+    spool = str(tmp_path)
+    now = time.time()
+    _write_feed(spool, "serve-a", 9001, now, resilience={
+        "breakers": {}, "quarantine": {"m": {"s1": 3}}})
+    watch = FeedWatch(JobConfig({"router.poll.sec": "0"}), spool,
+                      ["127.0.0.1:9001", "127.0.0.1:9002"])
+    watch.scan(now=now)
+    links = [_CmdRecorder("127.0.0.1:9001"), _CmdRecorder("127.0.0.1:9002")]
+    loop = ControlLoop(JobConfig({"serve.breaker.propagate": "false",
+                                  "router.control.interval.sec": "0"}),
+                       links, watch, lambda: {})
+    loop.step(now=100.0)
+    assert links[1].sent == []
+
+
+# ---------------------------------------------------------------------------
+# backend surface: generation fence, scale-vs-drain, quarantine verb
+# ---------------------------------------------------------------------------
+
+SCHEMA = {"fields": [
+    {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+    {"name": "plan", "ordinal": 1, "dataType": "categorical",
+     "feature": True, "cardinality": ["planA", "planB"]},
+    {"name": "minUsed", "ordinal": 2, "dataType": "int", "feature": True,
+     "min": 0, "max": 2200, "bucketWidth": 200},
+    {"name": "dataUsed", "ordinal": 3, "dataType": "int", "feature": True,
+     "min": 0, "max": 1000, "bucketWidth": 100},
+    {"name": "csCall", "ordinal": 4, "dataType": "int", "feature": True,
+     "min": 0, "max": 14, "bucketWidth": 2},
+    {"name": "csEmail", "ordinal": 5, "dataType": "int", "feature": True,
+     "min": 0, "max": 22, "bucketWidth": 4},
+    {"name": "network", "ordinal": 6, "dataType": "int", "feature": True,
+     "min": 0, "max": 12, "bucketWidth": 2},
+    {"name": "churned", "ordinal": 7, "dataType": "categorical",
+     "cardinality": ["N", "Y"]}]}
+
+
+@pytest.fixture(scope="module")
+def ha_art(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("fleet_ha")
+    schema_path = tmp / "schema.json"
+    schema_path.write_text(json.dumps(SCHEMA))
+    rows = gen_telecom_churn(300, seed=31)
+    write_output(str(tmp / "train"), [",".join(r) for r in rows[:260]])
+    BayesianDistribution(JobConfig(
+        {"feature.schema.file.path": str(schema_path)})).run(
+        str(tmp / "train"), str(tmp / "model"))
+    return {"schema": str(schema_path), "model": str(tmp / "model"),
+            "lines": [",".join(r) for r in rows[260:]]}
+
+
+def _server(art, **overrides):
+    props = {
+        "serve.models": "churn",
+        "serve.model.churn.kind": "naiveBayes",
+        "serve.model.churn.feature.schema.file.path": art["schema"],
+        "serve.model.churn.bayesian.model.file.path": art["model"],
+        "serve.pool.replicas": "1",
+        "serve.poison.isolate": "true",
+        "serve.poison.quarantine.threshold": "2",
+        "serve.port": "0",
+        "serve.warmup": "false",
+        "telemetry.interval.sec": "0",
+    }
+    props.update({k: str(v) for k, v in overrides.items()})
+    srv = PredictionServer(JobConfig(props))
+    return srv, srv.start()
+
+
+def test_scale_generation_fence_refuses_stale_leaders(ha_art):
+    srv, port = _server(ha_art)
+    try:
+        resp = request("127.0.0.1", port,
+                       {"cmd": "scale", "model": "churn", "replicas": 2,
+                        "generation": 3}, timeout=30)
+        assert resp.get("ok") and resp["generation"] == 3
+        # a deposed leader's in-flight decision: refused, shape untouched
+        stale = request("127.0.0.1", port,
+                        {"cmd": "scale", "model": "churn", "replicas": 1,
+                         "generation": 2}, timeout=30)
+        assert "stale" in stale.get("error", ""), stale
+        stats = request("127.0.0.1", port, {"cmd": "stats"}, timeout=30)
+        assert len(stats["models"]["churn"]["variants"]["default"]
+                   ["replicas"]) == 2
+        # EQUAL generation passes: the same leader re-deciding
+        resp = request("127.0.0.1", port,
+                       {"cmd": "scale", "model": "churn", "replicas": 1,
+                        "generation": 3}, timeout=30)
+        assert resp.get("ok"), resp
+        # ungenerated (operator CLI) commands never fence
+        resp = request("127.0.0.1", port,
+                       {"cmd": "scale", "model": "churn", "replicas": 1},
+                       timeout=30)
+        assert resp.get("ok"), resp
+        bad = request("127.0.0.1", port,
+                      {"cmd": "scale", "model": "churn", "replicas": 1,
+                       "generation": "seven"}, timeout=30)
+        assert "generation" in bad.get("error", "")
+    finally:
+        srv.stop()
+
+
+def test_scale_racing_drain_is_rejected_cleanly(ha_art):
+    """A scale landing in the drain window (stop() has flipped the
+    drain bit, the frontend is still answering) is refused with a
+    structured error — never half-applied — while in-flight requests
+    keep completing."""
+    srv, port = _server(ha_art)
+    try:
+        row = ha_art["lines"][0]
+        srv._stopped = True
+        resp = request("127.0.0.1", port,
+                       {"cmd": "scale", "model": "churn", "replicas": 2},
+                       timeout=30)
+        assert resp.get("draining") is True and "error" in resp, resp
+        # the drain discipline still answers in-flight work
+        out = request("127.0.0.1", port,
+                      {"model": "churn", "row": row}, timeout=30)
+        assert "output" in out, out
+        stats = request("127.0.0.1", port, {"cmd": "stats"}, timeout=30)
+        assert len(stats["models"]["churn"]["variants"]["default"]
+                   ["replicas"]) == 1
+        # drain abandoned (operator changed their mind): scale applies
+        srv._stopped = False
+        resp = request("127.0.0.1", port,
+                       {"cmd": "scale", "model": "churn", "replicas": 2},
+                       timeout=30)
+        assert resp.get("ok"), resp
+    finally:
+        srv._stopped = False
+        srv.stop()
+
+
+def test_seeded_quarantine_refuses_at_submit_before_scorer(ha_art):
+    """The propagation payload end-to-end on one backend: a signature a
+    SIBLING quarantined is seeded over the wire, and a matching row is
+    refused at submit — this process's scorer never sees it (zero
+    isolated poison failures recorded here)."""
+    srv, port = _server(ha_art)
+    try:
+        poison = "POISON-sibling-row,planA,100,100,2,4,2,N"
+        sig = PoisonQuarantine.signature(poison)
+        resp = request("127.0.0.1", port,
+                       {"cmd": "quarantine", "model": "churn",
+                        "signatures": {sig: 5}}, timeout=30)
+        assert resp.get("ok") and resp["seeded"] == 1, resp
+        refused = request("127.0.0.1", port,
+                          {"model": "churn", "row": poison}, timeout=30)
+        assert refused.get("poison") is True, refused
+        assert "quarantined" in refused.get("error", "")
+        stats = request("127.0.0.1", port, {"cmd": "stats"}, timeout=30)
+        serve = stats["models"]["churn"]["counters"]["Serve"]
+        assert serve.get("Poison quarantined submits", 0) == 1
+        # the scorer-side poison path NEVER fired on this process
+        assert serve.get("Poison rows", 0) == 0
+        assert stats["models"]["churn"]["poison"]["quarantine_size"] == 1
+
+        # below-threshold seeding counts offenses but does not refuse
+        clean = ha_art["lines"][1]
+        resp = request("127.0.0.1", port,
+                       {"cmd": "quarantine", "model": "churn",
+                        "signatures":
+                        {PoisonQuarantine.signature(clean): 1}},
+                       timeout=30)
+        assert resp.get("ok") and resp["seeded"] == 0, resp
+        out = request("127.0.0.1", port,
+                      {"model": "churn", "row": clean}, timeout=30)
+        assert "output" in out, out
+
+        # the resilience overlay exports what propagation needs
+        snap = srv._telemetry_overlay()
+        assert snap["resilience"]["quarantine"]["churn"][sig] == 5
+    finally:
+        srv.stop()
+
+
+def test_quarantine_verb_validates_input(ha_art):
+    srv, port = _server(ha_art)
+    try:
+        resp = request("127.0.0.1", port,
+                       {"cmd": "quarantine", "model": "nope",
+                        "signatures": {"ab": 2}}, timeout=30)
+        assert "error" in resp
+        resp = request("127.0.0.1", port,
+                       {"cmd": "quarantine", "model": "churn"},
+                       timeout=30)
+        assert "signatures" in resp.get("error", "")
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# the chaos storm: kill every process class mid-storm
+# ---------------------------------------------------------------------------
+
+class StubBackend:
+    """Duck-typed instant backend (no jax): records scored rows."""
+
+    max_line_bytes = 1 << 20
+
+    def __init__(self, tag):
+        self.tag = tag
+        self.scored = []
+        self.cmds = []
+        self._lock = threading.Lock()
+
+    def dispatch_line(self, line, cb, conn=None):
+        obj = json.loads(line)
+        rid = obj.get("request_id")
+        if obj.get("cmd") is not None:
+            with self._lock:
+                self.cmds.append(obj)
+            resp = {"ok": True, "cmd": obj["cmd"], "backend": self.tag}
+        else:
+            with self._lock:
+                self.scored.append(obj)
+            resp = {"ok": True, "backend": self.tag,
+                    "row": obj.get("row")}
+        if rid is not None:
+            resp["request_id"] = rid
+        cb(resp)
+        return {"request_id": rid} if rid is not None else None
+
+
+def _frontend(target):
+    return EventLoopFrontend(target, "127.0.0.1", 0, io_threads=1)
+
+
+def _hard_kill_router(router, rfe):
+    """SIGKILL-equivalent: tear the sockets down and stop every thread
+    WITHOUT the clean-shutdown lease release — promotion must come from
+    TTL expiry, exactly as after a real SIGKILL."""
+    rfe.stop()
+    for piece in (router.control, router.lease, router.watch):
+        if piece is not None:
+            piece._stop.set()
+            t = piece._thread
+            if t is not None:
+                t.join(timeout=10)
+
+
+def test_chaos_kill_each_process_class_mid_storm(tmp_path):
+    """240-request storm against 4 replicated routers over 2 backends
+    and an aggregator; a follower router, a backend, the leader router,
+    and the aggregator are killed abruptly at staggered points.  Zero
+    idempotent requests drop (clients fail over between routers, routers
+    fail over between backends), and leadership hands off EXACTLY once,
+    with the generation bumped exactly once."""
+    from avenir_tpu.fleetobs.aggregator import FleetAggregator
+    spool = str(tmp_path / "spool")
+    os.makedirs(spool)
+    b1, b2 = StubBackend("b1"), StubBackend("b2")
+    f1, f2 = _frontend(b1), _frontend(b2)
+    routers = []            # (label, router, frontend)
+    for label in ("ha-a", "ha-b", "ha-c", "ha-d"):
+        config = JobConfig({
+            "router.backends": f"127.0.0.1:{f1.port},127.0.0.1:{f2.port}",
+            "router.backend.connections": "1",
+            "router.request.timeout.sec": "5",
+            "fleetobs.spool.dir": spool,
+            "router.poll.sec": "0.2",
+            "router.lease.ttl.sec": "0.8",
+        })
+        r = FleetRouter(config, identity_label=label).start()
+        rfe = _frontend(r)
+        r.frontend = rfe
+        routers.append((label, r, rfe))
+
+    agg = FleetAggregator(spool, JobConfig({}))
+    agg_stop = threading.Event()
+
+    def agg_loop():
+        while not agg_stop.wait(0.1):
+            try:
+                agg.scan()
+            except Exception:                           # noqa: BLE001
+                pass
+
+    agg_thread = threading.Thread(target=agg_loop, daemon=True)
+    agg_thread.start()
+
+    try:
+        # leadership settles synchronously at start(): the first router
+        # claimed generation 1 and the rest followed
+        leaders = [(label, r, rfe) for label, r, rfe in routers
+                   if r.lease.is_leader()]
+        assert len(leaders) == 1, [r.lease.section() for _, r, _ in routers]
+        leader = leaders[0]
+        followers = [t for t in routers if t[0] != leader[0]]
+        g0 = leader[1].lease.generation()
+        router_ports = [rfe.port for _, _, rfe in routers]
+
+        n_requests, n_threads = 240, 8
+        results = [None] * n_requests
+        done = threading.Semaphore(0)
+        idx_lock = threading.Lock()
+        state = {"next": 0}
+
+        def failover_request(obj):
+            last = None
+            for _ in range(3):          # rounds over every router
+                for port in router_ports:
+                    try:
+                        resp = request("127.0.0.1", port, obj,
+                                       timeout=10)
+                    except (OSError, ValueError,
+                            TruncatedResponseError) as exc:
+                        # a killed router closes mid-response; predicts
+                        # are idempotent — fail over to a sibling
+                        last = {"error": f"transport: {exc}"}
+                        continue
+                    if isinstance(resp, dict) and "error" not in resp:
+                        return resp
+                    last = resp
+                time.sleep(0.05)
+            return last
+
+        def worker():
+            while True:
+                with idx_lock:
+                    i = state["next"]
+                    if i >= n_requests:
+                        return
+                    state["next"] = i + 1
+                results[i] = failover_request(
+                    {"model": "m", "row": f"r{i}",
+                     "request_id": f"ha-{i}"})
+                done.release()
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+
+        def kill_at(count, fn):
+            for _ in range(count):
+                done.acquire()
+            fn()
+
+        kill_at(60, lambda: _hard_kill_router(followers[0][1],
+                                              followers[0][2]))
+        kill_at(30, f1.stop)                     # backend class, at 90
+        kill_at(30, lambda: _hard_kill_router(leader[1],
+                                              leader[2]))  # leader, 120
+        kill_at(60, agg_stop.set)                # aggregator, at 180
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), "hung storm"
+
+        dropped = [i for i, r in enumerate(results)
+                   if not isinstance(r, dict) or "error" in r]
+        assert not dropped, (len(dropped), results[dropped[0]]
+                             if dropped else None)
+
+        # leadership handed off EXACTLY once: one surviving router holds
+        # generation g0+1; the other survivors follow it
+        survivors = [t for t in followers[1:]]
+        deadline = time.monotonic() + 10
+        while True:
+            new_leaders = [t for t in survivors
+                           if t[1].lease.is_leader()]
+            if len(new_leaders) == 1 and \
+                    new_leaders[0][1].lease.generation() == g0 + 1:
+                break
+            assert time.monotonic() < deadline, \
+                [t[1].lease.section() for t in survivors]
+            time.sleep(0.05)
+        assert sum(t[1].lease.section()["acquisitions"]
+                   for t in survivors) == 1
+        for t in survivors:
+            assert t[1].lease.generation() == g0 + 1
+    finally:
+        agg_stop.set()
+        agg_thread.join(timeout=10)
+        for _, r, rfe in routers:
+            rfe.stop()
+            r.stop()
+        f1.stop()
+        f2.stop()
+
+
+def test_quarantine_propagates_within_one_tick(tmp_path):
+    """End-to-end propagation latency: a quarantine appearing in one
+    backend's feed reaches the sibling backend within one feed-poll plus
+    one control tick — on a FOLLOWER router (no leadership required)."""
+    spool = str(tmp_path / "spool")
+    os.makedirs(spool)
+    b1, b2 = StubBackend("b1"), StubBackend("b2")
+    f1, f2 = _frontend(b1), _frontend(b2)
+    config = JobConfig({
+        "router.backends": f"127.0.0.1:{f1.port},127.0.0.1:{f2.port}",
+        "router.backend.connections": "1",
+        "fleetobs.spool.dir": spool,
+        "router.poll.sec": "0.1",
+        "router.control.interval.sec": "0.1",
+        "router.lease.ttl.sec": "0.5",
+    })
+    # a live foreign lease makes this router a FOLLOWER throughout
+    foreign = _lease(spool, "other-router", ttl=60.0)
+    foreign.tick()
+    router = FleetRouter(config, identity_label="ha-prop").start()
+    try:
+        assert not router.lease.is_leader()
+        # backend b2's feed publishes a freshly quarantined signature
+        _write_feed(spool, "serve-b2", f2.port, time.time(), resilience={
+            "breakers": {}, "quarantine": {"m": {"sig-poison": 3}}})
+        deadline = time.monotonic() + 5
+        while not b1.cmds:
+            assert time.monotonic() < deadline, "propagation never fired"
+            time.sleep(0.02)
+        assert b1.cmds[0] == {"cmd": "quarantine", "model": "m",
+                              "signatures": {"sig-poison": 3}}
+        # the backend whose feed already shows it is never re-knocked
+        time.sleep(0.3)
+        assert all(c.get("cmd") != "quarantine" for c in b2.cmds)
+    finally:
+        router.stop()
+        f1.stop()
+        f2.stop()
+
+
+# ---------------------------------------------------------------------------
+# real processes, real SIGKILL (the CI gate, replayed from pytest)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_router_ha_smoke_real_processes():
+    """CI gate 6 end-to-end: 2 router processes + 2 backends, SIGKILL
+    the LEADER router mid-storm, zero dropped + exactly one leadership
+    transfer.  Slow: trains a model and boots 5 real processes."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "resource", "ci", "router_ha_smoke.py")],
+        env=env, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-4000:]

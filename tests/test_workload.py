@@ -314,3 +314,89 @@ def test_e2e_pass_then_tightened_envelope_dumps_once(tmp_path,
         assert anomaly[0]["violations"]
     finally:
         recorder.dump_dir = prev_dir
+
+
+# ---------------------------------------------------------------------------
+# soak gates: resource-leak envelope + the cycle floor
+# ---------------------------------------------------------------------------
+
+def test_verdict_resource_and_cycle_gates():
+    """The run-level soak gates: fd/RSS growth CEILINGS between the
+    post-warmup baseline and run end, the promote/demote cycle FLOOR,
+    and the zero-samples contract (a declared gate the platform could
+    not measure fails loudly, never passes vacuously)."""
+    cfg = _cfg(SERVE_MANIFEST.format(threads=2)
+               + "workload.slo.fd.growth.max=8\n"
+               + "workload.slo.rss.growth.max.mb=64\n"
+               + "workload.soak.cycles.min=500\n")
+    per = {"steady": _stats("steady", [1.0]), "crowd": _stats("crowd", [1.0])}
+    ok = evaluate_run(
+        Scenario(cfg), per,
+        usage_after_warmup={"fds": 40, "rss_mb": 900.0},
+        usage_at_end={"fds": 44, "rss_mb": 930.5},
+        cycles_after_warmup=3, cycles_at_end=620)
+    assert ok["pass"]
+    rc = {c["key"]: c for c in ok["run_checks"]}
+    assert rc["slo.fd.growth.max"]["actual"] == 4
+    assert rc["slo.rss.growth.max.mb"]["actual"] == 30.5
+    assert rc["soak.cycles.min"]["actual"] == 617
+
+    # fd leak: ceiling breached
+    leak = evaluate_run(
+        Scenario(cfg), per,
+        usage_after_warmup={"fds": 40, "rss_mb": 900.0},
+        usage_at_end={"fds": 60, "rss_mb": 901.0},
+        cycles_after_warmup=0, cycles_at_end=600)
+    assert not leak["pass"]
+    assert any(v["key"] == "slo.fd.growth.max" and v["phase"] == "__run__"
+               for v in leak["violations"])
+
+    # idle cache: the cycle FLOOR keeps a churn-free run from claiming
+    # the flatness verdict
+    idle = evaluate_run(
+        Scenario(cfg), per,
+        usage_after_warmup={"fds": 40, "rss_mb": 900.0},
+        usage_at_end={"fds": 40, "rss_mb": 900.0},
+        cycles_after_warmup=0, cycles_at_end=12)
+    assert not idle["pass"]
+    assert any(v["key"] == "soak.cycles.min" for v in idle["violations"])
+
+    # unmeasurable platform: every declared gate reads None and fails
+    blind = evaluate_run(Scenario(cfg), per,
+                         usage_after_warmup={"fds": None, "rss_mb": None},
+                         usage_at_end={"fds": None, "rss_mb": None})
+    assert not blind["pass"]
+    assert {v["key"] for v in blind["violations"]} == {
+        "slo.fd.growth.max", "slo.rss.growth.max.mb", "soak.cycles.min"}
+    assert all(v["actual"] is None for v in blind["violations"])
+
+
+def test_process_usage_and_demote_cycles_readers():
+    from avenir_tpu.workload.runner import demote_cycles, process_usage
+    u = process_usage()
+    # this suite only runs on /proc platforms; both axes must read
+    assert u["fds"] is not None and u["fds"] > 0
+    assert u["rss_mb"] is not None and u["rss_mb"] > 1.0
+    assert demote_cycles({"cache": {"counters": {
+        "Evictions": 37, "Demotes": 4}}}) == 41
+    assert demote_cycles({"models": {}}) == 0
+
+
+@pytest.mark.slow
+def test_soak_profile_resource_flatness(tmp_path):
+    """resource/workload/soak.properties end to end: >=500 real
+    promote/demote cycles through the 4-slot managed cache with the fd,
+    RSS, and compile flatness gates all green."""
+    path = os.path.join(os.path.dirname(__file__), os.pardir,
+                        "resource", "workload", "soak.properties")
+    out = str(tmp_path / "out")
+    cfg = _cfg(open(path).read()
+               + f"\nworkload.out.dir={out}\nflight.dump.dir={out}\n")
+    assert run_scenario(cfg, do_assert=True) == 0
+    verdict = json.load(open(os.path.join(out, "verdict.json")))
+    assert verdict["pass"]
+    rc = {c["key"]: c for c in verdict["run_checks"]}
+    assert rc["soak.cycles.min"]["actual"] >= 500
+    assert rc["slo.compile.flat"]["actual"] == 0
+    assert rc["slo.fd.growth.max"]["ok"]
+    assert rc["slo.rss.growth.max.mb"]["ok"]
